@@ -1,0 +1,131 @@
+"""Codec + message schema round-trip tests."""
+
+import pytest
+
+from metisfl_tpu.comm import dumps, loads
+from metisfl_tpu.comm.messages import (
+    EvalResult,
+    EvalTask,
+    JoinReply,
+    JoinRequest,
+    TaskResult,
+    TrainParams,
+    TrainTask,
+)
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None,
+        True,
+        False,
+        0,
+        1,
+        -1,
+        127,
+        -128,
+        2**40,
+        -(2**40),
+        3.5,
+        -0.0,
+        "",
+        "héllo wörld",
+        b"",
+        b"\x00\xff\x80",
+        [],
+        [1, "two", 3.0, None, [True]],
+        {},
+        {"a": 1, "b": {"c": [1, 2, 3]}, "d": b"raw"},
+    ],
+)
+def test_codec_roundtrip(value):
+    assert loads(dumps(value)) == value
+
+
+def test_codec_rejects_non_str_keys():
+    with pytest.raises(TypeError):
+        dumps({1: "x"})
+
+
+def test_codec_large_nested():
+    value = {"k%d" % i: list(range(i)) for i in range(50)}
+    assert loads(dumps(value)) == value
+
+
+def test_train_task_roundtrip():
+    task = TrainTask(
+        task_id="t1",
+        learner_id="L0",
+        round_id=3,
+        global_iteration=7,
+        model=b"\x01\x02blob",
+        params=TrainParams(batch_size=64, local_steps=10, learning_rate=0.1,
+                           optimizer="adam", optimizer_kwargs={"b1": 0.9},
+                           proximal_mu=0.01),
+    )
+    out = TrainTask.from_wire(task.to_wire())
+    assert out == task
+    assert isinstance(out.params, TrainParams)
+
+
+def test_task_result_roundtrip():
+    result = TaskResult(
+        task_id="t1", learner_id="L0", round_id=3, model=b"m",
+        num_train_examples=1000, completed_steps=20, completed_epochs=1.5,
+        completed_batches=20, processing_ms_per_step=12.5,
+        train_metrics={"loss": 0.5}, epoch_metrics=[{"loss": 0.9}, {"loss": 0.5}],
+    )
+    assert TaskResult.from_wire(result.to_wire()) == result
+
+
+def test_join_roundtrip():
+    req = JoinRequest(hostname="h", port=50052, num_train_examples=600,
+                      previous_id="L9", auth_token="tok")
+    assert JoinRequest.from_wire(req.to_wire()) == req
+    rep = JoinReply(learner_id="L1", auth_token="abc", rejoined=True)
+    assert JoinReply.from_wire(rep.to_wire()) == rep
+
+
+def test_eval_roundtrip():
+    task = EvalTask(task_id="e1", model=b"m", datasets=["train", "test"],
+                    metrics=["loss"])
+    assert EvalTask.from_wire(task.to_wire()) == task
+    res = EvalResult(task_id="e1", evaluations={"test": {"loss": 0.25, "accuracy": 0.9}},
+                     duration_ms=42.0)
+    assert EvalResult.from_wire(res.to_wire()) == res
+
+
+def test_codec_int64_bounds():
+    assert loads(dumps(-(2**63))) == -(2**63)
+    assert loads(dumps(2**63 - 1)) == 2**63 - 1
+    with pytest.raises(OverflowError):
+        dumps(2**63)
+    with pytest.raises(OverflowError):
+        dumps(-(2**63) - 1)
+
+
+def test_codec_truncation_raises():
+    for value in ["hello world", b"abcdef", [1, 2, 3], {"k": 1.5}, 3.25]:
+        buf = dumps(value)
+        for cut in (1, 3, 4):
+            if cut < len(buf):
+                with pytest.raises(ValueError):
+                    loads(buf[:-cut])
+
+
+def test_codec_numpy_scalars():
+    import numpy as np
+    out = loads(dumps({"loss": np.float32(0.5), "n": np.int64(3), "b": np.bool_(True)}))
+    assert out == {"loss": 0.5, "n": 3, "b": True}
+
+
+def test_codec_memoryview_itemsize():
+    import numpy as np
+    mv = np.arange(4, dtype=np.int32).data
+    assert loads(dumps({"p": mv})) == {"p": np.arange(4, dtype=np.int32).tobytes()}
+
+
+def test_codec_varint_overflow_rejected():
+    with pytest.raises(ValueError):
+        loads(b"\x03" + b"\xff" * 30 + b"\x01")
