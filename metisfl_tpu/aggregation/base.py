@@ -1,0 +1,141 @@
+"""Aggregation interfaces and the shared jit-compiled pytree kernels.
+
+Design: every rule consumes ``(model_pytree, scale)`` pairs and produces a
+community model pytree. Arithmetic runs in an accumulator dtype (f32, or f64
+for f64 inputs) and is cast back to each tensor's storage dtype at the end —
+integer tensors round-to-nearest, matching the reference's behavior of
+aggregating every dtype (federated_average_test.cc exercises uint16 models).
+
+The two kernels (`scaled_add`, `finalize`) are jit-compiled once per model
+tree-structure/shape and reused across rounds and rules; XLA fuses the whole
+model into one executable instead of the reference's per-variable OpenMP loop
+(federated_average.cc:101).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def _acc_dtype(dtype) -> jnp.dtype:
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.float64:
+        return jnp.float64
+    return jnp.float32
+
+
+_WIDE = (np.float64, np.int64, np.uint64)
+
+
+def ensure_x64_for(tree) -> None:
+    """Enable jax x64 if the model carries 64-bit tensors.
+
+    TPU compute never wants f64, but the *aggregation contract* is
+    dtype-preserving (the reference aggregates all 10 wire dtypes —
+    federated_average_test.cc); silently truncating a learner's f64 weights
+    would corrupt the federation. Flipping the flag is safe here: the
+    controller owns its process and compiled functions are keyed by dtype.
+    """
+    if jax.config.jax_enable_x64:
+        return
+    for leaf in jax.tree.leaves(tree):
+        if any(np.dtype(leaf.dtype) == w for w in _WIDE):
+            jax.config.update("jax_enable_x64", True)
+            return
+
+
+@jax.jit
+def scaled_init(model: Pytree, scale) -> Pytree:
+    """acc = scale * model, in accumulator dtype."""
+    return jax.tree.map(
+        lambda x: jnp.asarray(x, _acc_dtype(x.dtype)) * scale, model
+    )
+
+
+@jax.jit
+def scaled_add(acc: Pytree, model: Pytree, scale) -> Pytree:
+    """acc += scale * model (single fused XLA computation over the tree)."""
+    return jax.tree.map(
+        lambda a, x: a + jnp.asarray(x, a.dtype) * scale, acc, model
+    )
+
+
+@jax.jit
+def scaled_sub(acc: Pytree, model: Pytree, scale) -> Pytree:
+    """acc -= scale * model."""
+    return jax.tree.map(
+        lambda a, x: a - jnp.asarray(x, a.dtype) * scale, acc, model
+    )
+
+
+def finalize(acc: Pytree, z, like: Pytree) -> Pytree:
+    """community = acc / z, cast back to the storage dtypes of ``like``."""
+    acc_leaves, treedef = jax.tree.flatten(acc)
+    dtypes = tuple(str(x.dtype) for x in jax.tree.leaves(like))
+    out_leaves = _finalize_flat(tuple(acc_leaves), z, dtypes)
+    return jax.tree.unflatten(treedef, out_leaves)
+
+
+@functools.partial(jax.jit, static_argnames=("dtypes",))
+def _finalize_flat(acc_leaves, z, dtypes):
+    out = []
+    for a, dtype in zip(acc_leaves, dtypes):
+        value = a / z
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+            value = jnp.round(value)
+        out.append(value.astype(dtype))
+    return tuple(out)
+
+
+class AggState:
+    """Mutable rolling-aggregation state kept across calls.
+
+    Equivalent of the reference's ``FederatedRollingAverageBase`` members
+    (federated_rolling_average_base.cc:175-291): the scaled community sum
+    (``wc_scaled``) and the running normalization factor (``z``).
+    """
+
+    def __init__(self):
+        self.wc_scaled: Optional[Pytree] = None
+        self.z: float = 0.0
+        # learner_id -> (scale, model) of the latest counted contribution
+        self.contributions: Dict[str, Tuple[float, Pytree]] = {}
+
+    def reset(self) -> None:
+        self.wc_scaled = None
+        self.z = 0.0
+        self.contributions.clear()
+
+
+class AggregationRule(Protocol):
+    """One federation aggregation policy.
+
+    ``required_lineage`` mirrors the reference's
+    ``RequiredLearnerLineageLength`` (aggregation_function.h): how many recent
+    models per learner the store must retain for this rule.
+    """
+
+    name: str
+    required_lineage: int
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+    ) -> Pytree:
+        """Aggregate ``models`` = [(lineage, scale), ...] → community pytree.
+
+        ``lineage`` is the learner's most-recent-first model list (length ≥ 1;
+        only :class:`FedRec` looks past index 0).
+        """
+        ...
+
+    def reset(self) -> None:
+        ...

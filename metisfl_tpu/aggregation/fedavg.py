@@ -1,0 +1,55 @@
+"""FedAvg — weighted average of learner models.
+
+Equivalent of the reference's ``FederatedAverage`` (reference
+metisfl/controller/aggregation/federated_average.cc:70-150): community =
+Σ scaleᵢ · modelᵢ, computed here as a fold of one jit-compiled scaled-add
+over pytrees. ``stride`` bounds how many models the caller materializes at
+once (the controller feeds models block-wise from the store, mirroring the
+stride-blocked loop in controller.cc:842-936); the math is identical for any
+stride because addition is associative.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from metisfl_tpu.aggregation.base import (
+    AggState,
+    Pytree,
+    ensure_x64_for,
+    finalize,
+    scaled_add,
+    scaled_init,
+)
+
+
+class FedAvg:
+    name = "fedavg"
+    required_lineage = 1
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+    ) -> Pytree:
+        if not models:
+            raise ValueError("FedAvg.aggregate called with no models")
+        ensure_x64_for(models[0][0][0])
+        acc = None
+        total = 0.0
+        template = None
+        for lineage, scale in models:
+            model = lineage[0]
+            if template is None:
+                template = model
+            if acc is None:
+                acc = scaled_init(model, scale)
+            else:
+                acc = scaled_add(acc, model, scale)
+            total += float(scale)
+        # Scales from the standard scalers sum to 1; normalize anyway so the
+        # rule is correct for unnormalized weights.
+        return finalize(acc, total, template)
+
+    def reset(self) -> None:  # stateless
+        pass

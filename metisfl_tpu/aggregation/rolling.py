@@ -1,0 +1,112 @@
+"""Rolling (incremental) aggregation: FedStride and FedRec.
+
+Equivalent of the reference's ``FederatedRollingAverageBase`` family
+(reference metisfl/controller/aggregation/federated_rolling_average_base.cc:17-291,
+federated_stride.cc:5-68, federated_recency.cc:7-107):
+
+- The community model is maintained incrementally as ``wc_scaled / z`` where
+  ``wc_scaled = Σ scaleᵢ·modelᵢ`` and ``z = Σ scaleᵢ``.
+- **FedStride**: learners arrive in stride blocks within a round; each block
+  is added to the running sum so only ``stride`` models are ever resident —
+  bounded memory for huge federations. State resets between rounds.
+- **FedRec** (async recency): when a learner reports again, its *previous*
+  contribution is subtracted and the newest added (the reference's case II-B,
+  federated_recency.cc:68-99), so stragglers never double-count. Requires
+  model lineage length 2 (federated_recency.h:19); here the exact previous
+  ``(scale, model)`` is tracked in :class:`AggState` so the subtraction is
+  bit-consistent with what was added.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from metisfl_tpu.aggregation.base import (
+    AggState,
+    Pytree,
+    ensure_x64_for,
+    finalize,
+    scaled_add,
+    scaled_init,
+    scaled_sub,
+)
+
+
+class _RollingBase:
+    def __init__(self):
+        self._state = AggState()
+
+    def reset(self) -> None:
+        self._state.reset()
+
+    def _community(self, template: Pytree) -> Pytree:
+        return finalize(self._state.wc_scaled, self._state.z, template)
+
+    def _add(self, learner_id: str, model: Pytree, scale: float) -> None:
+        state = self._state
+        ensure_x64_for(model)
+        if state.wc_scaled is None:
+            state.wc_scaled = scaled_init(model, scale)
+        else:
+            state.wc_scaled = scaled_add(state.wc_scaled, model, scale)
+        state.z += float(scale)
+        state.contributions[learner_id] = (float(scale), model)
+
+    def _remove(self, learner_id: str) -> None:
+        state = self._state
+        prev = state.contributions.pop(learner_id, None)
+        if prev is not None and state.wc_scaled is not None:
+            old_scale, old_model = prev
+            state.wc_scaled = scaled_sub(state.wc_scaled, old_model, old_scale)
+            state.z -= old_scale
+
+
+class FedStride(_RollingBase):
+    """Stride-blocked synchronous rolling FedAvg (bounded memory)."""
+
+    name = "fedstride"
+    required_lineage = 1
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+        learner_ids: Optional[Sequence[str]] = None,
+    ) -> Pytree:
+        if not models:
+            raise ValueError("FedStride.aggregate called with no models")
+        ids = learner_ids or [f"_anon{i}" for i in range(len(models))]
+        template = None
+        for lid, (lineage, scale) in zip(ids, models):
+            model = lineage[0]
+            if template is None:
+                template = model
+            # Same learner re-submitting within a round replaces its block.
+            self._remove(lid)
+            self._add(lid, model, scale)
+        return self._community(template)
+
+
+class FedRec(_RollingBase):
+    """Asynchronous recency aggregation: newest contribution wins."""
+
+    name = "fedrec"
+    required_lineage = 2
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+        learner_ids: Optional[Sequence[str]] = None,
+    ) -> Pytree:
+        if not models:
+            raise ValueError("FedRec.aggregate called with no models")
+        ids = learner_ids or [f"_anon{i}" for i in range(len(models))]
+        template = None
+        for lid, (lineage, scale) in zip(ids, models):
+            model = lineage[0]
+            if template is None:
+                template = model
+            self._remove(lid)   # case II-B: drop the stale contribution
+            self._add(lid, model, scale)
+        return self._community(template)

@@ -1,0 +1,21 @@
+from metisfl_tpu.config.federation import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    LearnerEndpoint,
+    ModelStoreConfig,
+    SecureAggConfig,
+    TerminationConfig,
+    load_config,
+)
+
+__all__ = [
+    "FederationConfig",
+    "AggregationConfig",
+    "ModelStoreConfig",
+    "SecureAggConfig",
+    "TerminationConfig",
+    "EvalConfig",
+    "LearnerEndpoint",
+    "load_config",
+]

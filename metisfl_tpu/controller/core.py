@@ -1,0 +1,556 @@
+"""Federation controller core.
+
+The orchestration state machine: learner registry, task lifecycle, model
+store, aggregation driver, round-metadata lineage. Capability equivalent of
+the reference's C++ ``Controller``/``ControllerDefaultImpl``
+(reference metisfl/controller/core/controller.cc: AddLearner :98-168,
+RemoveLearner :170-199, LearnerCompletedTask :201-259, ScheduleTasks
+:428-518, UpdateLearnersTaskTemplates :520-569, ComputeCommunityModel
+:795-950), redesigned:
+
+- Models are flat ``{name: np.ndarray}`` dicts controller-side (no byte-blob
+  per-variable arithmetic); aggregation is one jit-compiled XLA computation.
+- Concurrency: RPC threads only enqueue; a single-worker scheduling executor
+  owns all round logic, so a learner's completion ack never blocks on
+  aggregation (the reference pushes ScheduleTasks onto a thread pool for the
+  same reason, controller.cc:246-255) and state needs one lock, not two.
+- Transport is pluggable (:class:`LearnerProxy`): in-process calls for tests
+  and pod-mode, gRPC for cross-host federations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import resource
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from metisfl_tpu.aggregation import make_aggregation_rule
+from metisfl_tpu.aggregation.secure import SecureAgg
+from metisfl_tpu.comm.messages import (
+    EvalResult,
+    EvalTask,
+    JoinReply,
+    JoinRequest,
+    TaskResult,
+    TrainParams,
+    TrainTask,
+)
+from metisfl_tpu.config import FederationConfig
+from metisfl_tpu.scaling import make_scaler
+from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
+from metisfl_tpu.selection import make_selector
+from metisfl_tpu.store import EvictionPolicy, make_store
+from metisfl_tpu.tensor.pytree import ModelBlob
+from metisfl_tpu.tensor.spec import quantify
+
+logger = logging.getLogger("metisfl_tpu.controller")
+
+
+class LearnerProxy(Protocol):
+    """Controller → learner transport for one registered learner."""
+
+    def run_task(self, task: TrainTask) -> None:
+        """Fire-and-forget local-training dispatch."""
+        ...
+
+    def evaluate(self, task: EvalTask, callback: Callable[[EvalResult], None]) -> None:
+        """Non-blocking evaluation; ``callback`` runs on completion."""
+        ...
+
+    def shutdown(self) -> None:
+        ...
+
+
+@dataclass
+class LearnerRecord:
+    learner_id: str
+    auth_token: str
+    hostname: str = "localhost"
+    port: int = 0
+    num_train_examples: int = 0
+    num_val_examples: int = 0
+    num_test_examples: int = 0
+    # latest task execution metadata (feeds scalers + semi-sync recompute)
+    completed_batches: int = 0
+    ms_per_step: float = 0.0
+    # per-learner train overrides (semi-sync step budgets)
+    local_steps_override: int = 0
+    proxy: Optional[LearnerProxy] = None
+
+
+@dataclass
+class RoundMetadata:
+    """Per-round runtime trace — the reference's FederatedTaskRuntimeMetadata
+    (metis.proto:342-365) rebuilt as a plain record."""
+
+    global_iteration: int = 0
+    started_at: float = 0.0
+    completed_at: float = 0.0
+    train_submitted_at: Dict[str, float] = field(default_factory=dict)
+    train_received_at: Dict[str, float] = field(default_factory=dict)
+    eval_submitted_at: Dict[str, float] = field(default_factory=dict)
+    eval_received_at: Dict[str, float] = field(default_factory=dict)
+    selected_learners: List[str] = field(default_factory=list)
+    aggregation_block_sizes: List[int] = field(default_factory=list)
+    aggregation_block_duration_ms: List[float] = field(default_factory=list)
+    aggregation_duration_ms: float = 0.0
+    model_insertion_duration_ms: Dict[str, float] = field(default_factory=dict)
+    model_size: Dict[str, int] = field(default_factory=dict)
+    peak_rss_kb: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Controller:
+    """See module docstring. Lifecycle: ``start()`` → learners ``join()`` →
+    rounds run event-driven off ``task_completed()`` → ``shutdown()``."""
+
+    def __init__(self, config: FederationConfig,
+                 proxy_factory: Callable[[LearnerRecord], LearnerProxy],
+                 secure_backend=None):
+        self.config = config
+        self._proxy_factory = proxy_factory
+        self._lock = threading.RLock()
+        self._learners: Dict[str, LearnerRecord] = {}
+        self._tokens: Dict[str, str] = {}
+
+        agg = config.aggregation
+        if config.secure.enabled:
+            if secure_backend is None:
+                raise ValueError("secure aggregation enabled but no backend given")
+            self._aggregator = SecureAgg(secure_backend)
+        else:
+            self._aggregator = make_aggregation_rule(agg.rule)
+        self._scaler = make_scaler(agg.scaler)
+        self._selector = make_selector("scheduled_cardinality")
+        if config.protocol == "semi_synchronous":
+            self._scheduler = make_scheduler(
+                "semi_synchronous", lambda_=config.semi_sync_lambda,
+                recompute_every_round=config.semi_sync_recompute_every_round)
+        else:
+            self._scheduler = make_scheduler(config.protocol)
+
+        store_cfg = config.model_store
+        lineage = store_cfg.lineage_length or self._aggregator.required_lineage
+        lineage = max(lineage, self._aggregator.required_lineage)
+        store_kwargs = {"lineage_length": lineage}
+        if store_cfg.store == "disk":
+            store_kwargs["root"] = store_cfg.root or "/tmp/metisfl_tpu_store"
+        self._store = make_store(store_cfg.store, **store_kwargs)
+
+        # community model state
+        self._community_flat: Optional[Dict[str, np.ndarray]] = None
+        self._community_blob: Optional[bytes] = None
+        self._community_opaque = None      # secure path
+        self.global_iteration = 0
+
+        # lineage / statistics
+        self.round_metadata: List[RoundMetadata] = []
+        self.community_evaluations: List[Dict[str, Any]] = []
+        self._current_meta = RoundMetadata(global_iteration=0)
+
+        # single-worker pool serializes all scheduling/aggregation work
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ctrl-sched")
+        self._shutdown = threading.Event()
+        self._tasks_in_flight: Dict[str, str] = {}  # task_id -> learner_id
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        pass  # transport servers are owned by the service layer
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._pool.shutdown(wait=True)
+        self._store.shutdown()
+
+    # ------------------------------------------------------------------ #
+    # membership (RPC thread)
+    # ------------------------------------------------------------------ #
+
+    def join(self, request: JoinRequest) -> JoinReply:
+        """Register (or re-register) a learner; schedules its initial task.
+
+        Mirrors AddLearner (controller.cc:98-168) + the rejoin path the
+        reference drives through ALREADY_EXISTS (grpc_controller_client.py:96-107).
+        """
+        with self._lock:
+            if (request.previous_id
+                    and request.previous_id in self._learners
+                    and self._tokens.get(request.previous_id) == request.auth_token):
+                record = self._learners[request.previous_id]
+                record.hostname, record.port = request.hostname, request.port
+                record.proxy = self._proxy_factory(record)
+                logger.info("learner %s rejoined", record.learner_id)
+                return JoinReply(learner_id=record.learner_id,
+                                 auth_token=record.auth_token, rejoined=True)
+            learner_id = f"L{len(self._tokens)}_{request.hostname}_{request.port}"
+            token = uuid.uuid4().hex
+            record = LearnerRecord(
+                learner_id=learner_id, auth_token=token,
+                hostname=request.hostname, port=request.port,
+                num_train_examples=request.num_train_examples,
+                num_val_examples=request.num_val_examples,
+                num_test_examples=request.num_test_examples,
+            )
+            record.proxy = self._proxy_factory(record)
+            self._learners[learner_id] = record
+            self._tokens[learner_id] = token
+        logger.info("learner %s joined (%d train examples)",
+                    learner_id, request.num_train_examples)
+        # Control handoff exactly like controller.cc:163-164: initial task is
+        # scheduled off the join path.
+        self._pool.submit(self._guard, self._schedule_initial, learner_id)
+        return JoinReply(learner_id=learner_id, auth_token=token)
+
+    def leave(self, learner_id: str, auth_token: str) -> bool:
+        """RemoveLearner (controller.cc:170-199): drop registry + models."""
+        with self._lock:
+            record = self._learners.get(learner_id)
+            if record is None or record.auth_token != auth_token:
+                return False
+            del self._learners[learner_id]
+        self._store.erase([learner_id])
+        logger.info("learner %s left", learner_id)
+        return True
+
+    def active_learners(self) -> List[str]:
+        with self._lock:
+            return list(self._learners.keys())
+
+    # ------------------------------------------------------------------ #
+    # community model management (RPC thread)
+    # ------------------------------------------------------------------ #
+
+    def set_community_model(self, blob_bytes: bytes) -> None:
+        """ReplaceCommunityModel (controller.cc:85-96): seed or overwrite."""
+        blob = ModelBlob.from_bytes(blob_bytes)
+        with self._lock:
+            self._community_blob = bytes(blob_bytes)
+            if blob.tensors:
+                self._community_flat = dict(blob.tensors)
+            if blob.opaque:
+                self._community_opaque = dict(blob.opaque)
+
+    def community_model_bytes(self) -> Optional[bytes]:
+        with self._lock:
+            return self._community_blob
+
+    # ------------------------------------------------------------------ #
+    # task completion (RPC thread → scheduling executor)
+    # ------------------------------------------------------------------ #
+
+    def task_completed(self, result: TaskResult) -> bool:
+        """MarkTaskCompleted (controller.cc:201-259). Returns ack; all heavy
+        work happens on the scheduling executor."""
+        if self._shutdown.is_set():
+            return False
+        with self._lock:
+            record = self._learners.get(result.learner_id)
+            if record is None:
+                logger.warning("completion from unknown learner %s",
+                               result.learner_id)
+                return False
+        self._pool.submit(self._guard, self._handle_completed, result)
+        return True
+
+    # ------------------------------------------------------------------ #
+    # scheduling executor internals
+    # ------------------------------------------------------------------ #
+
+    def _guard(self, fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception:  # pragma: no cover - logged, never kills the pool
+            logger.exception("controller executor task failed")
+
+    def _schedule_initial(self, learner_id: str) -> None:
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            record = self._learners.get(learner_id)
+        if record is None:
+            return
+        self._dispatch_train([learner_id])
+
+    def _handle_completed(self, result: TaskResult) -> None:
+        start = time.time()
+        with self._lock:
+            record = self._learners.get(result.learner_id)
+            if record is None:
+                return
+            record.completed_batches = result.completed_batches
+            if result.processing_ms_per_step > 0:
+                record.ms_per_step = result.processing_ms_per_step
+            self._tasks_in_flight.pop(result.task_id, None)
+            self._current_meta.train_received_at[result.learner_id] = start
+
+        model = self._parse_result_model(result)
+        self._store.insert(result.learner_id, model)
+        with self._lock:
+            self._current_meta.model_insertion_duration_ms[result.learner_id] = (
+                (time.time() - start) * 1e3)
+
+        to_schedule = self._scheduler.schedule_next(
+            result.learner_id, self.active_learners())
+        if not to_schedule:
+            return
+        self._complete_round(to_schedule)
+
+    def _parse_result_model(self, result: TaskResult):
+        blob = ModelBlob.from_bytes(result.model)
+        if self.config.secure.enabled:
+            return result.model if blob.opaque else dict(blob.tensors)
+        return dict(blob.tensors)
+
+    def _complete_round(self, cohort: Sequence[str]) -> None:
+        """One ScheduleTasks pass (controller.cc:428-518): select, aggregate,
+        record metadata, evaluate, re-dispatch."""
+        selected = self._selector.select(cohort, self.active_learners())
+        self._compute_community_model(selected)
+        self._send_eval_tasks()
+        with self._lock:
+            self.global_iteration += 1
+            self._current_meta.completed_at = time.time()
+            self._current_meta.peak_rss_kb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+            self.round_metadata.append(self._current_meta)
+            self._current_meta = RoundMetadata(
+                global_iteration=self.global_iteration)
+        self._maybe_recompute_semisync()
+        if not self._shutdown.is_set():
+            self._dispatch_train(self._sample_cohort(cohort))
+
+    def _sample_cohort(self, cohort: Sequence[str]) -> List[str]:
+        ratio = self.config.aggregation.participation_ratio
+        active = self.active_learners()
+        pool = [lid for lid in cohort if lid in active] or active
+        if ratio >= 1.0:
+            return pool
+        k = max(1, int(round(ratio * len(pool))))
+        return random.sample(pool, k)
+
+    def _maybe_recompute_semisync(self) -> None:
+        if not isinstance(self._scheduler, SemiSynchronousScheduler):
+            return
+        batch = self.config.train.batch_size
+        with self._lock:
+            timings = {
+                lid: {
+                    "ms_per_step": r.ms_per_step,
+                    "steps_per_epoch": max(1.0, r.num_train_examples / max(1, batch)),
+                }
+                for lid, r in self._learners.items()
+            }
+        overrides = self._scheduler.recompute_steps(timings)
+        if not overrides:
+            return
+        with self._lock:
+            for lid, steps in overrides.items():
+                if lid in self._learners:
+                    self._learners[lid].local_steps_override = steps
+        logger.info("semi-sync step budgets: %s", overrides)
+
+    # -- aggregation ------------------------------------------------------
+
+    def _compute_community_model(self, selected: Sequence[str]) -> None:
+        """ComputeCommunityModel (controller.cc:795-950), stride-blocked."""
+        t0 = time.time()
+        lineage_k = self._aggregator.required_lineage
+        stride = self.config.aggregation.stride_length or len(selected) or 1
+        scales = self._scaler(self._scaling_metadata(selected))
+        if hasattr(self._aggregator, "reset") and self._aggregator.name != "fedrec":
+            self._aggregator.reset()
+
+        community = None
+        meta_blocks: List[int] = []
+        meta_durations: List[float] = []
+        ids = [lid for lid in selected if lid in scales]
+        if self.config.secure.enabled or self._aggregator.name == "fedavg":
+            # FedAvg / secure: one pass over blocks, associative accumulation
+            # happens inside the rule via repeated calls (fedavg recomputes
+            # from scratch, so feed all blocks' models in one call but select
+            # from the store block-wise to bound resident memory).
+            pairs, id_order = [], []
+            for i in range(0, len(ids), stride):
+                block = ids[i : i + stride]
+                tb = time.time()
+                picked = self._store.select(block, k=lineage_k)
+                for lid in block:
+                    if lid in picked:
+                        pairs.append((picked[lid], scales[lid]))
+                        id_order.append(lid)
+                meta_blocks.append(len(block))
+                meta_durations.append((time.time() - tb) * 1e3)
+            if not pairs:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+            pairs = self._parse_secure(pairs) if self.config.secure.enabled else pairs
+            community = self._aggregator.aggregate(pairs)
+        else:
+            # rolling rules (fedstride / fedrec): incremental block updates
+            for i in range(0, len(ids), stride):
+                block = ids[i : i + stride]
+                tb = time.time()
+                picked = self._store.select(block, k=lineage_k)
+                pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
+                present = [lid for lid in block if lid in picked]
+                if pairs:
+                    community = self._aggregator.aggregate(
+                        pairs, learner_ids=present)
+                meta_blocks.append(len(block))
+                meta_durations.append((time.time() - tb) * 1e3)
+            if community is None:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+
+        blob = self._community_to_blob(community)
+        with self._lock:
+            if self.config.secure.enabled:
+                self._community_opaque = community
+            else:
+                self._community_flat = community
+            self._community_blob = blob
+            meta = self._current_meta
+            meta.selected_learners = list(selected)
+            meta.aggregation_block_sizes = meta_blocks
+            meta.aggregation_block_duration_ms = meta_durations
+            meta.aggregation_duration_ms = (time.time() - t0) * 1e3
+            if not self.config.secure.enabled:
+                sizes = {"values": 0, "non_zeros": 0, "zeros": 0, "bytes": 0}
+                for arr in community.values():
+                    q = quantify(np.asarray(arr))
+                    for key in sizes:
+                        sizes[key] += q[key]
+                meta.model_size = sizes
+
+    def _parse_secure(self, pairs):
+        parsed = []
+        for lineage, scale in pairs:
+            models = []
+            for item in lineage:
+                if isinstance(item, (bytes, bytearray)):
+                    blob = ModelBlob.from_bytes(item)
+                    models.append(dict(blob.opaque))
+                else:
+                    models.append(item)
+            parsed.append((models, scale))
+        return parsed
+
+    def _community_to_blob(self, community) -> bytes:
+        if self.config.secure.enabled:
+            return ModelBlob(opaque=dict(community)).to_bytes()
+        named = [(name, np.asarray(arr)) for name, arr in community.items()]
+        return ModelBlob(tensors=named).to_bytes()
+
+    def _scaling_metadata(self, selected: Sequence[str]) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                lid: {
+                    "num_train_examples": self._learners[lid].num_train_examples,
+                    "completed_batches": self._learners[lid].completed_batches,
+                }
+                for lid in selected
+                if lid in self._learners
+            }
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _dispatch_train(self, learner_ids: Sequence[str]) -> None:
+        """SendRunTasks (controller.cc:696-759)."""
+        with self._lock:
+            blob = self._community_blob
+        if blob is None:
+            logger.warning("no community model yet; cannot dispatch train tasks")
+            return
+        for lid in learner_ids:
+            with self._lock:
+                record = self._learners.get(lid)
+                if record is None:
+                    continue
+                params = dataclasses.replace(self.config.train)
+                if record.local_steps_override:
+                    params.local_steps = record.local_steps_override
+                task = TrainTask(
+                    task_id=uuid.uuid4().hex,
+                    learner_id=lid,
+                    round_id=self.global_iteration,
+                    global_iteration=self.global_iteration,
+                    model=blob,
+                    params=params,
+                )
+                self._tasks_in_flight[task.task_id] = lid
+                self._current_meta.train_submitted_at[lid] = time.time()
+                proxy = record.proxy
+            try:
+                proxy.run_task(task)
+            except Exception:
+                # Failed dispatches are logged and dropped, like the
+                # reference (controller.cc:783-786); async protocols recover,
+                # sync rounds rely on membership changes.
+                logger.exception("train dispatch to %s failed", lid)
+
+    def _send_eval_tasks(self) -> None:
+        """SendEvaluationTasks (controller.cc:571-647) + digest callback."""
+        cfg = self.config.eval
+        if cfg.every_n_rounds <= 0:
+            return
+        if (self.global_iteration + 1) % cfg.every_n_rounds != 0:
+            return
+        with self._lock:
+            blob = self._community_blob
+            learners = list(self._learners.values())
+            iteration = self.global_iteration
+        if blob is None:
+            return
+        entry: Dict[str, Any] = {"global_iteration": iteration, "evaluations": {}}
+        with self._lock:
+            self.community_evaluations.append(entry)
+        for record in learners:
+            task = EvalTask(
+                task_id=uuid.uuid4().hex,
+                learner_id=record.learner_id,
+                round_id=iteration,
+                model=blob,
+                batch_size=cfg.batch_size,
+                datasets=list(cfg.datasets),
+                metrics=list(cfg.metrics),
+            )
+            with self._lock:
+                self._current_meta.eval_submitted_at[record.learner_id] = time.time()
+
+            def _digest(result: EvalResult, lid=record.learner_id, entry=entry):
+                with self._lock:
+                    entry["evaluations"][lid] = result.evaluations
+                    self._current_meta.eval_received_at[lid] = time.time()
+
+            try:
+                record.proxy.evaluate(task, _digest)
+            except Exception:
+                logger.exception("eval dispatch to %s failed", record.learner_id)
+
+    # ------------------------------------------------------------------ #
+    # statistics (driver)
+    # ------------------------------------------------------------------ #
+
+    def get_statistics(self) -> dict:
+        with self._lock:
+            return {
+                "global_iteration": self.global_iteration,
+                "learners": sorted(self._learners.keys()),
+                "round_metadata": [m.to_dict() for m in self.round_metadata],
+                "community_evaluations": [dict(e) for e in self.community_evaluations],
+            }

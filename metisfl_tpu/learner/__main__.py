@@ -1,0 +1,76 @@
+"""Learner process entry point: ``python -m metisfl_tpu.learner``.
+
+Reference: metisfl/learner/__main__.py:10-90. The model + datasets arrive as
+a cloudpickled *recipe*: a zero-arg callable returning
+``(model_ops, train_ds, val_ds, test_ds)`` — the same mechanism as the
+reference's dataset recipes (driver_session.py:71-90) extended to the model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import socket
+import sys
+
+import cloudpickle
+
+from metisfl_tpu.controller.service import ControllerClient
+from metisfl_tpu.learner.learner import Learner
+from metisfl_tpu.learner.service import LearnerServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("metisfl_tpu.learner")
+    parser.add_argument("--controller-host", default="localhost")
+    parser.add_argument("--controller-port", type=int, required=True)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--advertise-host", default="",
+                        help="hostname the controller should dial back")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--recipe", required=True,
+                        help="cloudpickled callable -> (ops, train, val, test)")
+    parser.add_argument("--previous-id", default="")
+    parser.add_argument("--auth-token", default="")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    with open(args.recipe, "rb") as f:
+        recipe = cloudpickle.load(f)
+    built = recipe()
+    model_ops, train_ds = built[0], built[1]
+    val_ds = built[2] if len(built) > 2 else None
+    test_ds = built[3] if len(built) > 3 else None
+    secure_backend = built[4] if len(built) > 4 else None
+
+    controller = ControllerClient(args.controller_host, args.controller_port)
+    advertise = args.advertise_host or socket.gethostname()
+    learner = Learner(
+        model_ops=model_ops,
+        train_dataset=train_ds,
+        val_dataset=val_ds,
+        test_dataset=test_ds,
+        hostname=advertise,
+        controller=controller,
+        secure_backend=secure_backend,
+    )
+    server = LearnerServer(learner, host=args.host, port=args.port)
+    port = server.start()
+    print(f"METISFL_TPU_LEARNER_READY port={port}", flush=True)
+
+    reply = learner.join_federation(previous_id=args.previous_id,
+                                    auth_token=args.auth_token)
+    print(f"METISFL_TPU_LEARNER_JOINED id={reply.learner_id}", flush=True)
+
+    signal.signal(signal.SIGTERM, lambda *_: server.stop())
+    signal.signal(signal.SIGINT, lambda *_: server.stop())
+    server.wait_for_shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""FlaxModelOps — the learner's jit-compiled execution engine.
+
+Replaces the reference's per-engine ModelOps (keras_model_ops.py:117-225,
+pytorch_model_ops.py:23-172) with one JAX engine:
+
+- local training runs **exactly N optimizer steps** as a cached jit-compiled
+  step function (the reference converts steps→epochs and stops early with a
+  ``StepCounter`` callback, keras_model_ops.py:131-138 — lossy; here N is N);
+- FedProx is a proximal term added to the loss (∇ matches the reference's
+  ``fed_prox.py`` update exactly);
+- BatchNorm-style mutable state (``batch_stats``) is part of the federated
+  model: it ships and aggregates with the weights;
+- step wall-clock is measured post-compilation so the semi-sync scheduler
+  sees steady-state timings (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import inspect
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.models.dataset import ArrayDataset
+from metisfl_tpu.models.optimizers import make_optimizer
+
+Pytree = Any
+
+
+@dataclass
+class TrainOutput:
+    variables: Pytree
+    completed_steps: int
+    completed_batches: int
+    completed_epochs: float
+    ms_per_step: float
+    train_metrics: Dict[str, float]
+    epoch_metrics: List[Dict[str, float]] = field(default_factory=list)
+
+
+def softmax_cross_entropy_loss(logits, y):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def mse_loss(preds, y):
+    return jnp.mean(jnp.square(preds - y))
+
+
+_LOSSES = {
+    "softmax_cross_entropy": softmax_cross_entropy_loss,
+    "mse": mse_loss,
+}
+
+
+def _accuracy(logits, y):
+    return jnp.mean(jnp.argmax(logits, axis=-1) == y)
+
+
+class FlaxModelOps:
+    """Train/eval engine around one Flax module instance.
+
+    ``module.apply`` convention: zoo modules accept an optional ``train``
+    kwarg (dropout/batchnorm mode); plain modules without it work too.
+    """
+
+    def __init__(
+        self,
+        module,
+        sample_input: np.ndarray,
+        loss: str | Callable = "softmax_cross_entropy",
+        rng_seed: int = 0,
+        variables: Optional[Pytree] = None,
+    ):
+        self.module = module
+        self._loss_name = loss if isinstance(loss, str) else getattr(loss, "__name__", "custom")
+        self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
+        self._rng = jax.random.PRNGKey(rng_seed)
+        if variables is not None:
+            self.variables = variables
+        else:
+            init_kwargs = {}
+            if self._accepts_train_kwarg():
+                init_kwargs["train"] = False
+            self.variables = module.init(
+                {"params": self._rng, "dropout": jax.random.fold_in(self._rng, 1)},
+                jnp.asarray(sample_input), **init_kwargs)
+        self._has_batch_stats = "batch_stats" in self.variables
+        self._step_cache: Dict[tuple, Callable] = {}
+        self._eval_cache: Optional[Callable] = None
+
+    # -- module introspection ---------------------------------------------
+    def _accepts_train_kwarg(self) -> bool:
+        try:
+            sig = inspect.signature(self.module.__call__)
+            return "train" in sig.parameters
+        except (TypeError, ValueError):  # pragma: no cover
+            return False
+
+    def _apply(self, variables, x, train: bool, rngs=None):
+        kwargs = {}
+        if self._accepts_train_kwarg():
+            kwargs["train"] = train
+        mutable = ["batch_stats"] if (train and self._has_batch_stats) else False
+        return self.module.apply(variables, x, rngs=rngs, mutable=mutable, **kwargs)
+
+    # -- weights I/O -------------------------------------------------------
+    def get_variables(self) -> Pytree:
+        return jax.device_get(self.variables)
+
+    def set_variables(self, variables: Pytree) -> None:
+        self.variables = jax.tree.map(jnp.asarray, variables)
+
+    # -- training ----------------------------------------------------------
+    def _make_step(self, params_cfg: TrainParams):
+        key = (
+            params_cfg.optimizer,
+            float(params_cfg.learning_rate),
+            tuple(sorted((params_cfg.optimizer_kwargs or {}).items())),
+            float(params_cfg.proximal_mu),
+            self._loss_name,
+        )
+        if key in self._step_cache:
+            return self._step_cache[key]
+
+        tx = make_optimizer(params_cfg.optimizer, params_cfg.learning_rate,
+                            params_cfg.optimizer_kwargs)
+        mu = float(params_cfg.proximal_mu)
+        has_bs = self._has_batch_stats
+        loss_fn = self.loss_fn
+
+        def loss_and_aux(params, batch_stats, global_params, x, y, rng):
+            variables = {"params": params}
+            if has_bs:
+                variables["batch_stats"] = batch_stats
+            out = self._apply(variables, x, train=True,
+                              rngs={"dropout": rng})
+            if has_bs:
+                logits, mutated = out
+                new_bs = mutated["batch_stats"]
+            else:
+                logits, new_bs = out, batch_stats
+            loss = loss_fn(logits, y)
+            if mu > 0.0:
+                prox = sum(
+                    jnp.sum(jnp.square(p - p0))
+                    for p, p0 in zip(jax.tree.leaves(params),
+                                     jax.tree.leaves(global_params))
+                )
+                loss = loss + 0.5 * mu * prox
+            return loss, (logits, new_bs)
+
+        def step(params, batch_stats, opt_state, global_params, x, y, rng):
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                loss_and_aux, has_aux=True)(params, batch_stats, global_params,
+                                            x, y, rng)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            acc = _accuracy(logits, y)
+            return params, new_bs, opt_state, loss, acc
+
+        compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._step_cache[key] = (compiled, tx)
+        return self._step_cache[key]
+
+    def train(self, dataset: ArrayDataset, params_cfg: TrainParams,
+              cancel_event=None) -> TrainOutput:
+        steps_per_epoch = max(1, len(dataset) // max(1, params_cfg.batch_size))
+        if params_cfg.local_steps > 0:
+            total_steps = params_cfg.local_steps
+        else:
+            total_steps = max(1, int(math.ceil(
+                params_cfg.local_epochs * steps_per_epoch)))
+
+        compiled, tx = self._make_step(params_cfg)
+        params = self.variables["params"]
+        batch_stats = self.variables.get("batch_stats", {})
+        # FedProx anchors to a non-donated copy of the round-start params;
+        # without FedProx an empty tree avoids aliasing the donated params.
+        global_params = (jax.tree.map(jnp.copy, params)
+                         if params_cfg.proximal_mu > 0 else {})
+        opt_state = tx.init(params)
+
+        losses: List[float] = []
+        accs: List[float] = []
+        epoch_metrics: List[Dict[str, float]] = []
+        epoch_losses: List[Any] = []
+        step_times: List[float] = []
+        completed = 0
+        rng = self._rng
+
+        stream = dataset.infinite_batches(params_cfg.batch_size)
+        for step_idx in range(total_steps):
+            if cancel_event is not None and cancel_event.is_set():
+                break
+            x, y = next(stream)
+            rng = jax.random.fold_in(rng, step_idx)
+            t0 = time.perf_counter()
+            params, batch_stats, opt_state, loss, acc = compiled(
+                params, batch_stats, opt_state, global_params,
+                jnp.asarray(x), jnp.asarray(y), rng)
+            if step_idx > 0 or total_steps == 1:
+                # skip the compile step for steady-state timing
+                jax.block_until_ready(loss)
+                step_times.append(time.perf_counter() - t0)
+            completed += 1
+            epoch_losses.append((loss, acc))
+            if (step_idx + 1) % steps_per_epoch == 0 or step_idx == total_steps - 1:
+                ls = [float(l) for l, _ in epoch_losses]
+                as_ = [float(a) for _, a in epoch_losses]
+                epoch_metrics.append({"loss": float(np.mean(ls)),
+                                      "accuracy": float(np.mean(as_))})
+                losses.extend(ls)
+                accs.extend(as_)
+                epoch_losses = []
+
+        if epoch_losses:
+            losses.extend(float(l) for l, _ in epoch_losses)
+            accs.extend(float(a) for _, a in epoch_losses)
+
+        new_vars = {"params": params}
+        if self._has_batch_stats:
+            new_vars["batch_stats"] = batch_stats
+        self.variables = new_vars
+        self._rng = rng
+
+        ms_per_step = float(np.median(step_times) * 1e3) if step_times else 0.0
+        return TrainOutput(
+            variables=self.get_variables(),
+            completed_steps=completed,
+            completed_batches=completed,
+            completed_epochs=completed / steps_per_epoch,
+            ms_per_step=ms_per_step,
+            train_metrics={
+                "loss": float(np.mean(losses)) if losses else float("nan"),
+                "accuracy": float(np.mean(accs)) if accs else float("nan"),
+            },
+            epoch_metrics=epoch_metrics,
+        )
+
+    # -- evaluation --------------------------------------------------------
+    def _make_eval(self):
+        if self._eval_cache is None:
+            loss_fn = self.loss_fn
+
+            def eval_step(variables, x, y):
+                logits = self._apply(variables, x, train=False)
+                return loss_fn(logits, y), _accuracy(logits, y), x.shape[0]
+
+            self._eval_cache = jax.jit(eval_step)
+        return self._eval_cache
+
+    def evaluate(self, dataset: ArrayDataset, batch_size: int = 256,
+                 metrics: Optional[List[str]] = None,
+                 variables: Optional[Pytree] = None) -> Dict[str, float]:
+        """Evaluate ``variables`` (default: the engine's current model).
+
+        Passing variables explicitly lets an eval run concurrently with
+        training without racing on the engine's model slot.
+        """
+        eval_step = self._make_eval()
+        if variables is None:
+            variables = self.variables
+        else:
+            variables = jax.tree.map(jnp.asarray, variables)
+        total_loss = 0.0
+        total_acc = 0.0
+        count = 0
+        for x, y in dataset.batches(batch_size, shuffle=False):
+            loss, acc, n = eval_step(variables, jnp.asarray(x), jnp.asarray(y))
+            total_loss += float(loss) * int(n)
+            total_acc += float(acc) * int(n)
+            count += int(n)
+        if count == 0:
+            return {}
+        out = {"loss": total_loss / count, "accuracy": total_acc / count}
+        if metrics:
+            out = {k: v for k, v in out.items() if k in metrics or k == "loss"}
+        return out

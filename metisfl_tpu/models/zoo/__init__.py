@@ -1,0 +1,12 @@
+"""Flax model zoo.
+
+TPU-native counterparts of the reference's example model zoo
+(reference examples/keras/models/*.py, examples/pytorch/models/mlp.py):
+small federated workloads (MLP, CNNs, LSTM) plus the scale-ladder models
+from BASELINE.md (ResNet-20, ViT, BERT, Llama+LoRA).
+"""
+
+from metisfl_tpu.models.zoo.mlp import MLP, HousingMLP
+from metisfl_tpu.models.zoo.cnn import FashionMnistCNN, Cifar10CNN
+
+__all__ = ["MLP", "HousingMLP", "FashionMnistCNN", "Cifar10CNN"]

@@ -1,0 +1,44 @@
+"""Small CNNs (reference examples/keras/models/fashion_mnist_cnn.py,
+cifar10_cnn.py): the minimum end-to-end federation workloads."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FashionMnistCNN(nn.Module):
+    """2-conv CNN for 28×28×1 inputs — the reference's flagship example
+    (examples/keras/fashionmnist.py)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class Cifar10CNN(nn.Module):
+    """3-block VGG-style CNN for 32×32×3 inputs."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for width in (32, 64, 128):
+            x = nn.relu(nn.Conv(width, (3, 3))(x))
+            x = nn.relu(nn.Conv(width, (3, 3))(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,25 @@
+"""Parallelism: meshes, shardings, collectives, pod-mode federation.
+
+The reference has no device parallelism at all — its only scale axes are
+learner count and aggregation stride (SURVEY.md §2.3). This package is the
+TPU-native upgrade path:
+
+- :mod:`mesh`        — named device meshes (fed/dp/fsdp/tp/sp/ep axes).
+- :mod:`sharding`    — partition rules for param pytrees.
+- :mod:`collectives` — jit-compiled federated averaging as ``psum`` over ICI.
+- :mod:`podfed`      — N learners co-resident on one pod slice: weights never
+  leave the device; the controller reduces to bookkeeping (the BASELINE.json
+  north star).
+"""
+
+from metisfl_tpu.parallel.mesh import MeshConfig, build_mesh
+from metisfl_tpu.parallel.collectives import federated_mean_psum, make_pod_aggregator
+from metisfl_tpu.parallel.podfed import PodFederation
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "federated_mean_psum",
+    "make_pod_aggregator",
+    "PodFederation",
+]

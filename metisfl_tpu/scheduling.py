@@ -1,0 +1,105 @@
+"""Round scheduling policies: synchronous, semi-synchronous, asynchronous.
+
+Equivalent of the reference's ``Scheduler`` strategies
+(reference metisfl/controller/scheduling/synchronous_scheduler.h:13-40,
+asynchronous_scheduler.h:12-20) plus the semi-synchronous per-learner step
+recomputation the reference keeps inside the controller
+(controller.cc:520-569). Pure in-memory policy objects — no I/O.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Set
+
+
+class SynchronousScheduler:
+    """Release the full cohort only when every active learner has reported."""
+
+    name = "synchronous"
+
+    def __init__(self):
+        self._completed: Set[str] = set()
+
+    def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
+        self._completed.add(learner_id)
+        # Only count learners that are still active (a learner leaving
+        # mid-round must not stall the federation forever).
+        pending = [lid for lid in active if lid not in self._completed]
+        if pending:
+            return []
+        self._completed.clear()
+        return list(active)
+
+    def reset(self) -> None:
+        self._completed.clear()
+
+
+class AsynchronousScheduler:
+    """Immediately reschedule the reporting learner (no round barrier)."""
+
+    name = "asynchronous"
+
+    def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
+        return [learner_id]
+
+    def reset(self) -> None:
+        pass
+
+
+class SemiSynchronousScheduler(SynchronousScheduler):
+    """Synchronous release + per-learner step budget matched to the slowest.
+
+    After each round, every learner's local-step count is recomputed so all
+    learners train for ``lambda_ × (slowest learner's epoch wall-clock)``:
+    ``steps_i = lambda_ · t_slowest_epoch / t_step_i``. Mirrors the
+    reference's ``UpdateLearnersTaskTemplates`` (controller.cc:529-567).
+    """
+
+    name = "semi_synchronous"
+
+    def __init__(self, lambda_: float = 1.0, recompute_every_round: bool = False):
+        super().__init__()
+        self.lambda_ = float(lambda_)
+        self.recompute_every_round = recompute_every_round
+        self._recomputed_once = False
+
+    def recompute_steps(
+        self,
+        timings: Dict[str, Dict[str, float]],
+    ) -> Dict[str, int]:
+        """``timings[lid] = {"ms_per_step": float, "steps_per_epoch": float}``
+        → per-learner local-step budgets for the next round."""
+        if self.recompute_every_round is False and self._recomputed_once:
+            return {}
+        usable = {
+            lid: t
+            for lid, t in timings.items()
+            if t.get("ms_per_step", 0) > 0 and t.get("steps_per_epoch", 0) > 0
+        }
+        if not usable:
+            return {}
+        slowest_epoch_ms = max(
+            t["ms_per_step"] * t["steps_per_epoch"] for t in usable.values()
+        )
+        budget_ms = self.lambda_ * slowest_epoch_ms
+        self._recomputed_once = True
+        return {
+            lid: max(1, int(budget_ms / t["ms_per_step"]))
+            for lid, t in usable.items()
+        }
+
+
+SCHEDULERS = {
+    "synchronous": SynchronousScheduler,
+    "semi_synchronous": SemiSynchronousScheduler,
+    "asynchronous": AsynchronousScheduler,
+}
+
+
+def make_scheduler(name: str, **kwargs):
+    try:
+        cls = SCHEDULERS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown scheduler {name!r}; have {sorted(SCHEDULERS)}") from None
+    return cls(**kwargs)

@@ -1,0 +1,85 @@
+"""Disk-backed model store.
+
+Persistence role of the reference's ``RedisModelStore``
+(reference metisfl/controller/store/redis_model_store.cc:1-307) without an
+external service: each model is one blob file under
+``<root>/<learner_id>/<seq>.blob``, so controller restarts can recover the
+latest lineage (the reference's Redis store persisted models but lost its
+lineage bookkeeping on restart — SURVEY.md §5.4; here the sequence numbers
+ARE the bookkeeping).
+
+Values must be serializable pytrees (stored via :func:`pack_model`) or raw
+``bytes`` (stored verbatim — e.g. encrypted blobs).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, List
+
+from metisfl_tpu.store.base import EvictionPolicy, ModelStore
+from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+_BLOB_RE = re.compile(r"^(\d+)\.blob$")
+_SAFE_ID = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+class DiskModelStore(ModelStore):
+    def __init__(self, root: str, policy: EvictionPolicy = EvictionPolicy.LINEAGE_LENGTH,
+                 lineage_length: int = 1):
+        super().__init__(policy, lineage_length)
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, learner_id: str) -> str:
+        return os.path.join(self.root, _SAFE_ID.sub("_", learner_id))
+
+    def _seqs(self, learner_id: str) -> List[int]:
+        path = self._dir(learner_id)
+        if not os.path.isdir(path):
+            return []
+        seqs = []
+        for name in os.listdir(path):
+            match = _BLOB_RE.match(name)
+            if match:
+                seqs.append(int(match.group(1)))
+        return sorted(seqs)
+
+    def _append(self, learner_id: str, model: Any) -> None:
+        path = self._dir(learner_id)
+        os.makedirs(path, exist_ok=True)
+        seqs = self._seqs(learner_id)
+        seq = (seqs[-1] + 1) if seqs else 0
+        data = model if isinstance(model, (bytes, bytearray)) else pack_model(model)
+        tmp = os.path.join(path, f".{seq}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(path, f"{seq}.blob"))
+
+    def _lineage(self, learner_id: str) -> List[Any]:
+        path = self._dir(learner_id)
+        out = []
+        for seq in reversed(self._seqs(learner_id)):
+            with open(os.path.join(path, f"{seq}.blob"), "rb") as f:
+                data = f.read()
+            blob = ModelBlob.from_bytes(data)
+            if blob.opaque and not blob.tensors:
+                out.append(data)  # encrypted blob: hand back raw bytes
+            else:
+                out.append({name: arr for name, arr in blob.tensors})
+        return out
+
+    def _erase(self, learner_id: str) -> None:
+        shutil.rmtree(self._dir(learner_id), ignore_errors=True)
+
+    def _evict(self, learner_id: str) -> None:
+        seqs = self._seqs(learner_id)
+        excess = len(seqs) - self.lineage_length
+        for seq in seqs[:excess]:
+            os.unlink(os.path.join(self._dir(learner_id), f"{seq}.blob"))
+
+    def _learner_ids(self) -> List[str]:
+        return [d for d in os.listdir(self.root)
+                if os.path.isdir(os.path.join(self.root, d))]

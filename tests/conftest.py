@@ -14,3 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# Under pytest, plugins may import jax before this conftest runs, so the env
+# var alone is not reliable — set the config directly too.
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

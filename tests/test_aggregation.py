@@ -1,0 +1,119 @@
+"""Aggregation-rule tests, modeled on the reference's fixture style
+(federated_average_test.cc, federated_stride_test.cc, federated_recency_test.cc):
+small hand-computed models across dtypes, incremental sequences for the
+rolling rules.
+"""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.aggregation import FedAvg, FedRec, FedStride, make_aggregation_rule
+
+
+def model(values, dtype=np.float32):
+    return {"layer": {"w": np.asarray(values, dtype=dtype)}}
+
+
+def weights(m):
+    return np.asarray(m["layer"]["w"])
+
+
+def test_fedavg_equal_weights_identical_models():
+    m = model(range(1, 11))
+    out = FedAvg().aggregate([([m], 0.5), ([m], 0.5)])
+    np.testing.assert_allclose(weights(out), np.arange(1, 11), rtol=1e-6)
+
+
+def test_fedavg_two_models_hand_computed():
+    m1, m2 = model(range(1, 11)), model(range(11, 21))
+    out = FedAvg().aggregate([([m1], 0.5), ([m2], 0.5)])
+    np.testing.assert_allclose(weights(out), np.arange(6, 16), rtol=1e-6)
+
+
+def test_fedavg_unnormalized_scales():
+    m1, m2 = model([2.0, 4.0]), model([4.0, 8.0])
+    out = FedAvg().aggregate([([m1], 1.0), ([m2], 3.0)])
+    np.testing.assert_allclose(weights(out), [3.5, 7.0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [np.uint16, np.int32, np.int8, np.float64,
+                                   np.float16])
+def test_fedavg_dtype_preserved(dtype):
+    m1, m2 = model([1, 2, 3, 4], dtype), model([3, 4, 5, 6], dtype)
+    out = FedAvg().aggregate([([m1], 0.5), ([m2], 0.5)])
+    assert weights(out).dtype == dtype
+    np.testing.assert_allclose(np.asarray(weights(out), np.float64),
+                               [2, 3, 4, 5], atol=0.01)
+
+
+def test_fedavg_bfloat16():
+    import ml_dtypes
+    m1 = model([1.0, 2.0], ml_dtypes.bfloat16)
+    m2 = model([3.0, 4.0], ml_dtypes.bfloat16)
+    out = FedAvg().aggregate([([m1], 0.5), ([m2], 0.5)])
+    assert weights(out).dtype == ml_dtypes.bfloat16
+    np.testing.assert_allclose(weights(out).astype(np.float32), [2.0, 3.0])
+
+
+def test_fedavg_empty_raises():
+    with pytest.raises(ValueError):
+        FedAvg().aggregate([])
+
+
+def test_fedstride_blocked_equals_fedavg():
+    models = [model(np.random.default_rng(i).standard_normal(8)) for i in range(3)]
+    pairs = [([m], 1 / 3) for m in models]
+    expected = FedAvg().aggregate(pairs)
+
+    rule = FedStride()
+    rule.aggregate(pairs[:2], learner_ids=["L0", "L1"])       # first stride block
+    out = rule.aggregate(pairs[2:], learner_ids=["L2"])       # second block
+    np.testing.assert_allclose(weights(out), weights(expected), rtol=1e-5)
+
+
+def test_fedstride_reset_between_rounds():
+    rule = FedStride()
+    rule.aggregate([([model([10.0])], 1.0)], learner_ids=["L0"])
+    rule.reset()
+    out = rule.aggregate([([model([2.0])], 1.0)], learner_ids=["L0"])
+    np.testing.assert_allclose(weights(out), [2.0])
+
+
+def test_fedrec_replaces_previous_contribution():
+    m1, m2, m3 = model([2.0, 2.0]), model([4.0, 4.0]), model([8.0, 8.0])
+    rule = FedRec()
+    out = rule.aggregate([([m1], 0.5)], learner_ids=["L1"])
+    np.testing.assert_allclose(weights(out), [2.0, 2.0])      # only L1 so far
+    out = rule.aggregate([([m2], 0.5)], learner_ids=["L2"])
+    np.testing.assert_allclose(weights(out), [3.0, 3.0])      # avg(m1, m2)
+    out = rule.aggregate([([m3], 0.5)], learner_ids=["L1"])   # L1's new model wins
+    np.testing.assert_allclose(weights(out), [6.0, 6.0])      # avg(m3, m2)
+
+
+def test_fedrec_scale_change_on_resubmit():
+    rule = FedRec()
+    rule.aggregate([([model([1.0])], 0.25)], learner_ids=["L1"])
+    rule.aggregate([([model([3.0])], 0.75)], learner_ids=["L2"])
+    # L1 resubmits with a different scale; old 0.25 contribution fully retired.
+    out = rule.aggregate([([model([5.0])], 0.25)], learner_ids=["L1"])
+    np.testing.assert_allclose(weights(out), [(0.25 * 5 + 0.75 * 3) / 1.0])
+
+
+def test_fedrec_required_lineage():
+    assert FedRec().required_lineage == 2
+    assert FedAvg().required_lineage == 1
+
+
+def test_make_aggregation_rule():
+    assert isinstance(make_aggregation_rule("fedavg"), FedAvg)
+    with pytest.raises(ValueError):
+        make_aggregation_rule("nope")
+
+
+def test_multi_tensor_tree_aggregation():
+    m1 = {"a": np.ones((2, 2), np.float32), "b": {"c": np.full(3, 2.0, np.float64)}}
+    m2 = {"a": np.full((2, 2), 3.0, np.float32), "b": {"c": np.full(3, 6.0, np.float64)}}
+    out = FedAvg().aggregate([([m1], 0.5), ([m2], 0.5)])
+    np.testing.assert_allclose(out["a"], np.full((2, 2), 2.0))
+    np.testing.assert_allclose(out["b"]["c"], np.full(3, 4.0))
+    assert np.asarray(out["b"]["c"]).dtype == np.float64

@@ -1,0 +1,141 @@
+"""End-to-end in-process federations: the 'minimum slice' milestone test
+(SURVEY.md §7 step 4) — real training, real aggregation, sync + async."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    TerminationConfig,
+)
+from metisfl_tpu.driver import InProcessFederation
+from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+from metisfl_tpu.models.zoo import MLP
+
+
+def _shards(num_learners, n_per=60, d=6, classes=3, seed=7):
+    """Non-identical shards of one underlying task (IID partition)."""
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((d, classes)).astype(np.float32)
+    shards = []
+    for i in range(num_learners):
+        x = rng.standard_normal((n_per, d)).astype(np.float32)
+        y = np.argmax(x @ w, axis=-1).astype(np.int32)
+        shards.append(ArrayDataset(x, y, seed=i))
+    x = rng.standard_normal((120, d)).astype(np.float32)
+    y = np.argmax(x @ w, axis=-1).astype(np.int32)
+    return shards, ArrayDataset(x, y)
+
+
+def _make_federation(protocol="synchronous", rule="fedavg", num_learners=3,
+                     local_steps=4, stride=0, **cfg_kwargs):
+    config = FederationConfig(
+        protocol=protocol,
+        aggregation=AggregationConfig(rule=rule, scaler="participants",
+                                      stride_length=stride),
+        train=TrainParams(batch_size=16, local_steps=local_steps,
+                          learning_rate=0.1),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=3),
+        **cfg_kwargs,
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(num_learners)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3), shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)  # all learners start identical
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    return fed, test
+
+
+def test_sync_fedavg_three_learners():
+    fed, test = _make_federation()
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        stats = fed.statistics()
+        assert stats["global_iteration"] >= 2
+        assert len(stats["learners"]) == 3
+        # round metadata lineage recorded
+        meta = stats["round_metadata"][0]
+        assert meta["selected_learners"]
+        assert meta["aggregation_duration_ms"] > 0
+        assert meta["model_size"]["values"] > 0
+        assert len(meta["train_received_at"]) == 3
+        # community model evaluations flow back asynchronously
+        assert fed.wait_for_evaluations(1, timeout_s=120)
+    finally:
+        fed.shutdown()
+
+
+def test_sync_federation_learns():
+    fed, test = _make_federation(local_steps=8)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=180)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        first = np.mean([v["test"]["accuracy"]
+                         for v in evals[0]["evaluations"].values()])
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last >= first  # federation should not get worse on this task
+        assert last > 0.5     # and should actually learn it
+    finally:
+        fed.shutdown()
+
+
+def test_async_fedrec_federation():
+    fed, _ = _make_federation(protocol="asynchronous", rule="fedrec")
+    try:
+        fed.start()
+        # async: every completion triggers an aggregation + reschedule
+        assert fed.wait_for_rounds(4, timeout_s=120)
+        assert fed.statistics()["global_iteration"] >= 4
+    finally:
+        fed.shutdown()
+
+
+def test_fedstride_with_stride_blocks():
+    fed, _ = _make_federation(rule="fedstride", stride=2)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        meta = fed.statistics()["round_metadata"][0]
+        assert meta["aggregation_block_sizes"] == [2, 1]
+    finally:
+        fed.shutdown()
+
+
+def test_semisync_recomputes_budgets():
+    fed, _ = _make_federation(protocol="semi_synchronous",
+                              semi_sync_lambda=1.0)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        overrides = [r.local_steps_override
+                     for r in fed.controller._learners.values()]
+        assert any(o > 0 for o in overrides)
+    finally:
+        fed.shutdown()
+
+
+def test_learner_leave_midrun():
+    fed, _ = _make_federation(num_learners=3)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=120)
+        assert fed.learners[2].leave_federation()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        assert len(fed.statistics()["learners"]) == 2
+    finally:
+        fed.shutdown()

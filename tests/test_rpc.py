@@ -1,0 +1,85 @@
+"""gRPC bytes-transport unit tests."""
+
+import threading
+
+import pytest
+
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
+
+
+@pytest.fixture()
+def echo_server():
+    state = {"count": 0}
+
+    def echo(payload: bytes) -> bytes:
+        state["count"] += 1
+        return payload
+
+    def boom(payload: bytes) -> bytes:
+        raise RuntimeError("kaboom")
+
+    server = RpcServer("127.0.0.1", 0)
+    server.add_service(BytesService("test.Echo", {"Echo": echo, "Boom": boom}))
+    port = server.start()
+    yield port, state
+    server.stop()
+
+
+def test_unary_roundtrip(echo_server):
+    port, state = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    payload = dumps({"x": 1, "blob": b"\x00" * 1000})
+    assert loads(client.call("Echo", payload)) == loads(payload)
+    assert state["count"] == 1
+    client.close()
+
+
+def test_async_call(echo_server):
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    done = threading.Event()
+    result = {}
+
+    def cb(raw):
+        result["raw"] = raw
+        done.set()
+
+    client.call_async("Echo", b"hello", callback=cb)
+    assert done.wait(10)
+    assert result["raw"] == b"hello"
+    client.close()
+
+
+def test_handler_error_propagates(echo_server):
+    import grpc
+
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    with pytest.raises(grpc.RpcError) as err:
+        client.call("Boom", b"")
+    assert err.value.code() == grpc.StatusCode.INTERNAL
+    assert "kaboom" in err.value.details()
+    client.close()
+
+
+def test_async_error_callback(echo_server):
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    done = threading.Event()
+    errors = []
+
+    client.call_async("Boom", b"", callback=lambda r: done.set(),
+                      error_callback=lambda e: (errors.append(e), done.set()))
+    assert done.wait(10)
+    assert errors
+    client.close()
+
+
+def test_large_payload(echo_server):
+    # >4MB default gRPC limit must pass (unlimited message size option)
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    payload = b"\xab" * (8 * 1024 * 1024)
+    assert client.call("Echo", payload) == payload
+    client.close()
