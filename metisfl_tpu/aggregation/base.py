@@ -31,24 +31,22 @@ def _acc_dtype(dtype) -> jnp.dtype:
     return jnp.float32
 
 
-_WIDE = (np.float64, np.int64, np.uint64)
+_WIDE = tuple(np.dtype(d) for d in (np.float64, np.int64, np.uint64))
 
 
-def ensure_x64_for(tree) -> None:
-    """Enable jax x64 if the model carries 64-bit tensors.
+def use_numpy_fold(tree) -> bool:
+    """True when the tree carries 64-bit tensors but jax x64 is disabled.
 
-    TPU compute never wants f64, but the *aggregation contract* is
-    dtype-preserving (the reference aggregates all 10 wire dtypes —
-    federated_average_test.cc); silently truncating a learner's f64 weights
-    would corrupt the federation. Flipping the flag is safe here: the
-    controller owns its process and compiled functions are keyed by dtype.
-    """
+    The aggregation contract is dtype-preserving (the reference aggregates
+    all 10 wire dtypes — federated_average_test.cc); jit kernels would
+    silently truncate f64 under the default x32 mode, and flipping the
+    process-global ``jax_enable_x64`` flag mid-run can change the semantics
+    of every other compiled function in the controller process. Instead,
+    wide trees fold on host numpy (they are a rare cross-silo compatibility
+    case, not the TPU hot path)."""
     if jax.config.jax_enable_x64:
-        return
-    for leaf in jax.tree.leaves(tree):
-        if any(np.dtype(leaf.dtype) == w for w in _WIDE):
-            jax.config.update("jax_enable_x64", True)
-            return
+        return False
+    return any(np.dtype(leaf.dtype) in _WIDE for leaf in jax.tree.leaves(tree))
 
 
 @jax.jit
@@ -75,10 +73,13 @@ def scaled_sub(acc: Pytree, model: Pytree, scale) -> Pytree:
     )
 
 
-def finalize(acc: Pytree, z, like: Pytree) -> Pytree:
-    """community = acc / z, cast back to the storage dtypes of ``like``."""
+def finalize(acc: Pytree, z, like: Optional[Pytree] = None,
+             dtypes: Optional[Tuple[str, ...]] = None) -> Pytree:
+    """community = acc / z, cast back to storage dtypes (from ``like`` or an
+    explicit ``dtypes`` tuple in leaf order)."""
     acc_leaves, treedef = jax.tree.flatten(acc)
-    dtypes = tuple(str(x.dtype) for x in jax.tree.leaves(like))
+    if dtypes is None:
+        dtypes = tuple(str(x.dtype) for x in jax.tree.leaves(like))
     out_leaves = _finalize_flat(tuple(acc_leaves), z, dtypes)
     return jax.tree.unflatten(treedef, out_leaves)
 
@@ -94,6 +95,42 @@ def _finalize_flat(acc_leaves, z, dtypes):
     return tuple(out)
 
 
+# -- host-numpy fold (64-bit trees under x32 mode; see use_numpy_fold) -------
+
+def _np_acc_dtype(dtype) -> np.dtype:
+    return np.dtype(np.float64 if np.dtype(dtype) in _WIDE else np.float32)
+
+
+def np_scaled_init(model: Pytree, scale) -> Pytree:
+    return jax.tree.map(
+        lambda x: np.asarray(x, _np_acc_dtype(np.asarray(x).dtype)) * scale,
+        model)
+
+
+def np_scaled_add(acc: Pytree, model: Pytree, scale) -> Pytree:
+    return jax.tree.map(lambda a, x: a + np.asarray(x, a.dtype) * scale,
+                        acc, model)
+
+
+def np_scaled_sub(acc: Pytree, model: Pytree, scale) -> Pytree:
+    return jax.tree.map(lambda a, x: a - np.asarray(x, a.dtype) * scale,
+                        acc, model)
+
+
+def np_finalize(acc: Pytree, z, like: Optional[Pytree] = None,
+                dtypes: Optional[Tuple[str, ...]] = None) -> Pytree:
+    leaves, treedef = jax.tree.flatten(acc)
+    if dtypes is None:
+        dtypes = tuple(str(np.asarray(x).dtype) for x in jax.tree.leaves(like))
+    out = []
+    for a, dtype in zip(leaves, dtypes):
+        value = a / z
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            value = np.rint(value)
+        out.append(np.asarray(value).astype(dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
 class AggState:
     """Mutable rolling-aggregation state kept across calls.
 
@@ -105,12 +142,15 @@ class AggState:
     def __init__(self):
         self.wc_scaled: Optional[Pytree] = None
         self.z: float = 0.0
+        # whether this state folds on host numpy (wide dtypes under x32)
+        self.use_numpy: bool = False
         # learner_id -> (scale, model) of the latest counted contribution
         self.contributions: Dict[str, Tuple[float, Pytree]] = {}
 
     def reset(self) -> None:
         self.wc_scaled = None
         self.z = 0.0
+        self.use_numpy = False
         self.contributions.clear()
 
 

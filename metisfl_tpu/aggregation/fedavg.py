@@ -3,23 +3,29 @@
 Equivalent of the reference's ``FederatedAverage`` (reference
 metisfl/controller/aggregation/federated_average.cc:70-150): community =
 Σ scaleᵢ · modelᵢ, computed here as a fold of one jit-compiled scaled-add
-over pytrees. ``stride`` bounds how many models the caller materializes at
-once (the controller feeds models block-wise from the store, mirroring the
-stride-blocked loop in controller.cc:842-936); the math is identical for any
-stride because addition is associative.
+over pytrees. The fold API (``accumulate``/``result``) lets the controller
+feed models block-by-block from the store so only one stride block is ever
+resident — bounded memory for huge federations, the point of the reference's
+stride loop (controller.cc:842-936). The math is identical for any blocking
+because addition is associative.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
+import jax
+
 from metisfl_tpu.aggregation.base import (
     AggState,
     Pytree,
-    ensure_x64_for,
     finalize,
+    np_finalize,
+    np_scaled_add,
+    np_scaled_init,
     scaled_add,
     scaled_init,
+    use_numpy_fold,
 )
 
 
@@ -27,29 +33,58 @@ class FedAvg:
     name = "fedavg"
     required_lineage = 1
 
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._acc: Optional[Pytree] = None
+        self._total: float = 0.0
+        self._dtypes: Optional[Tuple[str, ...]] = None
+        self._np: bool = False
+
+    def accumulate(
+        self, models: Sequence[Tuple[Sequence[Pytree], float]]
+    ) -> None:
+        """Fold one block of ``(lineage, scale)`` pairs into the running sum.
+
+        Only the accumulator stays resident between calls — callers can
+        stream blocks of any size.
+        """
+        for lineage, scale in models:
+            model = lineage[0]
+            if self._dtypes is None:
+                self._np = use_numpy_fold(model)
+                self._dtypes = tuple(
+                    str(x.dtype) for x in jax.tree.leaves(model))
+            init = np_scaled_init if self._np else scaled_init
+            add = np_scaled_add if self._np else scaled_add
+            if self._acc is None:
+                self._acc = init(model, scale)
+            else:
+                self._acc = add(self._acc, model, scale)
+            self._total += float(scale)
+
+    def result(self) -> Pytree:
+        """Normalize the running sum → community model (storage dtypes).
+
+        Scales from the standard scalers sum to 1; normalize anyway so the
+        rule is correct for unnormalized weights.
+        """
+        if self._acc is None:
+            raise ValueError("FedAvg.result called before any accumulate")
+        fin = np_finalize if self._np else finalize
+        return fin(self._acc, self._total, dtypes=self._dtypes)
+
     def aggregate(
         self,
         models: Sequence[Tuple[Sequence[Pytree], float]],
         state: Optional[AggState] = None,
     ) -> Pytree:
+        """One-shot aggregation (equivalent to accumulate-all + result)."""
         if not models:
             raise ValueError("FedAvg.aggregate called with no models")
-        ensure_x64_for(models[0][0][0])
-        acc = None
-        total = 0.0
-        template = None
-        for lineage, scale in models:
-            model = lineage[0]
-            if template is None:
-                template = model
-            if acc is None:
-                acc = scaled_init(model, scale)
-            else:
-                acc = scaled_add(acc, model, scale)
-            total += float(scale)
-        # Scales from the standard scalers sum to 1; normalize anyway so the
-        # rule is correct for unnormalized weights.
-        return finalize(acc, total, template)
-
-    def reset(self) -> None:  # stateless
-        pass
+        self.reset()
+        self.accumulate(models)
+        out = self.result()
+        self.reset()
+        return out

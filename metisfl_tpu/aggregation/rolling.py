@@ -24,11 +24,15 @@ from typing import Optional, Sequence, Tuple
 from metisfl_tpu.aggregation.base import (
     AggState,
     Pytree,
-    ensure_x64_for,
     finalize,
+    np_finalize,
+    np_scaled_add,
+    np_scaled_init,
+    np_scaled_sub,
     scaled_add,
     scaled_init,
     scaled_sub,
+    use_numpy_fold,
 )
 
 
@@ -40,15 +44,18 @@ class _RollingBase:
         self._state.reset()
 
     def _community(self, template: Pytree) -> Pytree:
-        return finalize(self._state.wc_scaled, self._state.z, template)
+        fin = np_finalize if self._state.use_numpy else finalize
+        return fin(self._state.wc_scaled, self._state.z, template)
 
     def _add(self, learner_id: str, model: Pytree, scale: float) -> None:
         state = self._state
-        ensure_x64_for(model)
         if state.wc_scaled is None:
-            state.wc_scaled = scaled_init(model, scale)
+            state.use_numpy = use_numpy_fold(model)
+            init = np_scaled_init if state.use_numpy else scaled_init
+            state.wc_scaled = init(model, scale)
         else:
-            state.wc_scaled = scaled_add(state.wc_scaled, model, scale)
+            add = np_scaled_add if state.use_numpy else scaled_add
+            state.wc_scaled = add(state.wc_scaled, model, scale)
         state.z += float(scale)
         state.contributions[learner_id] = (float(scale), model)
 
@@ -57,7 +64,8 @@ class _RollingBase:
         prev = state.contributions.pop(learner_id, None)
         if prev is not None and state.wc_scaled is not None:
             old_scale, old_model = prev
-            state.wc_scaled = scaled_sub(state.wc_scaled, old_model, old_scale)
+            sub = np_scaled_sub if state.use_numpy else scaled_sub
+            state.wc_scaled = sub(state.wc_scaled, old_model, old_scale)
             state.z -= old_scale
 
 

@@ -137,6 +137,9 @@ class TrainTask(Message):
 class TaskResult(Message):
     task_id: str = ""
     learner_id: str = ""
+    # Composite-key auth: the controller validates (learner_id, auth_token)
+    # before accepting a model (reference controller.proto:146-148).
+    auth_token: str = ""
     round_id: int = 0
     model: bytes = b""          # locally trained ModelBlob
     num_train_examples: int = 0

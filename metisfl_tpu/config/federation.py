@@ -94,6 +94,13 @@ class FederationConfig:
             )
         if self.aggregation.rule == "secure_agg" and not self.secure.enabled:
             raise ValueError("aggregation.rule 'secure_agg' requires secure.enabled")
+        if (self.secure.enabled and self.secure.scheme == "masking"
+                and self.aggregation.scaler != "participants"):
+            # MaskingBackend.weighted_sum rejects non-uniform scales at
+            # aggregation time; fail at startup instead of stalling round 1.
+            raise ValueError(
+                "masking secure aggregation requires the 'participants' "
+                "scaler (pairwise masks only cancel under uniform scales)")
         if self.protocol not in ("synchronous", "semi_synchronous", "asynchronous"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:

@@ -225,6 +225,9 @@ class Controller:
             del self._learners[learner_id]
         self._store.erase([learner_id])
         logger.info("learner %s left", learner_id)
+        # Re-evaluate the round barrier: if the departed learner was the last
+        # pending one, no completion event would ever release the round.
+        self._pool.submit(self._guard, self._handle_membership_change)
         return True
 
     def active_learners(self) -> List[str]:
@@ -262,6 +265,14 @@ class Controller:
             record = self._learners.get(result.learner_id)
             if record is None:
                 logger.warning("completion from unknown learner %s",
+                               result.learner_id)
+                return False
+            # Validate the (learner_id, auth_token) composite key before
+            # accepting a model (the reference's ValidateLearner on
+            # MarkTaskCompleted, controller.cc:205, controller.proto:146-148)
+            # — without it any client could poison the community model.
+            if record.auth_token != result.auth_token:
+                logger.warning("completion from %s with bad auth token",
                                result.learner_id)
                 return False
         self._pool.submit(self._guard, self._handle_completed, result)
@@ -310,6 +321,22 @@ class Controller:
             return
         self._complete_round(to_schedule)
 
+    def _handle_membership_change(self) -> None:
+        active = self.active_learners()
+        if not active or self._shutdown.is_set():
+            return
+        cohort = self._scheduler.handle_leave(active)
+        if cohort:
+            self._complete_round(cohort)
+            return
+        if self._scheduler.round_stalled(active):
+            # every dispatched learner departed before the round could
+            # complete: abandon it and dispatch a fresh sample so the
+            # surviving learners keep making progress
+            logger.info("round abandoned (dispatched cohort left); re-dispatching")
+            self._scheduler.reset()
+            self._dispatch_train(self._sample_cohort())
+
     def _parse_result_model(self, result: TaskResult):
         blob = ModelBlob.from_bytes(result.model)
         if self.config.secure.enabled:
@@ -331,14 +358,23 @@ class Controller:
             self._current_meta = RoundMetadata(
                 global_iteration=self.global_iteration)
         self._maybe_recompute_semisync()
-        if not self._shutdown.is_set():
-            self._dispatch_train(self._sample_cohort(cohort))
+        if self._shutdown.is_set():
+            return
+        if self._scheduler.name == "asynchronous":
+            # async: re-dispatch only the reporting learner(s)
+            active = self.active_learners()
+            next_ids = [lid for lid in cohort if lid in active]
+        else:
+            next_ids = self._sample_cohort()
+        self._dispatch_train(next_ids)
 
-    def _sample_cohort(self, cohort: Sequence[str]) -> List[str]:
+    def _sample_cohort(self) -> List[str]:
+        """Sample next round's participants from all active learners
+        (ControllerParams.participation_ratio). The scheduler barriers on the
+        dispatched sample, so ratio < 1 cannot stall a synchronous round."""
         ratio = self.config.aggregation.participation_ratio
-        active = self.active_learners()
-        pool = [lid for lid in cohort if lid in active] or active
-        if ratio >= 1.0:
+        pool = self.active_learners()
+        if ratio >= 1.0 or not pool:
             return pool
         k = max(1, int(round(ratio * len(pool))))
         return random.sample(pool, k)
@@ -372,19 +408,20 @@ class Controller:
         lineage_k = self._aggregator.required_lineage
         stride = self.config.aggregation.stride_length or len(selected) or 1
         scales = self._scaler(self._scaling_metadata(selected))
-        if hasattr(self._aggregator, "reset") and self._aggregator.name != "fedrec":
+        # FedStride state resets between rounds (federated_stride.cc:52-68);
+        # FedRec carries state across rounds; FedAvg resets in its own branch.
+        if self._aggregator.name == "fedstride":
             self._aggregator.reset()
 
         community = None
         meta_blocks: List[int] = []
         meta_durations: List[float] = []
         ids = [lid for lid in selected if lid in scales]
-        if self.config.secure.enabled or self._aggregator.name == "fedavg":
-            # FedAvg / secure: one pass over blocks, associative accumulation
-            # happens inside the rule via repeated calls (fedavg recomputes
-            # from scratch, so feed all blocks' models in one call but select
-            # from the store block-wise to bound resident memory).
-            pairs, id_order = [], []
+        if self.config.secure.enabled:
+            # Secure: every party's payload must enter one combine call
+            # (masking sums must cancel across ALL parties), so blocks only
+            # bound store-select batching here.
+            pairs = []
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 tb = time.time()
@@ -392,14 +429,33 @@ class Controller:
                 for lid in block:
                     if lid in picked:
                         pairs.append((picked[lid], scales[lid]))
-                        id_order.append(lid)
                 meta_blocks.append(len(block))
                 meta_durations.append((time.time() - tb) * 1e3)
             if not pairs:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
-            pairs = self._parse_secure(pairs) if self.config.secure.enabled else pairs
-            community = self._aggregator.aggregate(pairs)
+            community = self._aggregator.aggregate(self._parse_secure(pairs))
+        elif self._aggregator.name == "fedavg":
+            # FedAvg is a fold: accumulate block-by-block so only one stride
+            # block of models is ever resident (the point of the reference's
+            # stride loop, controller.cc:842-936).
+            self._aggregator.reset()
+            accumulated = 0
+            for i in range(0, len(ids), stride):
+                block = ids[i : i + stride]
+                tb = time.time()
+                picked = self._store.select(block, k=lineage_k)
+                pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
+                if pairs:
+                    self._aggregator.accumulate(pairs)
+                    accumulated += len(pairs)
+                meta_blocks.append(len(block))
+                meta_durations.append((time.time() - tb) * 1e3)
+            if not accumulated:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+            community = self._aggregator.result()
+            self._aggregator.reset()
         else:
             # rolling rules (fedstride / fedrec): incremental block updates
             for i in range(0, len(ids), stride):
@@ -476,6 +532,9 @@ class Controller:
         if blob is None:
             logger.warning("no community model yet; cannot dispatch train tasks")
             return
+        # The dispatched set is the synchronous round barrier (participation
+        # sampling means it can be a strict subset of the active learners).
+        self._scheduler.notify_dispatched(list(learner_ids))
         for lid in learner_ids:
             with self._lock:
                 record = self._learners.get(lid)

@@ -176,6 +176,7 @@ class Learner:
             result = TaskResult(
                 task_id=task.task_id,
                 learner_id=self.learner_id,
+                auth_token=self.auth_token,
                 round_id=task.round_id,
                 model=self._dump_model(),
                 num_train_examples=len(self.datasets["train"]),

@@ -14,25 +14,68 @@ from typing import Dict, List, Sequence, Set
 
 
 class SynchronousScheduler:
-    """Release the full cohort only when every active learner has reported."""
+    """Release the round cohort only when every dispatched learner reports.
+
+    The barrier is the set of learners the controller actually dispatched
+    train tasks to this round (``notify_dispatched``) — not all active
+    learners — so participation_ratio < 1 cannot deadlock a round on
+    learners that were never asked to train. When no dispatch was recorded
+    (e.g. the policy object is driven directly in tests) the barrier falls
+    back to all active learners, matching the reference's semantics
+    (synchronous_scheduler.h:13-40).
+    """
 
     name = "synchronous"
 
     def __init__(self):
         self._completed: Set[str] = set()
+        self._dispatched: Set[str] = set()
+
+    def notify_dispatched(self, learner_ids: Sequence[str]) -> None:
+        self._dispatched.update(learner_ids)
+
+    def _barrier(self, active: Sequence[str]) -> List[str]:
+        # Only count learners that are still active (a learner leaving
+        # mid-round must not stall the federation forever).
+        if self._dispatched:
+            return [lid for lid in active if lid in self._dispatched]
+        return list(active)
+
+    def _release(self, active: Sequence[str]) -> List[str]:
+        cohort = [lid for lid in self._barrier(active) if lid in self._completed]
+        self._completed.clear()
+        self._dispatched.clear()
+        return cohort
 
     def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
         self._completed.add(learner_id)
-        # Only count learners that are still active (a learner leaving
-        # mid-round must not stall the federation forever).
-        pending = [lid for lid in active if lid not in self._completed]
-        if pending:
+        if any(lid not in self._completed for lid in self._barrier(active)):
             return []
-        self._completed.clear()
-        return list(active)
+        return self._release(active)
+
+    def handle_leave(self, active: Sequence[str]) -> List[str]:
+        """Re-evaluate the barrier after membership shrinks: if the departed
+        learner was the last pending one, release the round now (no later
+        completion event would ever re-check)."""
+        if not self._completed:
+            return []
+        barrier = self._barrier(active)
+        # An empty barrier means every dispatched learner left — nothing to
+        # aggregate; keep state so round_stalled() reports it for re-dispatch.
+        if not barrier or any(lid not in self._completed for lid in barrier):
+            return []
+        return self._release(active)
+
+    def round_stalled(self, active: Sequence[str]) -> bool:
+        """True when a dispatched round can never complete because no
+        dispatched learner is still active — the caller should reset and
+        dispatch a fresh round to the surviving learners."""
+        return bool(self._dispatched) and not any(
+            lid in active for lid in self._dispatched)
 
     def reset(self) -> None:
         self._completed.clear()
+        self._dispatched.clear()
 
 
 class AsynchronousScheduler:
@@ -40,8 +83,17 @@ class AsynchronousScheduler:
 
     name = "asynchronous"
 
+    def notify_dispatched(self, learner_ids: Sequence[str]) -> None:
+        pass
+
     def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
         return [learner_id]
+
+    def handle_leave(self, active: Sequence[str]) -> List[str]:
+        return []
+
+    def round_stalled(self, active: Sequence[str]) -> bool:
+        return False
 
     def reset(self) -> None:
         pass

@@ -77,6 +77,8 @@ class DiskModelStore(ModelStore):
     def _evict(self, learner_id: str) -> None:
         seqs = self._seqs(learner_id)
         excess = len(seqs) - self.lineage_length
+        if excess <= 0:
+            return
         for seq in seqs[:excess]:
             os.unlink(os.path.join(self._dir(learner_id), f"{seq}.blob"))
 

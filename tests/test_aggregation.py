@@ -60,6 +60,50 @@ def test_fedavg_empty_raises():
         FedAvg().aggregate([])
 
 
+def test_fedavg_blockwise_fold_equals_one_shot():
+    # the controller streams stride blocks through accumulate(); the result
+    # must be identical to a single aggregate() over everything
+    models = [model(np.random.default_rng(i).standard_normal(16))
+              for i in range(5)]
+    scales = [0.1, 0.3, 0.2, 0.25, 0.15]
+    pairs = [([m], s) for m, s in zip(models, scales)]
+    expected = FedAvg().aggregate(pairs)
+
+    rule = FedAvg()
+    rule.reset()
+    rule.accumulate(pairs[:2])
+    rule.accumulate(pairs[2:4])
+    rule.accumulate(pairs[4:])
+    out = rule.result()
+    np.testing.assert_allclose(weights(out), weights(expected), rtol=1e-6)
+
+
+def test_fedavg_result_before_accumulate_raises():
+    rule = FedAvg()
+    with pytest.raises(ValueError):
+        rule.result()
+
+
+def test_numpy_fold_kernels_match_jit():
+    # the host-numpy fold (used for 64-bit trees under x32 mode) must agree
+    # with the jit kernels
+    from metisfl_tpu.aggregation import base
+    m1 = {"w": np.asarray([1.0, 2.0], np.float64),
+          "n": np.asarray([10, 20], np.int64)}
+    m2 = {"w": np.asarray([3.0, 6.0], np.float64),
+          "n": np.asarray([30, 40], np.int64)}
+    acc = base.np_scaled_init(m1, 0.5)
+    acc = base.np_scaled_add(acc, m2, 0.5)
+    out = base.np_finalize(acc, 1.0, like=m1)
+    np.testing.assert_allclose(out["w"], [2.0, 4.0])
+    np.testing.assert_array_equal(out["n"], [20, 30])
+    assert out["w"].dtype == np.float64 and out["n"].dtype == np.int64
+    # subtraction retires a contribution exactly
+    acc2 = base.np_scaled_sub(acc, m2, 0.5)
+    out2 = base.np_finalize(acc2, 0.5, like=m1)
+    np.testing.assert_allclose(out2["w"], [1.0, 2.0])
+
+
 def test_fedstride_blocked_equals_fedavg():
     models = [model(np.random.default_rng(i).standard_normal(8)) for i in range(3)]
     pairs = [([m], 1 / 3) for m in models]

@@ -129,6 +129,51 @@ def test_semisync_recomputes_budgets():
         fed.shutdown()
 
 
+def test_sync_participation_ratio_completes_rounds():
+    # regression: with ratio < 1 the scheduler must barrier on the sampled
+    # cohort, not all active learners (which would deadlock round 2+)
+    fed, _ = _make_federation(num_learners=4)
+    fed.config.aggregation.participation_ratio = 0.5
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=180)
+        stats = fed.statistics()
+        assert stats["global_iteration"] >= 3
+        # rounds after the first involve only the sampled cohort
+        later = stats["round_metadata"][2]
+        assert len(later["train_received_at"]) <= 2
+    finally:
+        fed.shutdown()
+
+
+def test_completion_with_bad_auth_token_rejected():
+    from metisfl_tpu.comm.messages import TaskResult
+    fed, _ = _make_federation(num_learners=2)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=120)
+        lid = fed.learners[0].learner_id
+        forged = TaskResult(task_id="x", learner_id=lid, auth_token="wrong",
+                            model=b"")
+        assert fed.controller.task_completed(forged) is False
+        genuine = TaskResult(task_id="x", learner_id=lid,
+                             auth_token=fed.learners[0].auth_token, model=b"")
+        # well-formed token is accepted for processing (ack True)
+        assert fed.controller.task_completed(genuine) is True
+    finally:
+        fed.shutdown()
+
+
+def test_masking_requires_participants_scaler():
+    from metisfl_tpu.config import SecureAggConfig
+    with pytest.raises(ValueError):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="train_dataset_size"),
+            secure=SecureAggConfig(enabled=True, scheme="masking"),
+        )
+
+
 def test_learner_leave_midrun():
     fed, _ = _make_federation(num_learners=3)
     try:

@@ -43,6 +43,39 @@ class TestSchedulers:
         s = AsynchronousScheduler()
         assert s.schedule_next("L1", ACTIVE) == ["L1"]
 
+    def test_sync_barriers_on_dispatched_cohort(self):
+        # participation sampling: only the dispatched subset gates the round
+        s = SynchronousScheduler()
+        s.notify_dispatched(["L0", "L2"])
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert sorted(s.schedule_next("L2", ACTIVE)) == ["L0", "L2"]
+        # barrier cleared for the next round
+        s.notify_dispatched(["L1"])
+        assert s.schedule_next("L1", ACTIVE) == ["L1"]
+
+    def test_sync_leave_releases_stalled_round(self):
+        # last pending learner leaves after everyone else reported: the
+        # membership change itself must release the round (no completion
+        # event will ever fire again)
+        s = SynchronousScheduler()
+        s.notify_dispatched(ACTIVE)
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert s.schedule_next("L1", ACTIVE) == []
+        assert s.handle_leave(["L0", "L1"]) == ["L0", "L1"]
+        # and no spurious double-release afterwards
+        assert s.handle_leave(["L0", "L1"]) == []
+
+    def test_sync_whole_cohort_departure_flags_stall(self):
+        # the only dispatched learner leaves before reporting: no completion
+        # event will ever fire, so the round must be reported as stalled for
+        # the controller to abandon and re-dispatch
+        s = SynchronousScheduler()
+        s.notify_dispatched(["L0"])
+        assert s.handle_leave(["L1", "L2"]) == []
+        assert s.round_stalled(["L1", "L2"]) is True
+        s.reset()
+        assert s.round_stalled(["L1", "L2"]) is False
+
     def test_semisync_step_recompute(self):
         s = SemiSynchronousScheduler(lambda_=2.0)
         timings = {
@@ -156,6 +189,18 @@ class TestDiskStore:
         lineage = store.select(["L0"], k=5)["L0"]
         assert len(lineage) == 2
         np.testing.assert_allclose(lineage[0]["w"], 3.0)
+
+    def test_lineage_three_keeps_all_three(self, tmp_path):
+        # regression: a negative eviction excess must not delete models that
+        # are still inside the lineage limit
+        store = DiskModelStore(str(tmp_path / "store"), lineage_length=3)
+        store.insert("L0", _m(1))
+        store.insert("L0", _m(2))
+        assert store.size("L0") == 2
+        store.insert("L0", _m(3))
+        assert store.size("L0") == 3
+        lineage = store.select(["L0"], k=3)["L0"]
+        assert [float(m["w"][0]) for m in lineage] == [3.0, 2.0, 1.0]
 
     def test_survives_reopen(self, tmp_path):
         root = str(tmp_path / "store")
