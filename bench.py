@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Aggregation performance harness.
+
+TPU-native counterpart of the reference's scenario benchmark
+(reference metisfl/controller/scenarios/sync_model_aggregation_performance_main.cc:13-87
++ scenarios_common.cc: N synthetic learners x T tensors x V values, timing the
+aggregation hot loop and RSS) — here the hot loop is the controller's real
+FedAvg path: stride-blocked jit-compiled scaled-add fold over learner model
+pytrees (metisfl_tpu/aggregation/fedavg.py), including host->device transfer.
+
+Headline metric (BASELINE.md north star): federation aggregation wall-clock
+per round at 64 learners, target <= 2000 ms. ``vs_baseline`` is the speedup
+against that target (>1 means beating it).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, "details": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import resource
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MS = 2000.0          # <= 2 s aggregation/round @ 64 learners
+NUM_LEARNERS = 64
+ROUNDS = 5
+STRIDE = 8
+
+# CIFAR-10-CNN-scale synthetic model (~1.64M params), the same workload the
+# reference's anecdote measures (controller.cc:594-604 — 1.6M-param model).
+MODEL_SHAPES = {
+    "conv1/kernel": (3, 3, 3, 32), "conv1/bias": (32,),
+    "conv2/kernel": (3, 3, 32, 64), "conv2/bias": (64,),
+    "conv3/kernel": (3, 3, 64, 128), "conv3/bias": (128,),
+    "dense1/kernel": (2048, 512), "dense1/bias": (512,),
+    "dense2/kernel": (512, 512), "dense2/bias": (512,),
+    "head/kernel": (512, 10), "head/bias": (10,),
+}
+
+
+def synth_models(num_learners: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    models = []
+    for _ in range(num_learners):
+        models.append({name: rng.standard_normal(shape).astype(np.float32)
+                       for name, shape in MODEL_SHAPES.items()})
+    return models
+
+
+def aggregate_once(agg, models, scales, stride: int):
+    """The controller's stride-blocked fold (controller/core.py
+    _compute_community_model): one block resident at a time."""
+    agg.reset()
+    for i in range(0, len(models), stride):
+        block = [( [models[j]], scales[j] ) for j in range(i, min(i + stride, len(models)))]
+        agg.accumulate(block)
+    out = agg.result()
+    agg.reset()
+    return out
+
+
+def bench_aggregation(num_learners: int, rounds: int, stride: int):
+    import jax
+    from metisfl_tpu.aggregation.fedavg import FedAvg
+
+    models = synth_models(num_learners)
+    scales = np.full((num_learners,), 1.0 / num_learners, np.float64)
+    params = sum(int(np.prod(s)) for s in MODEL_SHAPES.values())
+
+    agg = FedAvg()
+    # warm-up (host path needs none, but keeps timings honest)
+    out = aggregate_once(agg, models, scales, stride)
+    jax.block_until_ready(jax.tree.leaves(out))
+
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        out = aggregate_once(agg, models, scales, stride)
+        jax.block_until_ready(jax.tree.leaves(out))
+        times.append((time.perf_counter() - t0) * 1e3)
+
+    # device-resident variant: models already live on the chip (co-located
+    # learner output / pod mode) — the fold runs as fused stacked reduces
+    import jax.numpy as jnp
+    dev_models = jax.block_until_ready(
+        [jax.tree.map(jnp.asarray, m) for m in models])
+    jax.block_until_ready(jax.tree.leaves(
+        aggregate_once(agg, dev_models, scales, stride)))  # compile
+    dev_times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        out_dev = aggregate_once(agg, dev_models, scales, stride)
+        jax.block_until_ready(jax.tree.leaves(out_dev))
+        dev_times.append((time.perf_counter() - t0) * 1e3)
+
+    # correctness guard: community == mean of the synthetic models
+    expect = np.mean([m["head/bias"] for m in models], axis=0)
+    np.testing.assert_allclose(np.asarray(out["head/bias"]), expect, atol=1e-4)
+
+    return {
+        "ms_per_round_median": float(np.median(times)),
+        "ms_per_round_min": float(np.min(times)),
+        "ms_per_round_all": [round(t, 2) for t in times],
+        "ms_per_round_device_resident": float(np.median(dev_times)),
+        "params_per_model": params,
+        "num_learners": num_learners,
+        "stride": stride,
+    }
+
+
+def bench_train_step():
+    """Secondary: learner local-training throughput (samples/sec/chip) on the
+    FashionMNIST CNN — the reference ladder's first rung."""
+    import jax
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.models.dataset import ArrayDataset
+    from metisfl_tpu.models.ops import FlaxModelOps
+    from metisfl_tpu.models.zoo import FashionMnistCNN
+
+    rng = np.random.default_rng(1)
+    batch = 256
+    x = rng.standard_normal((batch * 8, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(batch * 8,))
+    ops = FlaxModelOps(FashionMnistCNN(), x[:2])
+    out = ops.train(ArrayDataset(x, y),
+                    TrainParams(batch_size=batch, local_steps=12,
+                                optimizer="sgd", learning_rate=0.01))
+    if out.ms_per_step <= 0:
+        return {}
+    return {
+        "train_samples_per_sec": batch / (out.ms_per_step / 1e3),
+        "train_ms_per_step": out.ms_per_step,
+        "train_batch_size": batch,
+    }
+
+
+def main():
+    t_start = time.time()
+    import jax
+
+    agg = bench_aggregation(NUM_LEARNERS, ROUNDS, STRIDE)
+    try:
+        train = bench_train_step()
+    except Exception:  # secondary metric must not sink the headline
+        train = {}
+
+    value = agg["ms_per_round_median"]
+    result = {
+        "metric": f"aggregation_ms_per_round_{NUM_LEARNERS}learners",
+        "value": round(value, 2),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / value, 2),
+        "details": {
+            **agg,
+            **train,
+            "baseline_ms": BASELINE_MS,
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "peak_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+            "bench_wall_s": round(time.time() - t_start, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

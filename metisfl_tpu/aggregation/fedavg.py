@@ -15,16 +15,18 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from metisfl_tpu.aggregation.base import (
     AggState,
     Pytree,
     finalize,
+    is_host_tree,
     np_finalize,
-    np_scaled_add,
-    np_scaled_init,
-    scaled_add,
-    scaled_init,
+    np_stacked_scaled_add,
+    stacked_scaled_add,
+    stacked_scaled_init,
     use_numpy_fold,
 )
 
@@ -47,22 +49,35 @@ class FedAvg:
     ) -> None:
         """Fold one block of ``(lineage, scale)`` pairs into the running sum.
 
-        Only the accumulator stays resident between calls — callers can
-        stream blocks of any size.
+        Only the accumulator (plus the current block, stacked) stays resident
+        between calls — callers stream blocks of any size. The block enters
+        the device as one stacked array per leaf and folds in a single fused
+        weighted reduce (vs the reference's per-variable OpenMP loop,
+        federated_average.cc:101).
         """
-        for lineage, scale in models:
-            model = lineage[0]
-            if self._dtypes is None:
-                self._np = use_numpy_fold(model)
-                self._dtypes = tuple(
-                    str(x.dtype) for x in jax.tree.leaves(model))
-            init = np_scaled_init if self._np else scaled_init
-            add = np_scaled_add if self._np else scaled_add
+        if not models:
+            return
+        first = models[0][0][0]
+        if self._dtypes is None:
+            # fold locale: host BLAS for wire-arrived numpy models (FedAvg is
+            # bandwidth-bound — see is_host_tree), device fold for
+            # device-resident trees, psum for pod mode.
+            self._np = use_numpy_fold(first) or is_host_tree(first)
+            self._dtypes = tuple(
+                str(np.asarray(x).dtype) for x in jax.tree.leaves(first))
+        block = [lineage[0] for lineage, _ in models]
+        # f64 scales: the host fold downcasts per-leaf to its accumulator
+        # dtype, so wide (f64) model trees keep double-precision weights
+        scales = np.asarray([scale for _, scale in models], np.float64)
+        if self._np:
+            self._acc = np_stacked_scaled_add(self._acc, block, scales)
+        else:
+            scales_dev = jnp.asarray(scales.astype(np.float32))
             if self._acc is None:
-                self._acc = init(model, scale)
+                self._acc = stacked_scaled_init(scales_dev, *block)
             else:
-                self._acc = add(self._acc, model, scale)
-            self._total += float(scale)
+                self._acc = stacked_scaled_add(self._acc, scales_dev, *block)
+        self._total += float(scales.sum())
 
     def result(self) -> Pytree:
         """Normalize the running sum → community model (storage dtypes).

@@ -32,6 +32,7 @@ from metisfl_tpu.aggregation.base import (
     scaled_add,
     scaled_init,
     scaled_sub,
+    is_host_tree,
     use_numpy_fold,
 )
 
@@ -50,7 +51,9 @@ class _RollingBase:
     def _add(self, learner_id: str, model: Pytree, scale: float) -> None:
         state = self._state
         if state.wc_scaled is None:
-            state.use_numpy = use_numpy_fold(model)
+            # host-resident models fold on host (see is_host_tree): the
+            # incremental add/remove is a streaming axpy, not MXU work
+            state.use_numpy = use_numpy_fold(model) or is_host_tree(model)
             init = np_scaled_init if state.use_numpy else scaled_init
             state.wc_scaled = init(model, scale)
         else:

@@ -213,7 +213,8 @@ class Controller:
                     learner_id, request.num_train_examples)
         # Control handoff exactly like controller.cc:163-164: initial task is
         # scheduled off the join path.
-        self._pool.submit(self._guard, self._schedule_initial, learner_id)
+        if not self._shutdown.is_set():
+            self._pool.submit(self._guard, self._schedule_initial, learner_id)
         return JoinReply(learner_id=learner_id, auth_token=token)
 
     def leave(self, learner_id: str, auth_token: str) -> bool:
@@ -227,7 +228,8 @@ class Controller:
         logger.info("learner %s left", learner_id)
         # Re-evaluate the round barrier: if the departed learner was the last
         # pending one, no completion event would ever release the round.
-        self._pool.submit(self._guard, self._handle_membership_change)
+        if not self._shutdown.is_set():
+            self._pool.submit(self._guard, self._handle_membership_change)
         return True
 
     def active_learners(self) -> List[str]:

@@ -18,6 +18,22 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def to_varying(tree, axis_names):
+    """Mark a replicated tree as device-varying over ``axis_names``.
+
+    Required before ``jax.grad`` inside ``shard_map``: differentiating w.r.t.
+    an *unvarying* (replicated) input transposes the implicit broadcast into
+    a psum over the mesh — per-device gradients silently become cross-device
+    sums. (jax ≥0.9 VMA semantics; fixed here by casting params to varying
+    so the cotangent stays per-device.)"""
+    def cast(t):
+        try:
+            return jax.lax.pcast(t, axis_names, to="varying")
+        except AttributeError:  # pragma: no cover - older jax
+            return jax.lax.pvary(t, axis_names)
+    return jax.tree.map(cast, tree)
+
+
 def federated_mean_psum(params, scale, axis_name: str = "fed"):
     """Inside shard_map/pjit: weighted mean of per-learner params over the
     federation axis. ``scale`` is this learner's normalized weight."""

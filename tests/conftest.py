@@ -7,7 +7,10 @@ the multi-chip path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment points at real accelerators (e.g.
+# JAX_PLATFORMS=axon): CI must be hermetic and the virtual 8-device mesh
+# only exists on the host platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,8 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
-# Under pytest, plugins may import jax before this conftest runs, so the env
-# var alone is not reliable — set the config directly too.
+# Under pytest, plugins (or a sitecustomize like axon's, which force-sets
+# jax_platforms) may import/configure jax before this conftest runs, so the
+# env vars alone are not reliable — set the config directly too.
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
