@@ -1,5 +1,6 @@
 from metisfl_tpu.config.federation import (
     AggregationConfig,
+    CheckpointConfig,
     EvalConfig,
     FederationConfig,
     LearnerEndpoint,
@@ -12,6 +13,7 @@ from metisfl_tpu.config.federation import (
 __all__ = [
     "FederationConfig",
     "AggregationConfig",
+    "CheckpointConfig",
     "ModelStoreConfig",
     "SecureAggConfig",
     "TerminationConfig",

@@ -56,6 +56,15 @@ class SecureAggConfig:
 
 
 @dataclass
+class CheckpointConfig:
+    """Controller-side global checkpoint (SURVEY.md §5.4: the reference has
+    no resume flow; community model + round counter are rebuilt here)."""
+
+    dir: str = ""                            # "" → checkpointing disabled
+    every_n_rounds: int = 1
+
+
+@dataclass
 class EvalConfig:
     batch_size: int = 256
     datasets: List[str] = field(default_factory=lambda: ["test"])
@@ -76,10 +85,17 @@ class FederationConfig:
     protocol: str = "synchronous"            # synchronous | semi_synchronous | asynchronous
     semi_sync_lambda: float = 1.0
     semi_sync_recompute_every_round: bool = False
+    # Straggler deadline for sync/semi-sync rounds: a dispatched learner that
+    # has not reported within this many seconds is dropped from the round
+    # barrier and the round proceeds with whoever did report. 0 → no deadline
+    # (reference behavior: a hung learner stalls the round forever,
+    # SURVEY.md §5.3).
+    round_deadline_secs: float = 0.0
     aggregation: AggregationConfig = field(default_factory=AggregationConfig)
     model_store: ModelStoreConfig = field(default_factory=ModelStoreConfig)
     secure: SecureAggConfig = field(default_factory=SecureAggConfig)
     termination: TerminationConfig = field(default_factory=TerminationConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     train: TrainParams = field(default_factory=TrainParams)
     eval: EvalConfig = field(default_factory=EvalConfig)
     controller_host: str = "localhost"

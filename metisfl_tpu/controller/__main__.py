@@ -24,6 +24,9 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=0,
                         help="override config controller_port")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore community model + round counter from "
+                             "config.checkpoint.dir before serving")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -43,6 +46,13 @@ def main(argv=None) -> int:
 
     controller = Controller(config, RpcLearnerProxy,
                             secure_backend=secure_backend)
+    if args.resume:
+        if not config.checkpoint.dir:
+            parser.error("--resume requires config.checkpoint.dir")
+        if not controller.restore_checkpoint():
+            logging.getLogger("metisfl_tpu.controller").warning(
+                "--resume: no checkpoint found under %r — starting FRESH "
+                "at round 0", config.checkpoint.dir)
     server = ControllerServer(controller, host=args.host,
                               port=args.port or config.controller_port)
     port = server.start()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import random
 import resource
 import threading
@@ -35,6 +36,8 @@ import numpy as np
 
 from metisfl_tpu.aggregation import make_aggregation_rule
 from metisfl_tpu.aggregation.secure import SecureAgg
+from metisfl_tpu.comm.codec import dumps as codec_dumps
+from metisfl_tpu.comm.codec import loads as codec_loads
 from metisfl_tpu.comm.messages import (
     EvalResult,
     EvalTask,
@@ -164,6 +167,11 @@ class Controller:
                                         thread_name_prefix="ctrl-sched")
         self._shutdown = threading.Event()
         self._tasks_in_flight: Dict[str, str] = {}  # task_id -> learner_id
+        # straggler-deadline state: each dispatch bumps the serial so a
+        # deadline timer from a completed round never fires on the next one
+        self._round_serial = 0
+        self._deadline_timer: Optional[threading.Timer] = None
+        self._expired_tasks: Dict[str, None] = {}  # ordered set of task_ids
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -174,6 +182,9 @@ class Controller:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        with self._lock:
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
         self._pool.shutdown(wait=True)
         self._store.shutdown()
 
@@ -309,13 +320,25 @@ class Controller:
             if result.processing_ms_per_step > 0:
                 record.ms_per_step = result.processing_ms_per_step
             self._tasks_in_flight.pop(result.task_id, None)
-            self._current_meta.train_received_at[result.learner_id] = start
+            # A completion for a task the deadline already expired: keep the
+            # model (fresh data for later rounds) but do not advance the
+            # current round's barrier — and keep its timings out of the
+            # current round's metadata (it belongs to an abandoned round).
+            stale = result.task_id in self._expired_tasks
+            self._expired_tasks.pop(result.task_id, None)
+            if not stale:
+                self._current_meta.train_received_at[result.learner_id] = start
 
         model = self._parse_result_model(result)
         self._store.insert(result.learner_id, model)
-        with self._lock:
-            self._current_meta.model_insertion_duration_ms[result.learner_id] = (
-                (time.time() - start) * 1e3)
+        if not stale:
+            with self._lock:
+                self._current_meta.model_insertion_duration_ms[result.learner_id] = (
+                    (time.time() - start) * 1e3)
+        if stale:
+            logger.info("late completion from %s for expired task %s stored "
+                        "but not scheduled", result.learner_id, result.task_id)
+            return
 
         to_schedule = self._scheduler.schedule_next(
             result.learner_id, self.active_learners())
@@ -339,6 +362,60 @@ class Controller:
             self._scheduler.reset()
             self._dispatch_train(self._sample_cohort())
 
+    # -- straggler deadline ----------------------------------------------
+
+    def _arm_round_deadline(self) -> None:
+        """Start (or restart) the per-round straggler timer after a dispatch.
+        Only sync/semi-sync rounds have a barrier a straggler can stall."""
+        deadline = self.config.round_deadline_secs
+        if deadline <= 0 or self._scheduler.name == "asynchronous":
+            return
+        with self._lock:
+            self._round_serial += 1
+            serial = self._round_serial
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+
+            def _fire():
+                if self._shutdown.is_set():
+                    return
+                try:
+                    self._pool.submit(self._guard, self._handle_deadline, serial)
+                except RuntimeError:  # pool already shut down
+                    pass
+
+            timer = threading.Timer(deadline, _fire)
+            timer.daemon = True
+            self._deadline_timer = timer
+            timer.start()
+
+    def _handle_deadline(self, serial: int) -> None:
+        """Round deadline expired: drop unreported learners from the barrier
+        and proceed with whoever reported (or re-dispatch if nobody did)."""
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            if serial != self._round_serial:
+                return  # round already completed; stale timer
+            pending = dict(self._tasks_in_flight)
+            self._expired_tasks.update(dict.fromkeys(pending))
+            while len(self._expired_tasks) > 512:
+                self._expired_tasks.pop(next(iter(self._expired_tasks)))
+            self._tasks_in_flight.clear()
+        cohort = self._scheduler.expire_pending(self.active_learners())
+        dropped = sorted(set(pending.values()))
+        if cohort:
+            logger.warning(
+                "round deadline (%.1fs) expired; aggregating %d reporter(s), "
+                "dropping stragglers %s", self.config.round_deadline_secs,
+                len(cohort), dropped)
+            self._complete_round(cohort)
+        else:
+            logger.warning(
+                "round deadline (%.1fs) expired with no reporters (%s); "
+                "re-dispatching", self.config.round_deadline_secs, dropped)
+            self._dispatch_train(self._sample_cohort())
+
     def _parse_result_model(self, result: TaskResult):
         blob = ModelBlob.from_bytes(result.model)
         if self.config.secure.enabled:
@@ -359,6 +436,12 @@ class Controller:
             self.round_metadata.append(self._current_meta)
             self._current_meta = RoundMetadata(
                 global_iteration=self.global_iteration)
+        ckpt = self.config.checkpoint
+        if ckpt.dir and self.global_iteration % max(1, ckpt.every_n_rounds) == 0:
+            try:
+                self.save_checkpoint()
+            except Exception:
+                logger.exception("checkpoint save failed")
         self._maybe_recompute_semisync()
         if self._shutdown.is_set():
             return
@@ -561,8 +644,9 @@ class Controller:
             except Exception:
                 # Failed dispatches are logged and dropped, like the
                 # reference (controller.cc:783-786); async protocols recover,
-                # sync rounds rely on membership changes.
+                # sync rounds rely on the round deadline / membership changes.
                 logger.exception("train dispatch to %s failed", lid)
+        self._arm_round_deadline()
 
     def _send_eval_tasks(self) -> None:
         """SendEvaluationTasks (controller.cc:571-647) + digest callback."""
@@ -604,8 +688,71 @@ class Controller:
                 logger.exception("eval dispatch to %s failed", record.learner_id)
 
     # ------------------------------------------------------------------ #
+    # checkpoint / resume
+    # ------------------------------------------------------------------ #
+
+    _CKPT_NAME = "controller_ckpt.bin"
+
+    def save_checkpoint(self, path: Optional[str] = None) -> str:
+        """Persist community model + round counter + lineage metadata.
+
+        Closes the reference's resume gap (SURVEY.md §5.4: resume there is
+        manual re-seeding via ReplaceCommunityModel, controller.cc:85-96 —
+        the round counter and metadata lineage are lost)."""
+        if path is None:
+            path = os.path.join(self.config.checkpoint.dir, self._CKPT_NAME)
+        with self._lock:
+            state = {
+                "global_iteration": self.global_iteration,
+                "community_blob": self._community_blob or b"",
+                "round_metadata": [m.to_dict() for m in self.round_metadata],
+                "community_evaluations": self._snapshot_evaluations(),
+            }
+        buf = codec_dumps(state)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf)
+        os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+        return path
+
+    def restore_checkpoint(self, path: Optional[str] = None) -> bool:
+        """Restore from ``save_checkpoint`` output; returns False when no
+        checkpoint exists (fresh start)."""
+        if path is None:
+            path = self.config.checkpoint.dir
+        if os.path.isdir(path):
+            path = os.path.join(path, self._CKPT_NAME)
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            state = codec_loads(f.read())
+        blob = state.get("community_blob") or None
+        with self._lock:
+            self.global_iteration = int(state["global_iteration"])
+            self.round_metadata = [
+                RoundMetadata(**m) for m in state.get("round_metadata", [])]
+            self.community_evaluations = list(
+                state.get("community_evaluations", []))
+            self._current_meta = RoundMetadata(
+                global_iteration=self.global_iteration)
+        if blob:
+            self.set_community_model(blob)
+        logger.info("restored checkpoint %s at round %d",
+                    path, self.global_iteration)
+        return True
+
+    # ------------------------------------------------------------------ #
     # statistics (driver)
     # ------------------------------------------------------------------ #
+
+    def _snapshot_evaluations(self) -> List[dict]:
+        """Copy evaluation entries deep enough to detach the mutable
+        ``evaluations`` dict, which eval-digest callbacks keep inserting into
+        under the lock — a caller serializing a shallow copy outside the lock
+        would race those inserts. Call with ``self._lock`` held."""
+        return [{**e, "evaluations": dict(e["evaluations"])}
+                for e in self.community_evaluations]
 
     def get_statistics(self) -> dict:
         with self._lock:
@@ -613,5 +760,5 @@ class Controller:
                 "global_iteration": self.global_iteration,
                 "learners": sorted(self._learners.keys()),
                 "round_metadata": [m.to_dict() for m in self.round_metadata],
-                "community_evaluations": [dict(e) for e in self.community_evaluations],
+                "community_evaluations": self._snapshot_evaluations(),
             }

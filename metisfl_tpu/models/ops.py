@@ -17,6 +17,7 @@ pytorch_model_ops.py:23-172) with one JAX engine:
 from __future__ import annotations
 
 import inspect
+import logging
 import math
 import time
 from dataclasses import dataclass, field
@@ -32,6 +33,8 @@ from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.optimizers import make_optimizer
 
 Pytree = Any
+
+logger = logging.getLogger("metisfl_tpu.models")
 
 
 @dataclass
@@ -61,6 +64,36 @@ _LOSSES = {
 
 def _accuracy(logits, y):
     return jnp.mean(jnp.argmax(logits, axis=-1) == y)
+
+
+def _top5_accuracy(logits, y):
+    k = min(5, logits.shape[-1])
+    _, top = jax.lax.top_k(logits, k)
+    return jnp.mean(jnp.any(top == y[..., None], axis=-1))
+
+
+def _mse_metric(preds, y):
+    return jnp.mean(jnp.square(preds.squeeze() - y))
+
+
+def _mae_metric(preds, y):
+    return jnp.mean(jnp.abs(preds.squeeze() - y))
+
+
+# Evaluation metric registry: arbitrary per-task metric lists, matching the
+# reference's free-form metric names (metis.proto:162-169) but typed and
+# jit-compiled. Each metric maps (model outputs, labels) → scalar.
+METRICS: Dict[str, Callable] = {
+    "accuracy": _accuracy,
+    "top5_accuracy": _top5_accuracy,
+    "mse": _mse_metric,
+    "mae": _mae_metric,
+}
+
+
+def register_metric(name: str, fn: Callable) -> None:
+    """Register a custom eval metric ``fn(outputs, labels) -> scalar``."""
+    METRICS[name] = fn
 
 
 class FlaxModelOps:
@@ -93,7 +126,7 @@ class FlaxModelOps:
                 jnp.asarray(sample_input), **init_kwargs)
         self._has_batch_stats = "batch_stats" in self.variables
         self._step_cache: Dict[tuple, Callable] = {}
-        self._eval_cache: Optional[Callable] = None
+        self._eval_cache: Dict[Tuple[str, ...], Callable] = {}
 
     # -- module introspection ---------------------------------------------
     def _accepts_train_kwarg(self) -> bool:
@@ -245,41 +278,61 @@ class FlaxModelOps:
         )
 
     # -- evaluation --------------------------------------------------------
-    def _make_eval(self):
-        if self._eval_cache is None:
-            loss_fn = self.loss_fn
+    def _make_eval(self, metric_names: Tuple[str, ...]):
+        cached = self._eval_cache.get(metric_names)
+        if cached is not None:
+            return cached
+        loss_fn = self.loss_fn
+        unknown = [m for m in metric_names if m not in METRICS]
+        if unknown:
+            raise ValueError(
+                f"unknown eval metrics {unknown}; registered: {sorted(METRICS)}"
+                " (add custom ones via metisfl_tpu.models.ops.register_metric)")
+        fns = [(name, METRICS[name]) for name in metric_names]
 
-            def eval_step(variables, x, y):
-                logits = self._apply(variables, x, train=False)
-                return loss_fn(logits, y), _accuracy(logits, y), x.shape[0]
+        def eval_step(variables, x, y):
+            logits = self._apply(variables, x, train=False)
+            vals = {"loss": loss_fn(logits, y)}
+            for name, fn in fns:
+                vals[name] = fn(logits, y)
+            return vals
 
-            self._eval_cache = jax.jit(eval_step)
-        return self._eval_cache
+        compiled = jax.jit(eval_step)
+        self._eval_cache[metric_names] = compiled
+        return compiled
 
     def evaluate(self, dataset: ArrayDataset, batch_size: int = 256,
                  metrics: Optional[List[str]] = None,
                  variables: Optional[Pytree] = None) -> Dict[str, float]:
         """Evaluate ``variables`` (default: the engine's current model).
 
-        Passing variables explicitly lets an eval run concurrently with
-        training without racing on the engine's model slot.
+        ``metrics`` selects from the METRICS registry (loss is always
+        reported; unregistered names are skipped with a warning, matching the
+        reference's tolerance of free-form metric lists, metis.proto:162-169
+        — eval runs on fire-and-forget threads, so raising here would make
+        evaluations silently vanish). Passing variables explicitly lets an
+        eval run concurrently with training without racing on the engine's
+        model slot.
         """
-        eval_step = self._make_eval()
+        requested = [m for m in (metrics or ["accuracy"]) if m != "loss"]
+        unknown = [m for m in requested if m not in METRICS]
+        if unknown:
+            logger.warning("skipping unregistered eval metrics %s "
+                           "(registered: %s)", unknown, sorted(METRICS))
+        names = tuple(m for m in requested if m in METRICS)
+        eval_step = self._make_eval(names)
         if variables is None:
             variables = self.variables
         else:
             variables = jax.tree.map(jnp.asarray, variables)
-        total_loss = 0.0
-        total_acc = 0.0
+        totals = {name: 0.0 for name in ("loss",) + names}
         count = 0
         for x, y in dataset.batches(batch_size, shuffle=False):
-            loss, acc, n = eval_step(variables, jnp.asarray(x), jnp.asarray(y))
-            total_loss += float(loss) * int(n)
-            total_acc += float(acc) * int(n)
-            count += int(n)
+            n = x.shape[0]
+            vals = eval_step(variables, jnp.asarray(x), jnp.asarray(y))
+            for name, v in vals.items():
+                totals[name] += float(v) * n
+            count += n
         if count == 0:
             return {}
-        out = {"loss": total_loss / count, "accuracy": total_acc / count}
-        if metrics:
-            out = {k: v for k, v in out.items() if k in metrics or k == "loss"}
-        return out
+        return {name: total / count for name, total in totals.items()}

@@ -73,6 +73,14 @@ class SynchronousScheduler:
         return bool(self._dispatched) and not any(
             lid in active for lid in self._dispatched)
 
+    def expire_pending(self, active: Sequence[str]) -> List[str]:
+        """Straggler deadline: drop dispatched-but-unreported learners from
+        the round barrier and release whoever did report (possibly nobody —
+        the caller then re-dispatches). Closes the stall the reference never
+        handles (SURVEY.md §5.3: failed/hung learners stall a sync round
+        forever, controller.cc:683-687)."""
+        return self._release(active)
+
     def reset(self) -> None:
         self._completed.clear()
         self._dispatched.clear()
@@ -94,6 +102,9 @@ class AsynchronousScheduler:
 
     def round_stalled(self, active: Sequence[str]) -> bool:
         return False
+
+    def expire_pending(self, active: Sequence[str]) -> List[str]:
+        return []  # no barrier — a hung learner cannot stall anyone else
 
     def reset(self) -> None:
         pass

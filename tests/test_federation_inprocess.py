@@ -184,3 +184,52 @@ def test_learner_leave_midrun():
         assert len(fed.statistics()["learners"]) == 2
     finally:
         fed.shutdown()
+
+
+def test_straggler_deadline_completes_rounds():
+    """A hung (not crashed) learner must not stall sync rounds forever: the
+    round deadline drops it from the barrier and aggregates the reporters."""
+    fed, _ = _make_federation(num_learners=3, round_deadline_secs=4.0)
+    # hung learner: accepts every dispatch, never reports back
+    fed.learners[2].run_task = lambda task: None
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=60)
+        stats = fed.statistics()
+        assert stats["global_iteration"] >= 2
+        # rounds aggregated only the responsive learners
+        for meta in stats["round_metadata"][:2]:
+            assert 1 <= len(meta["selected_learners"]) <= 2
+    finally:
+        fed.shutdown()
+
+
+def test_checkpoint_and_resume(tmp_path):
+    from metisfl_tpu.config import CheckpointConfig
+    fed, _ = _make_federation(
+        num_learners=2, checkpoint=CheckpointConfig(dir=str(tmp_path)))
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+    finally:
+        fed.shutdown()
+    # a fresh controller restores round counter, metadata, and the model
+    fed2 = InProcessFederation(fed.config)
+    try:
+        assert fed2.controller.restore_checkpoint()
+        assert fed2.controller.global_iteration >= 2
+        assert len(fed2.controller.round_metadata) == fed2.controller.global_iteration
+        assert fed2.controller.community_model_bytes() is not None
+    finally:
+        fed2.shutdown()
+
+
+def test_restore_without_checkpoint_is_fresh_start(tmp_path):
+    from metisfl_tpu.config import CheckpointConfig
+    fed, _ = _make_federation(
+        num_learners=2, checkpoint=CheckpointConfig(dir=str(tmp_path / "none")))
+    try:
+        assert fed.controller.restore_checkpoint() is False
+        assert fed.controller.global_iteration == 0
+    finally:
+        fed.shutdown()

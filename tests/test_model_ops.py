@@ -103,3 +103,27 @@ def test_variables_roundtrip_through_wire(ops):
     for a, b in zip(np.asarray(list(variables["params"].values())[0]["kernel"]),
                     np.asarray(list(restored["params"].values())[0]["kernel"])):
         np.testing.assert_array_equal(a, b)
+
+
+def test_eval_metric_registry(ops):
+    engine, ds = ops
+    out = engine.evaluate(ds, metrics=["accuracy", "top5_accuracy"])
+    assert set(out) == {"loss", "accuracy", "top5_accuracy"}
+    # 3 classes → top-5 clips to top-3 == always correct
+    assert out["top5_accuracy"] == pytest.approx(1.0)
+    # unregistered metrics are skipped (eval runs on fire-and-forget
+    # threads; raising would make evaluations silently vanish)
+    out = engine.evaluate(ds, metrics=["not_a_metric", "accuracy"])
+    assert set(out) == {"loss", "accuracy"}
+
+
+def test_register_custom_metric(ops):
+    import jax.numpy as jnp
+
+    from metisfl_tpu.models.ops import register_metric
+
+    engine, ds = ops
+    register_metric("const_half", lambda logits, y: jnp.float32(0.5))
+    out = engine.evaluate(ds, metrics=["const_half"])
+    assert out["const_half"] == pytest.approx(0.5)
+    assert "loss" in out

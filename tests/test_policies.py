@@ -218,3 +218,30 @@ class TestDiskStore:
         out = store.select(["L0"])["L0"][0]
         assert isinstance(out, bytes)
         assert ModelBlob.from_bytes(out).opaque["w"][0] == b"cipher"
+
+
+class TestStragglerExpiry:
+    """expire_pending: the straggler-deadline hook (SURVEY.md §5.3 gap)."""
+
+    def test_sync_releases_reporters_and_resets(self):
+        from metisfl_tpu.scheduling import make_scheduler
+        s = make_scheduler("synchronous")
+        s.notify_dispatched(["a", "b", "c"])
+        assert s.schedule_next("a", ["a", "b", "c"]) == []
+        assert s.expire_pending(["a", "b", "c"]) == ["a"]
+        # barrier fully reset: the next round is unaffected by the expiry
+        s.notify_dispatched(["a", "b"])
+        assert s.schedule_next("a", ["a", "b"]) == []
+        assert sorted(s.schedule_next("b", ["a", "b"])) == ["a", "b"]
+
+    def test_sync_no_reporters_yields_empty_cohort(self):
+        from metisfl_tpu.scheduling import make_scheduler
+        s = make_scheduler("synchronous")
+        s.notify_dispatched(["a", "b"])
+        assert s.expire_pending(["a", "b"]) == []
+        assert not s.round_stalled(["a", "b"])  # state cleared
+
+    def test_async_expire_is_noop(self):
+        from metisfl_tpu.scheduling import make_scheduler
+        s = make_scheduler("asynchronous")
+        assert s.expire_pending(["a"]) == []
