@@ -59,11 +59,16 @@ class BytesService:
 
 
 class RpcServer:
-    """gRPC server hosting one or more :class:`BytesService`s."""
+    """gRPC server hosting one or more :class:`BytesService`s.
 
-    def __init__(self, host: str, port: int, max_workers: int = 16):
+    ``ssl``: an enabled :class:`metisfl_tpu.comm.ssl.SSLConfig` serves TLS
+    (reference controller_servicer.cc:38-74); None serves plaintext.
+    """
+
+    def __init__(self, host: str, port: int, max_workers: int = 16, ssl=None):
         self.host = host
         self.port = port
+        self.ssl = ssl if (ssl is not None and ssl.enabled) else None
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=_UNLIMITED,
@@ -75,11 +80,17 @@ class RpcServer:
 
     def start(self) -> int:
         addr = f"{self.host}:{self.port}"
-        self._bound_port = self._server.add_insecure_port(addr)
+        if self.ssl is not None:
+            from metisfl_tpu.comm.ssl import server_credentials
+            self._bound_port = self._server.add_secure_port(
+                addr, server_credentials(self.ssl))
+        else:
+            self._bound_port = self._server.add_insecure_port(addr)
         if self._bound_port == 0:
             raise RuntimeError(f"could not bind gRPC server on {addr}")
         self._server.start()
-        logger.info("gRPC server listening on %s:%d", self.host, self._bound_port)
+        logger.info("gRPC server listening on %s:%d%s", self.host,
+                    self._bound_port, " (TLS)" if self.ssl else "")
         return self._bound_port
 
     def stop(self, grace: float = 1.0) -> None:
@@ -93,12 +104,17 @@ class RpcClient:
     """Channel to a :class:`BytesService` with retry/backoff on UNAVAILABLE."""
 
     def __init__(self, host: str, port: int, service_name: str,
-                 retries: int = 10, retry_sleep_s: float = 1.0):
+                 retries: int = 10, retry_sleep_s: float = 1.0, ssl=None):
         self.target = f"{host}:{port}"
         self.service_name = service_name
         self.retries = retries
         self.retry_sleep_s = retry_sleep_s
-        self._channel = grpc.insecure_channel(self.target, options=_UNLIMITED)
+        if ssl is not None and ssl.enabled:
+            from metisfl_tpu.comm.ssl import channel_credentials
+            self._channel = grpc.secure_channel(
+                self.target, channel_credentials(ssl), options=_UNLIMITED)
+        else:
+            self._channel = grpc.insecure_channel(self.target, options=_UNLIMITED)
 
     def call(self, method: str, payload: bytes, timeout: Optional[float] = None,
              wait_ready: bool = True) -> bytes:

@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 from metisfl_tpu.comm.codec import dumps, loads
 from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.comm.ssl import SSLConfig
 
 
 @dataclass
@@ -96,6 +97,7 @@ class FederationConfig:
     secure: SecureAggConfig = field(default_factory=SecureAggConfig)
     termination: TerminationConfig = field(default_factory=TerminationConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    ssl: SSLConfig = field(default_factory=SSLConfig)
     train: TrainParams = field(default_factory=TrainParams)
     eval: EvalConfig = field(default_factory=EvalConfig)
     controller_host: str = "localhost"

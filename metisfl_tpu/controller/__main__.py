@@ -44,8 +44,10 @@ def main(argv=None) -> int:
         from metisfl_tpu.secure import make_backend
         secure_backend = make_backend(config.secure, role="controller")
 
-    controller = Controller(config, RpcLearnerProxy,
-                            secure_backend=secure_backend)
+    controller = Controller(
+        config,
+        lambda record: RpcLearnerProxy(record, ssl=config.ssl),
+        secure_backend=secure_backend)
     if args.resume:
         if not config.checkpoint.dir:
             parser.error("--resume requires config.checkpoint.dir")
@@ -54,7 +56,8 @@ def main(argv=None) -> int:
                 "--resume: no checkpoint found under %r — starting FRESH "
                 "at round 0", config.checkpoint.dir)
     server = ControllerServer(controller, host=args.host,
-                              port=args.port or config.controller_port)
+                              port=args.port or config.controller_port,
+                              ssl=config.ssl)
     port = server.start()
     print(f"METISFL_TPU_CONTROLLER_READY port={port}", flush=True)
 

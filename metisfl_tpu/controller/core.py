@@ -206,6 +206,13 @@ class Controller:
                 record.hostname, record.port = request.hostname, request.port
                 record.proxy = self._proxy_factory(record)
                 logger.info("learner %s rejoined", record.learner_id)
+                # Re-dispatch the current community model so a crash-restarted
+                # learner rejoins the in-flight round instead of idling until
+                # the next dispatch (the reference leaves the sync round
+                # stalled after a crash — SURVEY.md §5.3).
+                if not self._shutdown.is_set():
+                    self._pool.submit(self._guard, self._schedule_initial,
+                                      record.learner_id)
                 return JoinReply(learner_id=record.learner_id,
                                  auth_token=record.auth_token, rejoined=True)
             learner_id = f"L{len(self._tokens)}_{request.hostname}_{request.port}"
@@ -246,6 +253,15 @@ class Controller:
     def active_learners(self) -> List[str]:
         with self._lock:
             return list(self._learners.keys())
+
+    def learner_endpoints(self) -> List[Dict[str, Any]]:
+        """Registered endpoints with the ports learners reported on join."""
+        with self._lock:
+            return [
+                {"learner_id": r.learner_id, "hostname": r.hostname,
+                 "port": r.port}
+                for r in self._learners.values()
+            ]
 
     # ------------------------------------------------------------------ #
     # community model management (RPC thread)
@@ -308,7 +324,7 @@ class Controller:
             record = self._learners.get(learner_id)
         if record is None:
             return
-        self._dispatch_train([learner_id])
+        self._dispatch_train([learner_id], restart_deadline=False)
 
     def _handle_completed(self, result: TaskResult) -> None:
         start = time.time()
@@ -364,13 +380,22 @@ class Controller:
 
     # -- straggler deadline ----------------------------------------------
 
-    def _arm_round_deadline(self) -> None:
+    def _arm_round_deadline(self, restart: bool = True) -> None:
         """Start (or restart) the per-round straggler timer after a dispatch.
-        Only sync/semi-sync rounds have a barrier a straggler can stall."""
+        Only sync/semi-sync rounds have a barrier a straggler can stall.
+
+        ``restart=False`` (join/rejoin single-learner dispatches) only arms
+        when no timer is live — otherwise a crash-looping learner rejoining
+        inside the deadline window would keep postponing it forever, and a
+        mid-round join would silently extend the in-flight round's deadline.
+        """
         deadline = self.config.round_deadline_secs
         if deadline <= 0 or self._scheduler.name == "asynchronous":
             return
         with self._lock:
+            if (not restart and self._deadline_timer is not None
+                    and self._deadline_timer.is_alive()):
+                return
             self._round_serial += 1
             serial = self._round_serial
             if self._deadline_timer is not None:
@@ -610,7 +635,8 @@ class Controller:
 
     # -- dispatch ---------------------------------------------------------
 
-    def _dispatch_train(self, learner_ids: Sequence[str]) -> None:
+    def _dispatch_train(self, learner_ids: Sequence[str],
+                        restart_deadline: bool = True) -> None:
         """SendRunTasks (controller.cc:696-759)."""
         with self._lock:
             blob = self._community_blob
@@ -646,7 +672,7 @@ class Controller:
                 # reference (controller.cc:783-786); async protocols recover,
                 # sync rounds rely on the round deadline / membership changes.
                 logger.exception("train dispatch to %s failed", lid)
-        self._arm_round_deadline()
+        self._arm_round_deadline(restart=restart_deadline)
 
     def _send_eval_tasks(self) -> None:
         """SendEvaluationTasks (controller.cc:571-647) + digest callback."""

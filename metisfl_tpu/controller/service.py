@@ -34,8 +34,9 @@ class RpcLearnerProxy:
     """Controller → remote learner over gRPC (async dispatch, mirroring the
     reference's CompletionQueue fan-out, controller.cc:713-759)."""
 
-    def __init__(self, record: LearnerRecord):
-        self._client = RpcClient(record.hostname, record.port, LEARNER_SERVICE)
+    def __init__(self, record: LearnerRecord, ssl=None):
+        self._client = RpcClient(record.hostname, record.port, LEARNER_SERVICE,
+                                 ssl=ssl)
 
     def run_task(self, task: TrainTask) -> None:
         self._client.call_async("RunTask", task.to_wire())
@@ -56,9 +57,9 @@ class ControllerServer:
     """Host a :class:`Controller` behind gRPC."""
 
     def __init__(self, controller: Controller, host: str = "0.0.0.0",
-                 port: int = 50051):
+                 port: int = 50051, ssl=None):
         self.controller = controller
-        self._server = RpcServer(host, port)
+        self._server = RpcServer(host, port, ssl=ssl)
         self._server.add_service(BytesService(CONTROLLER_SERVICE, {
             "JoinFederation": self._join,
             "LeaveFederation": self._leave,
@@ -66,6 +67,7 @@ class ControllerServer:
             "ReplaceCommunityModel": self._replace_model,
             "GetCommunityModel": self._get_model,
             "GetStatistics": self._get_statistics,
+            "ListLearners": self._list_learners,
             "GetHealthStatus": self._health,
             "ShutDown": self._shutdown_rpc,
         }))
@@ -94,6 +96,9 @@ class ControllerServer:
 
     def _get_statistics(self, raw: bytes) -> bytes:
         return dumps(self.controller.get_statistics())
+
+    def _list_learners(self, raw: bytes) -> bytes:
+        return dumps({"learners": self.controller.learner_endpoints()})
 
     def _health(self, raw: bytes) -> bytes:
         return dumps({"status": "SERVING",
@@ -124,8 +129,8 @@ class ControllerClient:
     """Learner/driver → controller client (reference
     grpc_controller_client.py:11-297)."""
 
-    def __init__(self, host: str, port: int):
-        self._client = RpcClient(host, port, CONTROLLER_SERVICE)
+    def __init__(self, host: str, port: int, ssl=None):
+        self._client = RpcClient(host, port, CONTROLLER_SERVICE, ssl=ssl)
 
     def join(self, request: JoinRequest) -> JoinReply:
         return JoinReply.from_wire(self._client.call("JoinFederation",
@@ -148,6 +153,12 @@ class ControllerClient:
 
     def get_statistics(self) -> dict:
         return loads(self._client.call("GetStatistics", b""))
+
+    def list_learners(self) -> list:
+        """Registered learner endpoints [{learner_id, hostname, port}] — the
+        ports learners actually bound (JoinRequest.port), for shutdown and
+        monitoring (replaces any port-arithmetic assumptions driver-side)."""
+        return loads(self._client.call("ListLearners", b""))["learners"]
 
     def health(self, timeout: float = 5.0) -> dict:
         return loads(self._client.call("GetHealthStatus", b"", timeout=timeout))

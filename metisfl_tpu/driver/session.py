@@ -51,6 +51,7 @@ class LocalLauncher:
 
     def __init__(self, workdir: str):
         self.workdir = workdir
+        self.python = sys.executable
 
     def launch(self, name: str, argv: Sequence[str], env: Dict[str, str]) -> _Proc:
         log_path = os.path.join(self.workdir, f"{name}.log")
@@ -73,14 +74,33 @@ class SSHLauncher:
         self.python = python
         self.ssh_options = list(ssh_options)
 
+    def command(self, argv: Sequence[str], env: Dict[str, str]) -> List[str]:
+        env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        remote_cmd = f"{env_prefix} {' '.join(shlex.quote(a) for a in argv)}".strip()
+        return ["ssh", *self.ssh_options, self.host, remote_cmd]
+
+    def ship_commands(self, paths: Sequence[str]) -> List[List[str]]:
+        """Commands copying local files to the SAME absolute paths remotely
+        (the reference `put`s model tarballs + recipes the same way,
+        driver_session.py:542-556)."""
+        dirs = sorted({os.path.dirname(os.path.abspath(p)) for p in paths})
+        mkdir = " && ".join(f"mkdir -p {shlex.quote(d)}" for d in dirs)
+        cmds: List[List[str]] = [["ssh", *self.ssh_options, self.host, mkdir]]
+        for p in paths:
+            p = os.path.abspath(p)
+            cmds.append(["scp", "-q", *self.ssh_options, p,
+                         f"{self.host}:{p}"])
+        return cmds
+
+    def ship(self, paths: Sequence[str]) -> None:
+        for cmd in self.ship_commands(paths):
+            subprocess.run(cmd, check=True)
+
     def launch(self, name: str, argv: Sequence[str], env: Dict[str, str]) -> _Proc:
         log_path = os.path.join(self.workdir, f"{name}.log")
         log = open(log_path, "w")
-        env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-        remote_cmd = f"{env_prefix} {' '.join(shlex.quote(a) for a in argv)}"
         process = subprocess.Popen(
-            ["ssh", *self.ssh_options, self.host, remote_cmd],
-            stdout=log, stderr=subprocess.STDOUT)
+            self.command(argv, env), stdout=log, stderr=subprocess.STDOUT)
         return _Proc(name, process, log_path)
 
 
@@ -92,6 +112,8 @@ class DriverSession:
     inside the learner process.
     """
 
+    _LOCAL_HOSTS = ("", "localhost", "127.0.0.1")
+
     def __init__(
         self,
         config: FederationConfig,
@@ -99,6 +121,8 @@ class DriverSession:
         learner_recipes: Sequence[Callable[[], tuple]],
         workdir: Optional[str] = None,
         learner_env: Optional[Dict[str, str]] = None,
+        launcher_factory: Optional[Callable[[str], Any]] = None,
+        resume: bool = False,
     ):
         self.config = config
         self.initial_blob = pack_model(initial_model_variables)
@@ -106,6 +130,9 @@ class DriverSession:
         self.workdir = workdir or tempfile.mkdtemp(prefix="metisfl_tpu_")
         os.makedirs(self.workdir, exist_ok=True)
         self.learner_env = learner_env or {}
+        self.resume = resume
+        self._launcher_factory = launcher_factory
+        self._local_launcher = LocalLauncher(self.workdir)
         self._procs: List[_Proc] = []
         self._client: Optional[ControllerClient] = None
         self._started_at = 0.0
@@ -114,44 +141,119 @@ class DriverSession:
     # bootstrap
     # ------------------------------------------------------------------ #
 
+    def _launcher_for(self, hostname: str):
+        """Local subprocess for localhost endpoints, SSH otherwise
+        (the reference always SSHes, even to localhost — driver_session.py:506)."""
+        if self._launcher_factory is not None:
+            return self._launcher_factory(hostname)
+        if hostname in self._LOCAL_HOSTS:
+            return self._local_launcher
+        return SSHLauncher(hostname, self.workdir)
+
+    def _endpoint(self, idx: int):
+        if idx < len(self.config.learners):
+            return self.config.learners[idx]
+        from metisfl_tpu.config import LearnerEndpoint
+        return LearnerEndpoint()
+
+    def _ssl_files(self) -> List[str]:
+        if not self.config.ssl.enabled:
+            return []
+        return [p for p in (self.config.ssl.cert_path,
+                            self.config.ssl.key_path) if p]
+
+    def _base_env(self) -> Dict[str, str]:
+        # make the package importable in child processes regardless of cwd
+        import metisfl_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(metisfl_tpu.__file__)))
+        pythonpath = os.pathsep.join(
+            p for p in (pkg_root, os.environ.get("PYTHONPATH", "")) if p)
+        return {"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+                "PYTHONPATH": pythonpath}
+
     def initialize_federation(self, health_retries: int = 30,
                               health_sleep_s: float = 1.0) -> None:
-        launcher = LocalLauncher(self.workdir)
+        # TLS: generate the federation's self-signed pair on first boot
+        # (reference driver keygen posture, ssl_configurator.py:21-30)
+        if self.config.ssl.enabled and not self.config.ssl.cert_path:
+            from metisfl_tpu.comm.ssl import generate_self_signed
+            hosts = sorted(
+                {ep.hostname for ep in self.config.learners}
+                | {self.config.controller_host} | set(self.config.ssl.hosts)
+            )
+            cert, key = generate_self_signed(
+                os.path.join(self.workdir, "tls"),
+                hosts=[h for h in hosts if h not in self._LOCAL_HOSTS])
+            self.config.ssl.cert_path, self.config.ssl.key_path = cert, key
 
         config_path = os.path.join(self.workdir, "federation_config.bin")
         with open(config_path, "wb") as f:
             f.write(self.config.to_wire())
+        self._config_path = config_path
 
-        self._procs.append(launcher.launch(
-            "controller",
-            [sys.executable, "-m", "metisfl_tpu.controller",
-             "--config", config_path, "--port", str(self.config.controller_port)],
-            env={"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
-        ))
+        ctrl_host = self.config.controller_host or "localhost"
+        ctrl_launcher = self._launcher_for(ctrl_host)
+        ctrl_argv = [getattr(ctrl_launcher, "python", sys.executable),
+                     "-m", "metisfl_tpu.controller",
+                     "--config", config_path,
+                     "--port", str(self.config.controller_port)]
+        if self.resume:
+            ctrl_argv.append("--resume")
+        if isinstance(ctrl_launcher, SSHLauncher):
+            ctrl_launcher.ship([config_path] + self._ssl_files())
+        self._procs.append(ctrl_launcher.launch(
+            "controller", ctrl_argv, env=self._base_env()))
 
-        self._client = ControllerClient("localhost", self.config.controller_port)
+        self._client = ControllerClient(ctrl_host, self.config.controller_port,
+                                        ssl=self.config.ssl)
         self._wait_healthy(health_retries, health_sleep_s)
 
         # ship initial model (reference _ship_model_to_controller :334-342)
-        self._client.replace_community_model(self.initial_blob)
+        # unless resuming from a checkpointed community model (cheap check:
+        # a restored controller reports its checkpointed round counter)
+        if not (self.resume
+                and self._client.get_statistics()["global_iteration"] > 0):
+            self._client.replace_community_model(self.initial_blob)
 
-        for idx, recipe in enumerate(self.learner_recipes):
-            recipe_path = os.path.join(self.workdir, f"learner_{idx}_recipe.pkl")
-            with open(recipe_path, "wb") as f:
-                cloudpickle.dump(recipe, f)
-            port = 50052 + idx
-            self._procs.append(launcher.launch(
-                f"learner_{idx}",
-                [sys.executable, "-m", "metisfl_tpu.learner",
-                 "--controller-host", "localhost",
-                 "--controller-port", str(self.config.controller_port),
-                 "--advertise-host", "localhost",
-                 "--port", str(port),
-                 "--recipe", recipe_path],
-                env={"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
-                     **self.learner_env},
-            ))
+        for idx in range(len(self.learner_recipes)):
+            self.launch_learner(idx)
         self._started_at = time.time()
+
+    def launch_learner(self, idx: int) -> _Proc:
+        """(Re)launch learner ``idx`` on its configured endpoint. Ports come
+        from the endpoint config or are ephemeral (the learner reports its
+        bound port on join); credentials persist in the workdir so a
+        relaunched learner rejoins as itself."""
+        recipe_path = os.path.join(self.workdir, f"learner_{idx}_recipe.pkl")
+        if not os.path.exists(recipe_path):
+            with open(recipe_path, "wb") as f:
+                cloudpickle.dump(self.learner_recipes[idx], f)
+        ep = self._endpoint(idx)
+        launcher = self._launcher_for(ep.hostname)
+        name = f"learner_{idx}"
+        argv = [getattr(launcher, "python", sys.executable),
+                "-m", "metisfl_tpu.learner",
+                "--controller-host", self.config.controller_host or "localhost",
+                "--controller-port", str(self.config.controller_port),
+                "--advertise-host", ep.hostname or "localhost",
+                "--port", str(ep.port),
+                "--recipe", recipe_path,
+                "--credentials-dir",
+                os.path.join(self.workdir, f"{name}_creds")]
+        if self.config.ssl.enabled:
+            argv += ["--ssl-cert", self.config.ssl.cert_path,
+                     "--ssl-key", self.config.ssl.key_path]
+        if isinstance(launcher, SSHLauncher):
+            # remote host: copy the recipe + TLS material to the same
+            # absolute paths (metisfl_tpu itself must be installed remotely)
+            launcher.ship([recipe_path] + self._ssl_files())
+        # a relaunch replaces the tracked (dead) process of the same name
+        self._procs = [p for p in self._procs if p.name != name]
+        proc = launcher.launch(name, argv,
+                               env={**self._base_env(), **self.learner_env})
+        self._procs.append(proc)
+        return proc
 
     def _wait_healthy(self, retries: int, sleep_s: float) -> None:
         last_exc: Optional[Exception] = None
@@ -232,14 +334,21 @@ class DriverSession:
         return path
 
     def shutdown_federation(self, timeout_s: float = 15.0) -> None:
-        # learners first (reference _shutdown :344-364), then the controller
+        # learners first (reference _shutdown :344-364), then the controller —
+        # dialing the endpoints learners actually registered on join, not
+        # assumed port arithmetic
         from metisfl_tpu.comm.rpc import RpcClient
         from metisfl_tpu.controller.service import LEARNER_SERVICE
 
-        for idx in range(len(self.learner_recipes)):
+        endpoints: List[dict] = []
+        try:
+            endpoints = self._client.list_learners() if self._client else []
+        except Exception:  # noqa: BLE001 - controller may already be gone
+            pass
+        for ep in endpoints:
             try:
-                client = RpcClient("localhost", 50052 + idx, LEARNER_SERVICE,
-                                   retries=0)
+                client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,
+                                   retries=0, ssl=self.config.ssl)
                 client.call("ShutDown", b"", timeout=5.0, wait_ready=False)
                 client.close()
             except Exception:  # noqa: BLE001 - learner may already be gone

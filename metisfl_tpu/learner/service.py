@@ -21,9 +21,10 @@ logger = logging.getLogger("metisfl_tpu.learner.service")
 
 
 class LearnerServer:
-    def __init__(self, learner: Learner, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, learner: Learner, host: str = "0.0.0.0", port: int = 0,
+                 ssl=None):
         self.learner = learner
-        self._server = RpcServer(host, port)
+        self._server = RpcServer(host, port, ssl=ssl)
         self._server.add_service(BytesService(LEARNER_SERVICE, {
             "RunTask": self._run_task,
             "EvaluateModel": self._evaluate,

@@ -1,0 +1,213 @@
+"""Deployment plumbing: TLS transport, launcher selection, credential
+persistence, and the multi-process crash-rejoin federation
+(reference driver_session.py:506-582, learner.py:96-103,
+ssl_configurator.py:16-80)."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    LearnerEndpoint,
+    TerminationConfig,
+)
+from metisfl_tpu.driver.session import DriverSession, LocalLauncher, SSHLauncher
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------- #
+# TLS
+# ---------------------------------------------------------------------- #
+
+class TestTLS:
+    def test_secure_roundtrip_and_plaintext_rejected(self, tmp_path):
+        import grpc
+
+        from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
+        from metisfl_tpu.comm.ssl import SSLConfig, generate_self_signed
+
+        cert, key = generate_self_signed(str(tmp_path))
+        ssl = SSLConfig(enabled=True, cert_path=cert, key_path=key)
+        server = RpcServer("127.0.0.1", 0, ssl=ssl)
+        server.add_service(BytesService("t.Echo", {"Echo": lambda b: b}))
+        port = server.start()
+        try:
+            client = RpcClient("127.0.0.1", port, "t.Echo", ssl=ssl)
+            assert client.call("Echo", b"\x00secret", timeout=10) == b"\x00secret"
+            client.close()
+            # a plaintext client must NOT get through to a TLS server
+            bad = RpcClient("127.0.0.1", port, "t.Echo", retries=0)
+            with pytest.raises(grpc.RpcError):
+                bad.call("Echo", b"x", timeout=5, wait_ready=False)
+            bad.close()
+        finally:
+            server.stop()
+
+    def test_generated_cert_covers_extra_hosts(self, tmp_path):
+        from cryptography import x509
+
+        from metisfl_tpu.comm.ssl import generate_self_signed
+
+        cert_path, _ = generate_self_signed(
+            str(tmp_path), hosts=["worker1.example.com", "10.0.0.5"])
+        cert = x509.load_pem_x509_certificate(open(cert_path, "rb").read())
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        names = {str(n.value) for n in sans}
+        assert {"localhost", "worker1.example.com", "127.0.0.1", "10.0.0.5"} \
+            <= names
+
+
+# ---------------------------------------------------------------------- #
+# launchers
+# ---------------------------------------------------------------------- #
+
+class TestLaunchers:
+    def test_ssh_command_shape(self):
+        launcher = SSHLauncher("worker1", "/tmp/w", python="python3",
+                               ssh_options=["-o", "BatchMode=yes"])
+        cmd = launcher.command(
+            ["python3", "-m", "metisfl_tpu.learner", "--port", "0"],
+            {"JAX_PLATFORMS": "cpu"})
+        assert cmd[:4] == ["ssh", "-o", "BatchMode=yes", "worker1"]
+        assert cmd[4].startswith("JAX_PLATFORMS=cpu ")
+        assert "python3 -m metisfl_tpu.learner --port 0" in cmd[4]
+
+    def test_launcher_selected_per_endpoint(self, tmp_path):
+        cfg = FederationConfig(learners=[
+            LearnerEndpoint(hostname="localhost"),
+            LearnerEndpoint(hostname="10.0.0.5"),
+        ])
+        session = DriverSession(
+            cfg, {"params": {"w": np.zeros(2, np.float32)}},
+            [lambda: None, lambda: None], workdir=str(tmp_path))
+        assert isinstance(session._launcher_for("localhost"), LocalLauncher)
+        assert isinstance(session._launcher_for(""), LocalLauncher)
+        remote = session._launcher_for("10.0.0.5")
+        assert isinstance(remote, SSHLauncher)
+        assert remote.host == "10.0.0.5"
+
+
+# ---------------------------------------------------------------------- #
+# credentials
+# ---------------------------------------------------------------------- #
+
+def test_credentials_roundtrip(tmp_path):
+    from metisfl_tpu.learner.__main__ import load_credentials, save_credentials
+
+    assert load_credentials(str(tmp_path)) == ("", "")
+    save_credentials(str(tmp_path), "L1_host_1", "tok123")
+    assert load_credentials(str(tmp_path)) == ("L1_host_1", "tok123")
+
+
+# ---------------------------------------------------------------------- #
+# multi-process federation: dynamic ports + crash-rejoin
+# ---------------------------------------------------------------------- #
+
+def test_multiprocess_crash_rejoin(tmp_path):
+    """2-learner localhost federation over real gRPC with ephemeral learner
+    ports; learner 1 is killed after round 1 and relaunched — it must rejoin
+    as the SAME learner (persisted credentials) and the federation must
+    finish its rounds (VERDICT next-round item 5)."""
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((4, 2)).astype(np.float32)
+
+    def make_recipe(seed):
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+
+        def recipe():
+            ops = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                               np.zeros((2, 4), np.float32), rng_seed=0)
+            return ops, ArrayDataset(x, y, seed=seed)
+
+        return recipe
+
+    template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                            np.zeros((2, 4), np.float32),
+                            rng_seed=0).get_variables()
+    config = FederationConfig(
+        controller_port=_free_port(),
+        round_deadline_secs=20.0,  # safety net if the kill lands mid-round
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=3),
+    )
+    session = DriverSession(config, template,
+                            [make_recipe(0), make_recipe(1)],
+                            workdir=str(tmp_path))
+
+    def wait_rounds(n, timeout_s):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            session._check_procs_alive()
+            if session.get_statistics()["global_iteration"] >= n:
+                return True
+            time.sleep(0.5)
+        return False
+
+    try:
+        session.initialize_federation()
+        assert wait_rounds(1, 90), "round 1 never completed"
+
+        victim = next(p for p in session._procs if p.name == "learner_1")
+        victim.process.kill()
+        victim.process.wait(timeout=10)
+        session.launch_learner(1)
+
+        assert wait_rounds(3, 120), "rounds stalled after crash-restart"
+        stats = session.get_statistics()
+        # rejoined as the same learner — not registered as a third one
+        assert len(stats["learners"]) == 2
+        log = open(tmp_path / "learner_1.log").read()
+        assert "rejoined=True" in log
+    finally:
+        session.shutdown_federation()
+
+
+def test_ssh_ship_commands_same_absolute_paths(tmp_path):
+    launcher = SSHLauncher("worker1", "/tmp/w", ssh_options=["-p", "2222"])
+    recipe = str(tmp_path / "r.pkl")
+    cert = str(tmp_path / "tls" / "cert.pem")
+    cmds = launcher.ship_commands([recipe, cert])
+    # one mkdir over ssh covering both parent dirs, then one scp per file
+    assert cmds[0][:4] == ["ssh", "-p", "2222", "worker1"]
+    assert f"mkdir -p {tmp_path}" in cmds[0][4]
+    assert f"mkdir -p {tmp_path / 'tls'}" in cmds[0][4]
+    assert cmds[1] == ["scp", "-q", "-p", "2222", recipe, f"worker1:{recipe}"]
+    assert cmds[2] == ["scp", "-q", "-p", "2222", cert, f"worker1:{cert}"]
+
+
+def test_join_dispatch_does_not_postpone_round_deadline():
+    """A (re)joining learner's initial dispatch must not restart the
+    in-flight round's straggler timer (a crash-looping learner would
+    otherwise postpone the deadline forever)."""
+    from metisfl_tpu.controller.core import Controller
+
+    cfg = FederationConfig(round_deadline_secs=300.0,
+                           train=TrainParams(batch_size=8))
+    ctrl = Controller(cfg, lambda record: None)
+    try:
+        ctrl._arm_round_deadline(restart=True)
+        serial = ctrl._round_serial
+        ctrl._arm_round_deadline(restart=False)  # live timer → no-op
+        assert ctrl._round_serial == serial
+        ctrl._arm_round_deadline(restart=True)   # round dispatch → restart
+        assert ctrl._round_serial == serial + 1
+    finally:
+        ctrl.shutdown()
