@@ -21,6 +21,10 @@ logger = logging.getLogger("metisfl_tpu.rpc")
 _UNLIMITED = [
     ("grpc.max_send_message_length", -1),
     ("grpc.max_receive_message_length", -1),
+    # gRPC servers default to SO_REUSEPORT on Linux: two federations (or a
+    # stale controller from a crashed run) binding the same port would
+    # silently load-balance RPCs between unrelated processes. Fail loudly.
+    ("grpc.so_reuseport", 0),
 ]
 
 _IDENTITY = lambda b: b  # noqa: E731 - bytes in, bytes out

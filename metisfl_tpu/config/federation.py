@@ -50,10 +50,15 @@ class ModelStoreConfig:
 class SecureAggConfig:
     enabled: bool = False
     scheme: str = "masking"                  # masking | ckks | identity
-    # CKKS params (reference ckks_scheme.cc:13-75 defaults)
+    # CKKS params (reference ckks_scheme.cc:13-75 defaults; the native ring
+    # packs 8192 coefficients regardless — kept for config parity)
     batch_size: int = 4096
     scaling_factor_bits: int = 52
     key_dir: str = ""
+    # masking: the controller must know the party count to verify that all
+    # masks cancel; the driver fills this in (secrets never enter this
+    # config — they travel in per-learner secure files only)
+    num_parties: int = 0
 
 
 @dataclass

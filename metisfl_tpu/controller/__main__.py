@@ -18,6 +18,8 @@ from metisfl_tpu.controller.service import ControllerServer, RpcLearnerProxy
 
 
 def main(argv=None) -> int:
+    from metisfl_tpu.platform import honor_platform_env
+    honor_platform_env()
     parser = argparse.ArgumentParser("metisfl_tpu.controller")
     parser.add_argument("--config", required=True,
                         help="path to FederationConfig (.bin codec or .yaml)")
@@ -42,7 +44,16 @@ def main(argv=None) -> int:
     secure_backend = None
     if config.secure.enabled:
         from metisfl_tpu.secure import make_backend
-        secure_backend = make_backend(config.secure, role="controller")
+        kwargs = {}
+        if config.secure.scheme == "masking":
+            num_parties = config.secure.num_parties or len(config.learners)
+            if num_parties <= 0:
+                parser.error(
+                    "masking secure aggregation needs secure.num_parties "
+                    "(the driver fills it in) or a configured learner list")
+            kwargs["num_parties"] = num_parties
+        secure_backend = make_backend(config.secure, role="controller",
+                                      **kwargs)
 
     controller = Controller(
         config,

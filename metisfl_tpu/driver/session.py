@@ -79,6 +79,19 @@ class SSHLauncher:
         remote_cmd = f"{env_prefix} {' '.join(shlex.quote(a) for a in argv)}".strip()
         return ["ssh", *self.ssh_options, self.host, remote_cmd]
 
+    def _scp_options(self) -> List[str]:
+        """ssh_options translated for scp: the flags overlap except the port
+        (`ssh -p` vs `scp -P`; to scp, `-p` means preserve-times and the port
+        number would parse as a stray source operand)."""
+        out: List[str] = []
+        it = iter(self.ssh_options)
+        for opt in it:
+            if opt == "-p":
+                out += ["-P", next(it, "")]
+            else:
+                out.append(opt)
+        return out
+
     def ship_commands(self, paths: Sequence[str]) -> List[List[str]]:
         """Commands copying local files to the SAME absolute paths remotely
         (the reference `put`s model tarballs + recipes the same way,
@@ -86,10 +99,10 @@ class SSHLauncher:
         dirs = sorted({os.path.dirname(os.path.abspath(p)) for p in paths})
         mkdir = " && ".join(f"mkdir -p {shlex.quote(d)}" for d in dirs)
         cmds: List[List[str]] = [["ssh", *self.ssh_options, self.host, mkdir]]
+        scp_opts = self._scp_options()
         for p in paths:
             p = os.path.abspath(p)
-            cmds.append(["scp", "-q", *self.ssh_options, p,
-                         f"{self.host}:{p}"])
+            cmds.append(["scp", "-q", *scp_opts, p, f"{self.host}:{p}"])
         return cmds
 
     def ship(self, paths: Sequence[str]) -> None:
@@ -136,6 +149,9 @@ class DriverSession:
         self._procs: List[_Proc] = []
         self._client: Optional[ControllerClient] = None
         self._started_at = 0.0
+        # last successfully observed learner endpoints — the shutdown
+        # fallback when the controller has already died
+        self._known_endpoints: List[dict] = []
 
     # ------------------------------------------------------------------ #
     # bootstrap
@@ -172,8 +188,57 @@ class DriverSession:
         return {"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
                 "PYTHONPATH": pythonpath}
 
+    def _prepare_secure(self) -> None:
+        """Generate + distribute secure-aggregation material (the reference's
+        driver-side HE keygen and key shipping, driver_session.py:110-140):
+        CKKS keys or the masking federation secret go into per-learner files;
+        the controller's config carries only what it must know (party count /
+        scheme) — never decryption capability."""
+        cfg = self.config.secure
+        if not cfg.enabled:
+            return
+        if cfg.scheme == "ckks":
+            key_dir = cfg.key_dir or os.path.join(self.workdir, "he_keys")
+            if not os.path.exists(os.path.join(key_dir, "sk.bin")):
+                from metisfl_tpu.secure.ckks import generate_keys
+                generate_keys(key_dir)
+            cfg.key_dir = key_dir
+            per_learner = {"scheme": "ckks", "key_dir": key_dir, "kwargs": {}}
+            learner_files = [per_learner] * len(self.learner_recipes)
+        elif cfg.scheme == "masking":
+            import secrets as _secrets
+            cfg.num_parties = len(self.learner_recipes)
+            secret = _secrets.token_hex(32)
+            learner_files = [
+                {"scheme": "masking", "kwargs": {
+                    "federation_secret": secret, "party_index": idx,
+                    "num_parties": cfg.num_parties}}
+                for idx in range(len(self.learner_recipes))
+            ]
+        else:  # identity
+            learner_files = [{"scheme": cfg.scheme, "kwargs": {}}
+                             for _ in self.learner_recipes]
+        from metisfl_tpu.comm.codec import dumps as codec_dumps
+        for idx, payload in enumerate(learner_files):
+            path = os.path.join(self.workdir, f"learner_{idx}_secure.bin")
+            with open(path, "wb") as f:
+                f.write(codec_dumps(payload))
+            os.chmod(path, 0o600)
+
+    def _secure_files(self, idx: int) -> List[str]:
+        """Files learner ``idx`` needs for secure aggregation (for SSH ship)."""
+        if not self.config.secure.enabled:
+            return []
+        files = [os.path.join(self.workdir, f"learner_{idx}_secure.bin")]
+        if self.config.secure.scheme == "ckks":
+            key_dir = self.config.secure.key_dir
+            files += [os.path.join(key_dir, "pk.bin"),
+                      os.path.join(key_dir, "sk.bin")]
+        return files
+
     def initialize_federation(self, health_retries: int = 30,
                               health_sleep_s: float = 1.0) -> None:
+        self._prepare_secure()
         # TLS: generate the federation's self-signed pair on first boot
         # (reference driver keygen posture, ssl_configurator.py:21-30)
         if self.config.ssl.enabled and not self.config.ssl.cert_path:
@@ -244,10 +309,14 @@ class DriverSession:
         if self.config.ssl.enabled:
             argv += ["--ssl-cert", self.config.ssl.cert_path,
                      "--ssl-key", self.config.ssl.key_path]
+        if self.config.secure.enabled:
+            argv += ["--secure-config",
+                     os.path.join(self.workdir, f"learner_{idx}_secure.bin")]
         if isinstance(launcher, SSHLauncher):
-            # remote host: copy the recipe + TLS material to the same
+            # remote host: copy the recipe + TLS/secure material to the same
             # absolute paths (metisfl_tpu itself must be installed remotely)
-            launcher.ship([recipe_path] + self._ssl_files())
+            launcher.ship([recipe_path] + self._ssl_files()
+                          + self._secure_files(idx))
         # a relaunch replaces the tracked (dead) process of the same name
         self._procs = [p for p in self._procs if p.name != name]
         proc = launcher.launch(name, argv,
@@ -287,6 +356,10 @@ class DriverSession:
             time.sleep(poll_every_s)
             self._check_procs_alive()
             stats = self._client.get_statistics()
+            try:
+                self._known_endpoints = self._client.list_learners()
+            except Exception:  # noqa: BLE001 - keep the stale snapshot
+                pass
 
             if stats["global_iteration"] >= term.federation_rounds > 0:
                 logger.info("termination: reached %d rounds",
@@ -344,7 +417,15 @@ class DriverSession:
         try:
             endpoints = self._client.list_learners() if self._client else []
         except Exception:  # noqa: BLE001 - controller may already be gone
-            pass
+            # fall back to the last snapshot (+ any statically configured
+            # endpoints) so remote learners still get a ShutDown even when
+            # the controller died first
+            endpoints = list(self._known_endpoints)
+            known = {(e["hostname"], e["port"]) for e in endpoints}
+            for ep in self.config.learners:
+                if ep.port and (ep.hostname, ep.port) not in known:
+                    endpoints.append({"hostname": ep.hostname,
+                                      "port": ep.port})
         for ep in endpoints:
             try:
                 client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,

@@ -51,6 +51,8 @@ def save_credentials(creds_dir: str, learner_id: str, auth_token: str) -> None:
 
 
 def main(argv=None) -> int:
+    from metisfl_tpu.platform import honor_platform_env
+    honor_platform_env()
     parser = argparse.ArgumentParser("metisfl_tpu.learner")
     parser.add_argument("--controller-host", default="localhost")
     parser.add_argument("--controller-port", type=int, required=True)
@@ -70,6 +72,9 @@ def main(argv=None) -> int:
     parser.add_argument("--ssl-cert", default="",
                         help="federation TLS cert (enables TLS client+server)")
     parser.add_argument("--ssl-key", default="")
+    parser.add_argument("--secure-config", default="",
+                        help="codec file with the driver-distributed secure-"
+                             "aggregation material (scheme + keys/secret)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -83,6 +88,19 @@ def main(argv=None) -> int:
     val_ds = built[2] if len(built) > 2 else None
     test_ds = built[3] if len(built) > 3 else None
     secure_backend = built[4] if len(built) > 4 else None
+
+    if secure_backend is None and args.secure_config:
+        # driver-distributed secure material (reference ships HE keys to
+        # learners the same way, driver_session.py:134-140)
+        from metisfl_tpu.comm.codec import loads as codec_loads
+        from metisfl_tpu.config import SecureAggConfig
+        from metisfl_tpu.secure import make_backend
+        with open(args.secure_config, "rb") as f:
+            sc = codec_loads(f.read())
+        secure_backend = make_backend(
+            SecureAggConfig(enabled=True, scheme=sc["scheme"],
+                            key_dir=sc.get("key_dir", "")),
+            role="learner", **sc.get("kwargs", {}))
 
     ssl = None
     if args.ssl_cert:
@@ -122,8 +140,13 @@ def main(argv=None) -> int:
     print(f"METISFL_TPU_LEARNER_JOINED id={reply.learner_id} "
           f"rejoined={reply.rejoined}", flush=True)
 
-    signal.signal(signal.SIGTERM, lambda *_: server.stop())
-    signal.signal(signal.SIGINT, lambda *_: server.stop())
+    def _on_signal(signum, _frame):
+        logging.getLogger("metisfl_tpu.learner").info(
+            "received signal %d; shutting down", signum)
+        server.stop()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
     server.wait_for_shutdown()
     return 0
 

@@ -47,6 +47,7 @@ class LearnerServer:
         return dumps({"status": "SERVING", "tasks_received": self._tasks_received})
 
     def _shutdown_rpc(self, raw: bytes) -> bytes:
+        logger.info("learner ShutDown RPC received")
         threading.Thread(target=self.stop, daemon=True).start()
         return dumps({"ok": True})
 
@@ -58,6 +59,7 @@ class LearnerServer:
     def stop(self, leave: bool = True) -> None:
         if self._shutdown_event.is_set():
             return
+        logger.info("learner server stopping (leave=%s)", leave)
         self._shutdown_event.set()
         try:
             if leave:

@@ -1,0 +1,82 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+The reference builds its native layer with Bazel + pybind11
+(reference WORKSPACE:1-120, controller/pybind/controller_pybind.cc:17-50);
+this rebuild compiles a small C-ABI shared library with ``g++`` on first use
+(pybind11 is not available here — Python binds via ctypes) and caches the
+``.so`` next to the source. Concurrent builders (learner subprocesses) race
+safely: the compile goes to a unique temp file then ``os.replace``s into
+place atomically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ckks.cc")
+_SO = os.path.join(_DIR, "libmetisfl_ckks.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _needs_build() -> bool:
+    return (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+
+
+def _build() -> None:
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+    except subprocess.CalledProcessError as exc:
+        raise RuntimeError(
+            f"native CKKS build failed:\n{exc.stderr}") from exc
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_ckks() -> ctypes.CDLL:
+    """Build (if stale) and load the CKKS library with typed signatures."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build():
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.ckks_n.restype = ctypes.c_long
+        lib.ckks_ciphertext_size.restype = ctypes.c_long
+        lib.ckks_ciphertext_size.argtypes = [ctypes.c_long]
+        lib.ckks_keygen.restype = ctypes.c_int
+        lib.ckks_keygen.argtypes = [ctypes.c_char_p]
+        lib.ckks_open.restype = ctypes.c_void_p
+        lib.ckks_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ckks_close.argtypes = [ctypes.c_void_p]
+        lib.ckks_has_secret.restype = ctypes.c_int
+        lib.ckks_has_secret.argtypes = [ctypes.c_void_p]
+        lib.ckks_encrypt.restype = ctypes.c_long
+        lib.ckks_encrypt.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+        lib.ckks_weighted_sum.restype = ctypes.c_long
+        lib.ckks_weighted_sum.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+        lib.ckks_decrypt.restype = ctypes.c_long
+        lib.ckks_decrypt.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+        lib.ckks_selftest.restype = ctypes.c_int
+        _lib = lib
+        return _lib

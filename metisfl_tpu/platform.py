@@ -1,0 +1,17 @@
+"""Process-level platform selection helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Make JAX_PLATFORMS authoritative even when a sitecustomize already
+    imported jax and force-set another platform (e.g. the axon TPU tunnel —
+    multiple federation processes contending for the one tunnel deadlock).
+    Call at process entry, before any jax computation initializes a backend.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+        jax.config.update("jax_platforms", platforms)

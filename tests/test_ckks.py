@@ -1,0 +1,147 @@
+"""Native CKKS-style RLWE homomorphic encryption
+(reference metisfl/encryption/palisade/ckks_scheme.cc:13-252,
+private_weighted_average.cc:22-111)."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.secure.ckks import CKKSBackend, generate_keys
+
+
+@pytest.fixture(scope="module")
+def keys(tmp_path_factory):
+    return generate_keys(str(tmp_path_factory.mktemp("ckks_keys")))
+
+
+@pytest.fixture(scope="module")
+def learner(keys):
+    return CKKSBackend(key_dir=keys, role="learner")
+
+
+@pytest.fixture(scope="module")
+def controller():
+    return CKKSBackend(role="controller")
+
+
+def test_native_selftest():
+    from metisfl_tpu.native import load_ckks
+    assert load_ckks().ckks_selftest() == 0
+
+
+def test_encrypt_decrypt_roundtrip(learner):
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal(10_000)
+    out = learner.decrypt(learner.encrypt(v), 10_000)
+    np.testing.assert_allclose(out, v, atol=2e-6)
+
+
+def test_non_multiple_of_ring_degree(learner):
+    v = np.arange(5, dtype=np.float64)  # far below one 8192-slot block
+    out = learner.decrypt(learner.encrypt(v), 5)
+    np.testing.assert_allclose(out, v, atol=2e-6)
+
+
+def test_ciphertext_reveals_nothing_obvious(learner):
+    v = np.zeros(100)
+    c1, c2 = learner.encrypt(v), learner.encrypt(v)
+    assert c1 != c2  # fresh randomness per encryption
+    body = np.frombuffer(c1[24:], np.uint64)
+    assert body.std() > 0  # not the all-zeros plaintext
+
+
+def test_homomorphic_weighted_average(learner, controller):
+    rng = np.random.default_rng(1)
+    vs = [rng.standard_normal(3000) for _ in range(4)]
+    scales = [0.1, 0.2, 0.3, 0.4]
+    cts = [learner.encrypt(v) for v in vs]
+    combined = controller.weighted_sum(cts, scales)  # keyless combine
+    out = learner.decrypt(combined, 3000)
+    want = sum(s * v for s, v in zip(scales, vs))
+    np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_controller_role_is_keyless(controller):
+    with pytest.raises(RuntimeError, match="cannot encrypt"):
+        controller.encrypt(np.ones(4))
+    with pytest.raises(RuntimeError, match="cannot decrypt"):
+        controller.decrypt(b"\x00" * 64, 4)
+
+
+def test_wrong_key_decrypts_garbage(learner, tmp_path):
+    other = CKKSBackend(key_dir=generate_keys(str(tmp_path / "other")),
+                        role="learner")
+    v = np.ones(256)
+    out = other.decrypt(learner.encrypt(v), 256)
+    assert not np.allclose(out, v, atol=0.5)
+
+
+def test_rejects_oversized_values(learner):
+    with pytest.raises(RuntimeError, match=r"\|v\| <= 63"):
+        learner.encrypt(np.array([1e6]))
+
+
+def test_rejects_mismatched_payloads(learner, controller):
+    a = learner.encrypt(np.ones(100))
+    b = learner.encrypt(np.ones(200))
+    with pytest.raises(RuntimeError):
+        controller.weighted_sum([a, b], [0.5, 0.5])
+
+
+def test_make_backend_dispatch(keys):
+    from metisfl_tpu.config import SecureAggConfig
+    from metisfl_tpu.secure import make_backend
+
+    cfg = SecureAggConfig(enabled=True, scheme="ckks", key_dir=keys)
+    lrn = make_backend(cfg, role="learner")
+    ctl = make_backend(cfg, role="controller")
+    v = np.linspace(-1, 1, 50)
+    out = lrn.decrypt(ctl.weighted_sum([lrn.encrypt(v)], [1.0]), 50)
+    np.testing.assert_allclose(out, v, atol=2e-6)
+
+
+def test_ckks_federation_end_to_end(keys):
+    """In-process encrypted federation: the controller aggregates ciphertexts
+    it cannot read (the reference's PWA path)."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, SecureAggConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    config = FederationConfig(
+        protocol="synchronous",
+        aggregation=AggregationConfig(rule="secure_agg",
+                                      scaler="train_dataset_size"),
+        secure=SecureAggConfig(enabled=True, scheme="ckks", key_dir=keys),
+        train=TrainParams(batch_size=16, local_steps=3, learning_rate=0.05),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=2),
+    )
+    fed = InProcessFederation(
+        config, secure_backend=CKKSBackend(role="controller"))
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((5, 3)).astype(np.float32)
+    template = None
+    for i in range(2):
+        x = rng.standard_normal((48, 5)).astype(np.float32)
+        y = np.argmax(x @ w, axis=-1).astype(np.int32)
+        ds = ArrayDataset(x, y, seed=i)
+        engine = FlaxModelOps(MLP(features=(8,), num_outputs=3), ds.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, ds,
+                        secure_backend=CKKSBackend(key_dir=keys,
+                                                   role="learner"))
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=180)
+        blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
+        assert blob.opaque and not blob.tensors  # ciphertext on the wire
+    finally:
+        fed.shutdown()

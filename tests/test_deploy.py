@@ -168,14 +168,27 @@ def test_multiprocess_crash_rejoin(tmp_path):
         victim = next(p for p in session._procs if p.name == "learner_1")
         victim.process.kill()
         victim.process.wait(timeout=10)
+        at_kill = session.get_statistics()["global_iteration"]
         session.launch_learner(1)
 
-        assert wait_rounds(3, 120), "rounds stalled after crash-restart"
+        # the relaunched process must REJOIN as its old identity (tiny
+        # rounds can sprint ahead on the surviving learner in the meantime,
+        # so gate on the log line, not on a fixed round number)
+        deadline = time.time() + 90
+        log = ""
+        while time.time() < deadline:
+            session._check_procs_alive()
+            log = open(tmp_path / "learner_1.log").read()
+            if "METISFL_TPU_LEARNER_JOINED" in log:
+                break
+            time.sleep(0.5)
+        assert "rejoined=True" in log, f"no rejoin in log: {log[-500:]}"
+
+        # and the federation keeps making rounds after the crash-restart
+        assert wait_rounds(at_kill + 2, 120), "rounds stalled after restart"
         stats = session.get_statistics()
         # rejoined as the same learner — not registered as a third one
         assert len(stats["learners"]) == 2
-        log = open(tmp_path / "learner_1.log").read()
-        assert "rejoined=True" in log
     finally:
         session.shutdown_federation()
 
@@ -185,12 +198,13 @@ def test_ssh_ship_commands_same_absolute_paths(tmp_path):
     recipe = str(tmp_path / "r.pkl")
     cert = str(tmp_path / "tls" / "cert.pem")
     cmds = launcher.ship_commands([recipe, cert])
-    # one mkdir over ssh covering both parent dirs, then one scp per file
+    # one mkdir over ssh covering both parent dirs, then one scp per file;
+    # the ssh port flag -p must translate to scp's -P
     assert cmds[0][:4] == ["ssh", "-p", "2222", "worker1"]
     assert f"mkdir -p {tmp_path}" in cmds[0][4]
     assert f"mkdir -p {tmp_path / 'tls'}" in cmds[0][4]
-    assert cmds[1] == ["scp", "-q", "-p", "2222", recipe, f"worker1:{recipe}"]
-    assert cmds[2] == ["scp", "-q", "-p", "2222", cert, f"worker1:{cert}"]
+    assert cmds[1] == ["scp", "-q", "-P", "2222", recipe, f"worker1:{recipe}"]
+    assert cmds[2] == ["scp", "-q", "-P", "2222", cert, f"worker1:{cert}"]
 
 
 def test_join_dispatch_does_not_postpone_round_deadline():

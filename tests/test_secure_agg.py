@@ -120,3 +120,11 @@ def test_masked_federation_end_to_end():
         assert blob.opaque and not blob.tensors
     finally:
         fed.shutdown()
+
+
+def test_masking_value_bound_scales_with_parties():
+    small = MaskingBackend(num_parties=2)
+    big = MaskingBackend(num_parties=1 << 16)
+    small.encrypt(np.full(4, 1000.0))  # fine for 2 parties
+    with pytest.raises(ValueError, match="supports"):
+        big.encrypt(np.full(4, 1000.0))  # would overflow a 65536-party sum

@@ -1,0 +1,85 @@
+"""Multi-process encrypted federation: driver-side keygen + key/secret
+distribution to learner subprocesses, controller aggregating ciphertexts
+(VERDICT next-round item 4; reference driver_session.py:110-140)."""
+
+import socket
+import time
+
+import numpy as np
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    SecureAggConfig,
+    TerminationConfig,
+)
+from metisfl_tpu.driver.session import DriverSession
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_multiprocess_ckks_federation(tmp_path):
+    """`python -m metisfl_tpu.controller` + 2 learner subprocesses with
+    NOTHING hand-wired: the driver generates CKKS keys, ships them via the
+    per-learner secure files, and the federation completes rounds with the
+    community model as ciphertext end-to-end."""
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.native import load_ckks
+
+    load_ckks()  # build the .so once here, not racing inside the learners
+
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal((4, 2)).astype(np.float32)
+
+    def make_recipe(seed):
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+
+        def recipe():
+            ops = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                               np.zeros((2, 4), np.float32), rng_seed=0)
+            return ops, ArrayDataset(x, y, seed=seed)
+
+        return recipe
+
+    template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                            np.zeros((2, 4), np.float32),
+                            rng_seed=0).get_variables()
+    config = FederationConfig(
+        controller_port=_free_port(),
+        aggregation=AggregationConfig(rule="secure_agg",
+                                      scaler="participants"),
+        secure=SecureAggConfig(enabled=True, scheme="ckks"),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=2),
+    )
+    session = DriverSession(config, template,
+                            [make_recipe(0), make_recipe(1)],
+                            workdir=str(tmp_path))
+    try:
+        session.initialize_federation()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            session._check_procs_alive()
+            if session.get_statistics()["global_iteration"] >= 2:
+                break
+            time.sleep(0.5)
+        stats = session.get_statistics()
+        assert stats["global_iteration"] >= 2, "secure rounds never completed"
+        # the community model on the wire is ciphertext the controller
+        # cannot read (no secret key ever reaches the controller config)
+        from metisfl_tpu.tensor.pytree import ModelBlob
+        blob = ModelBlob.from_bytes(session._client.get_community_model())
+        assert blob.opaque and not blob.tensors
+        assert (tmp_path / "he_keys" / "sk.bin").exists()
+        assert (tmp_path / "learner_0_secure.bin").exists()
+    finally:
+        session.shutdown_federation()
