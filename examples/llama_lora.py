@@ -1,0 +1,109 @@
+"""Federated LoRA fine-tuning of a Llama-style LM with in-learner sharding.
+
+The BASELINE.md north-star shape (Llama-LoRA federation with in-learner
+pjit sharding; the reference has no transformer or TP story at all —
+SURVEY.md §2.3): each learner trains ONLY its LoRA adapters
+(``trainable_regex="lora_"``) with params sharded over a ``dp × tp`` mesh
+per :data:`TRANSFORMER_RULES` (column/row-parallel attention + MLP — XLA
+inserts the all-reduces), and FedAvg merges the rounds.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/llama_lora.py --dim 64 --rounds 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("federated llama-lora")
+    parser.add_argument("--learners", type=int, default=2)
+    parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--dim", type=int, default=64)
+    parser.add_argument("--depth", type=int, default=2)
+    parser.add_argument("--heads", type=int, default=4)
+    parser.add_argument("--vocab", type=int, default=256)
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--lora-rank", type=int, default=8)
+    parser.add_argument("--dp", type=int, default=2)
+    parser.add_argument("--tp", type=int, default=0,
+                        help="0 = absorb remaining devices")
+    args = parser.parse_args()
+
+    from metisfl_tpu.platform import honor_platform_env
+    honor_platform_env()
+
+    import numpy as np
+
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import TRANSFORMER_RULES, LlamaLite
+    from metisfl_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(("dp", "tp"), (args.dp, args.tp)))
+    print(f"mesh: {dict(mesh.shape)}")
+
+    rng = np.random.default_rng(0)
+
+    def lm_shard(seed):
+        # synthetic 'language': order-2 markov tokens, learnable offline
+        trans = rng.dirichlet(np.ones(args.vocab) * 0.05,
+                              size=args.vocab)
+        toks = np.zeros((200, args.seq_len + 1), np.int32)
+        state = rng.integers(0, args.vocab, 200)
+        for t in range(args.seq_len + 1):
+            toks[:, t] = state
+            nxt = [rng.choice(args.vocab, p=trans[s]) for s in state]
+            state = np.asarray(nxt)
+        return ArrayDataset(toks[:, :-1], toks[:, 1:], seed=seed)
+
+    module = LlamaLite(vocab_size=args.vocab, dim=args.dim,
+                       depth=args.depth, heads=args.heads,
+                       lora_rank=args.lora_rank)
+    config = FederationConfig(
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=4, learning_rate=0.01,
+                          optimizer="adam"),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=args.rounds),
+    )
+    fed = InProcessFederation(config)
+    sample = np.zeros((2, args.seq_len), np.int32)
+    template = None
+    for i in range(args.learners):
+        ops = FlaxModelOps(module, sample, rng_seed=0, mesh=mesh,
+                           partition_rules=TRANSFORMER_RULES,
+                           trainable_regex="lora_")
+        if template is None:
+            template = ops.get_variables()
+        else:
+            ops.set_variables(template)
+        fed.add_learner(ops, lm_shard(i))
+    fed.seed_model(template)
+    fed.start()
+    ok = fed.wait_for_rounds(args.rounds, timeout_s=900)
+    stats = fed.statistics()
+    fed.shutdown()
+    print(f"completed {stats['global_iteration']} rounds"
+          + ("" if ok else " (timeout)"))
+    import jax
+    n_total = sum(int(np.size(l)) for l in jax.tree.leaves(template))
+    n_lora = sum(
+        int(np.size(l)) for p, l in
+        jax.tree_util.tree_flatten_with_path(template)[0]
+        if "lora_" in "/".join(str(k) for k in p))
+    print(f"params: {n_total} total, {n_lora} trainable LoRA "
+          f"({100 * n_lora / n_total:.1f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,95 @@
+"""Data tooling for the examples: partitioning + dataset loading.
+
+Capability equivalent of the reference's examples data utilities
+(reference examples/utils/data_partitioning.py:8-124): IID and non-IID
+(label-skew) partitioning of a dataset across N learners.
+
+Dataset loading works offline: this environment has no network egress, so
+``load_fashion_mnist`` reads a local ``.npz`` when given one and otherwise
+generates a *structured synthetic* stand-in with the same shapes — class
+templates + noise, so federated training genuinely learns (the reference
+downloads from keras.datasets, fashionmnist.py:23).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from metisfl_tpu.models.dataset import ArrayDataset
+
+
+def iid_partition(x: np.ndarray, y: np.ndarray, num_learners: int,
+                  seed: int = 0) -> List[ArrayDataset]:
+    """Shuffle and split evenly (reference DataPartitioning.iid_partition)."""
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(x))
+    shards = np.array_split(idx, num_learners)
+    return [ArrayDataset(x[s], y[s], seed=seed + i)
+            for i, s in enumerate(shards)]
+
+
+def non_iid_partition(x: np.ndarray, y: np.ndarray, num_learners: int,
+                      classes_per_learner: int = 2,
+                      seed: int = 0) -> List[ArrayDataset]:
+    """Label-skew partition: each learner draws from a limited class subset
+    (reference DataPartitioning.non_iid_partition's skew scheme)."""
+    rng = np.random.default_rng(seed)
+    classes = np.unique(y)
+    by_class = {c: list(rng.permutation(np.flatnonzero(y == c)))
+                for c in classes}
+    # assign each learner a rotating window of classes
+    picks: List[List[int]] = [[] for _ in range(num_learners)]
+    for i in range(num_learners):
+        owned = [classes[(i + j) % len(classes)]
+                 for j in range(classes_per_learner)]
+        for c in owned:
+            pool = by_class[c]
+            # owners of class c split its remaining examples equally
+            owners = sum(
+                1 for k in range(num_learners)
+                if c in [classes[(k + j) % len(classes)]
+                         for j in range(classes_per_learner)])
+            take = max(1, len(np.flatnonzero(y == c)) // max(1, owners))
+            picks[i].extend(pool[:take])
+            del pool[:take]
+    return [ArrayDataset(x[np.asarray(p, int)], y[np.asarray(p, int)],
+                         seed=seed + i)
+            for i, p in enumerate(picks)]
+
+
+def synthetic_image_classification(
+    n: int = 6000, height: int = 28, width: int = 28, channels: int = 1,
+    num_classes: int = 10, noise: float = 0.35, seed: int = 7,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-template images + Gaussian noise: learnable, offline, and the
+    same shapes/dtypes as Fashion-MNIST."""
+    rng = np.random.default_rng(seed)
+    templates = rng.standard_normal(
+        (num_classes, height, width, channels)).astype(np.float32)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    x = templates[y] + noise * rng.standard_normal(
+        (n, height, width, channels)).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+def load_fashion_mnist(path: Optional[str] = None,
+                       n_synthetic: int = 6000,
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(x_train, y_train, x_test, y_test), normalized to [0,1]-ish floats.
+
+    ``path`` may point to an ``.npz`` with x_train/y_train/x_test/y_test
+    (e.g. a locally cached real dataset). Without one, a structured
+    synthetic stand-in keeps every example runnable offline.
+    """
+    if path and os.path.exists(path):
+        with np.load(path) as data:
+            return (np.asarray(data["x_train"], np.float32) / 255.0,
+                    np.asarray(data["y_train"], np.int32),
+                    np.asarray(data["x_test"], np.float32) / 255.0,
+                    np.asarray(data["y_test"], np.int32))
+    x, y = synthetic_image_classification(n=n_synthetic + n_synthetic // 5)
+    split = n_synthetic
+    return x[:split], y[:split], x[split:], y[split:]

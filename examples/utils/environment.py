@@ -1,0 +1,57 @@
+"""Localhost federation environment generator
+(reference examples/utils/environment_generator.py:9-38: EnvGen writes a
+YAML env for N port-staggered localhost learners; here it returns the typed
+config directly — learner ports stay 0/ephemeral because learners report
+their bound port on join)."""
+
+from __future__ import annotations
+
+import socket
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    LearnerEndpoint,
+    SecureAggConfig,
+    TerminationConfig,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def generate_localhost_env(
+    num_learners: int,
+    rounds: int = 3,
+    protocol: str = "synchronous",
+    batch_size: int = 32,
+    local_epochs: float = 1.0,
+    learning_rate: float = 0.05,
+    secure_scheme: str = "",
+    round_deadline_secs: float = 0.0,
+) -> FederationConfig:
+    secure = SecureAggConfig()
+    agg = AggregationConfig(scaler="train_dataset_size")
+    if secure_scheme:
+        secure = SecureAggConfig(enabled=True, scheme=secure_scheme)
+        agg = AggregationConfig(
+            rule="secure_agg",
+            scaler="participants" if secure_scheme == "masking"
+            else "train_dataset_size")
+    return FederationConfig(
+        protocol=protocol,
+        controller_port=free_port(),
+        round_deadline_secs=round_deadline_secs,
+        aggregation=agg,
+        secure=secure,
+        train=TrainParams(batch_size=batch_size, local_epochs=local_epochs,
+                          learning_rate=learning_rate),
+        eval=EvalConfig(batch_size=256, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=rounds),
+        learners=[LearnerEndpoint() for _ in range(num_learners)],
+    )

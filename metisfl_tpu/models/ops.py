@@ -110,11 +110,22 @@ class FlaxModelOps:
         loss: str | Callable = "softmax_cross_entropy",
         rng_seed: int = 0,
         variables: Optional[Pytree] = None,
+        mesh=None,
+        partition_rules=None,
+        trainable_regex: str = "",
     ):
+        """``mesh`` + ``partition_rules`` enable in-learner sharded training
+        (TP/FSDP via pjit — the Llama-LoRA ladder config; SURVEY.md §2.3):
+        params are placed per the rules, batches are sharded over the data
+        axes, and XLA inserts the collectives. ``trainable_regex`` freezes
+        every param NOT matching it (LoRA fine-tuning: ``"lora_"``)."""
         self.module = module
         self._loss_name = loss if isinstance(loss, str) else getattr(loss, "__name__", "custom")
         self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
         self._rng = jax.random.PRNGKey(rng_seed)
+        self.mesh = mesh
+        self.partition_rules = list(partition_rules or [])
+        self._trainable_regex = trainable_regex
         if variables is not None:
             self.variables = variables
         else:
@@ -125,8 +136,36 @@ class FlaxModelOps:
                 {"params": self._rng, "dropout": jax.random.fold_in(self._rng, 1)},
                 jnp.asarray(sample_input), **init_kwargs)
         self._has_batch_stats = "batch_stats" in self.variables
+        if self.mesh is not None:
+            self.variables = self._shard(self.variables)
         self._step_cache: Dict[tuple, Callable] = {}
         self._eval_cache: Dict[Tuple[str, ...], Callable] = {}
+
+    # -- sharded placement -------------------------------------------------
+    def _shard(self, variables: Pytree) -> Pytree:
+        from metisfl_tpu.parallel.sharding import tree_shardings
+        shardings = tree_shardings(variables, self.mesh, self.partition_rules)
+        # device_put handles host numpy directly, transferring each device
+        # only its shard — no full-model staging on one device first
+        return jax.device_put(variables, shardings)
+
+    def _data_axis_size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in ("dp", "fsdp")
+                            if a in self.mesh.shape]))
+
+    def _shard_batch(self, arr):
+        """Shard the leading (batch) dimension over the mesh's data axes."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.shape)
+        n = self._data_axis_size()
+        if n > 1 and arr.shape[0] % n:
+            raise ValueError(
+                f"batch of {arr.shape[0]} examples is not divisible by the "
+                f"mesh data axes {data_axes} (size {n}); pick a batch_size "
+                f"that is a multiple of {n} and shards with >= batch_size "
+                "examples")
+        spec = PartitionSpec(data_axes if data_axes else None)
+        return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
     # -- module introspection ---------------------------------------------
     def _accepts_train_kwarg(self) -> bool:
@@ -148,7 +187,10 @@ class FlaxModelOps:
         return jax.device_get(self.variables)
 
     def set_variables(self, variables: Pytree) -> None:
-        self.variables = jax.tree.map(jnp.asarray, variables)
+        if self.mesh is not None:
+            self.variables = self._shard(variables)
+        else:
+            self.variables = jax.tree.map(jnp.asarray, variables)
 
     # -- training ----------------------------------------------------------
     def _make_step(self, params_cfg: TrainParams):
@@ -164,6 +206,28 @@ class FlaxModelOps:
 
         tx = make_optimizer(params_cfg.optimizer, params_cfg.learning_rate,
                             params_cfg.optimizer_kwargs)
+        if self._trainable_regex:
+            import re as _re
+
+            from metisfl_tpu.tensor.pytree import _key_to_name
+
+            regex = self._trainable_regex
+
+            def _labels(params):
+                flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+                labels = ["train" if _re.search(regex, _key_to_name(p))
+                          else "freeze" for p, _ in flat]
+                if "train" not in labels:
+                    raise ValueError(
+                        f"trainable_regex {regex!r} matches no params — "
+                        "training would silently be a no-op (did you forget "
+                        "lora_rank > 0?)")
+                return jax.tree_util.tree_unflatten(treedef, labels)
+
+            # multi_transform + set_to_zero actually freezes; optax.masked
+            # would pass the raw gradients through for unmasked leaves
+            tx = optax.multi_transform(
+                {"train": tx, "freeze": optax.set_to_zero()}, _labels)
         mu = float(params_cfg.proximal_mu)
         has_bs = self._has_batch_stats
         loss_fn = self.loss_fn
@@ -228,6 +292,7 @@ class FlaxModelOps:
         completed = 0
         rng = self._rng
 
+        place = self._shard_batch if self.mesh is not None else jnp.asarray
         stream = dataset.infinite_batches(params_cfg.batch_size)
         for step_idx in range(total_steps):
             if cancel_event is not None and cancel_event.is_set():
@@ -237,7 +302,7 @@ class FlaxModelOps:
             t0 = time.perf_counter()
             params, batch_stats, opt_state, loss, acc = compiled(
                 params, batch_stats, opt_state, global_params,
-                jnp.asarray(x), jnp.asarray(y), rng)
+                place(x), place(y), rng)
             if step_idx > 0 or total_steps == 1:
                 # skip the compile step for steady-state timing
                 jax.block_until_ready(loss)
@@ -323,6 +388,10 @@ class FlaxModelOps:
         eval_step = self._make_eval(names)
         if variables is None:
             variables = self.variables
+        elif self.mesh is not None:
+            # keep eval on the same sharded layout as training (an
+            # unsharded placement would stage the full model on one device)
+            variables = self._shard(variables)
         else:
             variables = jax.tree.map(jnp.asarray, variables)
         totals = {name: 0.0 for name in ("loss",) + names}

@@ -8,5 +8,16 @@ from BASELINE.md (ResNet-20, ViT, BERT, Llama+LoRA).
 
 from metisfl_tpu.models.zoo.mlp import MLP, HousingMLP
 from metisfl_tpu.models.zoo.cnn import FashionMnistCNN, Cifar10CNN
+from metisfl_tpu.models.zoo.resnet import ResNet20
+from metisfl_tpu.models.zoo.transformer import (
+    TRANSFORMER_RULES,
+    BertLite,
+    LlamaLite,
+    LoRADense,
+    ViTLite,
+)
 
-__all__ = ["MLP", "HousingMLP", "FashionMnistCNN", "Cifar10CNN"]
+__all__ = [
+    "MLP", "HousingMLP", "FashionMnistCNN", "Cifar10CNN", "ResNet20",
+    "ViTLite", "BertLite", "LlamaLite", "LoRADense", "TRANSFORMER_RULES",
+]

@@ -1,0 +1,249 @@
+"""Transformer family: ViT-lite, BERT-lite, Llama-lite (+LoRA).
+
+The BASELINE.md scale ladder (ViT-B/16 semi-sync, BERT async + secure,
+Llama-3-8B-LoRA with in-learner sharding) needs transformer workloads the
+reference never had (its zoo tops out at an IMDB LSTM,
+reference examples/keras/models/imdb_lstm.py). Designed TPU-first:
+
+- attention projections are single 2D matmuls (MXU-friendly, and the TP
+  partition rules in :data:`TRANSFORMER_RULES` shard them over ``tp``:
+  column-parallel qkv/gate/up, row-parallel out/down — XLA inserts the
+  all-reduce over ICI);
+- static shapes everywhere; causal masking via a static bool mask;
+- LoRA adapters (:class:`LoRADense`) add low-rank deltas whose params match
+  ``lora_`` so an optimizer mask can freeze the base model
+  (``FlaxModelOps(trainable_regex="lora_")``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# TP partition rules (first match wins; see parallel/sharding.py).
+# Megatron-style: column-parallel into the head/hidden dimension,
+# row-parallel back out, embeddings sharded over vocab rows. LoRA wraps the
+# base kernel under ``<name>/base/kernel``, hence the optional segment.
+TRANSFORMER_RULES = [
+    (r"(wq|wk|wv|gate|up|fc1)(/base)?/kernel", P(None, "tp")),
+    (r"(wo|down|fc2)(/base)?/kernel", P("tp", None)),
+    (r"lora_b", P(None, "tp")),
+    (r"embed/embedding", P("tp", None)),
+    (r"lm_head/kernel", P(None, "tp")),
+]
+
+
+class LoRADense(nn.Module):
+    """Dense with an optional low-rank adapter: y = xW + scale·(xA)B.
+
+    ``lora_a``/``lora_b`` params match the ``lora_`` trainable-mask regex;
+    the base kernel stays frozen under LoRA fine-tuning."""
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, use_bias=self.use_bias, name="base")(x)
+        if self.rank > 0:
+            a = self.param("lora_a", nn.initializers.normal(0.02),
+                           (x.shape[-1], self.rank))
+            b = self.param("lora_b", nn.initializers.zeros,
+                           (self.rank, self.features))
+            y = y + (x @ a) @ b * (self.alpha / self.rank)
+        return y
+
+
+def _rotary(x, positions):
+    """Rotary position embedding over the last (head) dimension."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (10000 ** (np.arange(0, half) / half))
+    angles = positions[..., None] * freqs  # (..., L, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
+
+
+class Attention(nn.Module):
+    """Multi-head attention with 2D projection kernels (TP-shardable)."""
+
+    dim: int
+    heads: int
+    causal: bool = False
+    rotary: bool = False
+    dropout: float = 0.0
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, L, _ = x.shape
+        head_dim = self.dim // self.heads
+
+        def proj(name, rank=0):
+            return LoRADense(self.dim, rank=rank, use_bias=False, name=name)
+
+        # LoRA on q/v only (standard practice)
+        q = proj("wq", self.lora_rank)(x)
+        k = proj("wk")(x)
+        v = proj("wv", self.lora_rank)(x)
+        q = q.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        if self.rotary:
+            positions = jnp.arange(L, dtype=jnp.float32)
+            q = _rotary(q, positions)
+            k = _rotary(k, positions)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(head_dim)
+        if self.causal:
+            mask = jnp.tril(jnp.ones((L, L), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        weights = nn.softmax(scores, axis=-1)
+        weights = nn.Dropout(self.dropout, deterministic=not train)(weights)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, self.dim)
+        return nn.Dense(self.dim, use_bias=False, name="wo")(out)
+
+
+class SwiGLU(nn.Module):
+    """Llama-style gated MLP (gate/up column-parallel, down row-parallel)."""
+
+    dim: int
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        gate = nn.Dense(self.hidden, use_bias=False, name="gate")(x)
+        up = nn.Dense(self.hidden, use_bias=False, name="up")(x)
+        return nn.Dense(self.dim, use_bias=False, name="down")(
+            nn.silu(gate) * up)
+
+
+class GeluMLP(nn.Module):
+    dim: int
+    hidden: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.gelu(nn.Dense(self.hidden, name="fc1")(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.dim, name="fc2")(x)
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN encoder block (ViT/BERT style)."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x + Attention(self.dim, self.heads, dropout=self.dropout,
+                          name="attn")(nn.LayerNorm()(x), train=train)
+        x = x + GeluMLP(self.dim, self.mlp_ratio * self.dim, self.dropout,
+                        name="mlp")(nn.LayerNorm()(x), train=train)
+        return x
+
+
+class DecoderBlock(nn.Module):
+    """Pre-RMSNorm causal block (Llama style) with rotary + SwiGLU."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
+                          lora_rank=self.lora_rank,
+                          name="attn")(nn.RMSNorm()(x), train=train)
+        x = x + SwiGLU(self.dim, self.mlp_ratio * self.dim,
+                       name="mlp")(nn.RMSNorm()(x))
+        return x
+
+
+class ViTLite(nn.Module):
+    """Patch-embedding vision transformer classifier (ViT ladder config;
+    default sizes give a fast CI-scale model — scale dim/depth/heads up for
+    the ViT-B/16 configuration: dim=768, depth=12, heads=12, patch=16)."""
+
+    num_classes: int = 10
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    patch: int = 4
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(self.dim, (self.patch,) * 2, strides=(self.patch,) * 2,
+                    name="patch_embed")(x)
+        x = x.reshape(x.shape[0], -1, self.dim)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.dim))
+        x = x + pos
+        for i in range(self.depth):
+            x = EncoderBlock(self.dim, self.heads, dropout=self.dropout,
+                             name=f"block_{i}")(x, train=train)
+        x = nn.LayerNorm()(x).mean(axis=1)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+class BertLite(nn.Module):
+    """Bidirectional text-encoder classifier (BERT ladder config)."""
+
+    vocab_size: int = 8192
+    num_classes: int = 2
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    max_len: int = 512
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        L = tokens.shape[1]
+        if L > self.max_len:
+            raise ValueError(f"sequence length {L} exceeds max_len "
+                             f"{self.max_len}")
+        x = nn.Embed(self.vocab_size, self.dim, name="embed")(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.dim))
+        x = x + pos[:, :L]
+        for i in range(self.depth):
+            x = EncoderBlock(self.dim, self.heads, dropout=self.dropout,
+                             name=f"block_{i}")(x, train=train)
+        x = nn.LayerNorm()(x).mean(axis=1)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+class LlamaLite(nn.Module):
+    """Decoder-only causal LM (RMSNorm + rotary + SwiGLU), the Llama-LoRA
+    ladder shape. ``lora_rank > 0`` adds adapters on q/v; train with
+    ``FlaxModelOps(trainable_regex="lora_")`` to freeze the base."""
+
+    vocab_size: int = 8192
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.dim, name="embed")(tokens)
+        for i in range(self.depth):
+            x = DecoderBlock(self.dim, self.heads,
+                             lora_rank=self.lora_rank,
+                             name=f"block_{i}")(x, train=train)
+        x = nn.RMSNorm()(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)

@@ -1,0 +1,71 @@
+"""Examples + data tooling (reference examples/utils/data_partitioning.py,
+examples/keras/fashionmnist.py — the de-facto integration suite)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from examples.utils.data import (  # noqa: E402
+    iid_partition,
+    load_fashion_mnist,
+    non_iid_partition,
+    synthetic_image_classification,
+)
+
+
+class TestPartitioning:
+    def test_iid_covers_all_examples_evenly(self):
+        x, y = synthetic_image_classification(n=1000)
+        shards = iid_partition(x, y, 4)
+        assert [len(s) for s in shards] == [250, 250, 250, 250]
+        # IID: every shard sees (almost) every class
+        for s in shards:
+            assert len(np.unique(s.y)) >= 9
+
+    def test_non_iid_skews_labels(self):
+        x, y = synthetic_image_classification(n=2000)
+        shards = non_iid_partition(x, y, 5, classes_per_learner=2)
+        for s in shards:
+            assert len(s) > 0
+            assert len(np.unique(s.y)) <= 2
+        # different learners own different class windows
+        owned = [tuple(sorted(np.unique(s.y))) for s in shards]
+        assert len(set(owned)) > 1
+
+    def test_non_iid_shards_are_disjoint(self):
+        x, y = synthetic_image_classification(n=2000)
+        # tag examples by index through a side channel: x values are unique
+        # enough; compare via row bytes
+        shards = non_iid_partition(x, y, 4, classes_per_learner=2)
+        seen = set()
+        for s in shards:
+            for row in s.x.reshape(len(s), -1)[:, :4]:
+                key = row.tobytes()
+                assert key not in seen
+                seen.add(key)
+
+    def test_synthetic_fallback_is_learnable_shapes(self):
+        xtr, ytr, xte, yte = load_fashion_mnist(n_synthetic=500)
+        assert xtr.shape == (500, 28, 28, 1) and ytr.shape == (500,)
+        assert len(xte) == 100
+        assert xtr.dtype == np.float32 and ytr.dtype == np.int32
+
+
+def test_fashionmnist_example_completes_rounds(tmp_path):
+    """VERDICT item 6 'done' criterion: the flagship example completes its
+    rounds on CPU as real subprocesses."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "fashionmnist.py"),
+         "--learners", "2", "--rounds", "2",
+         "--examples-per-learner", "150", "--batch-size", "16",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "completed" in proc.stdout
+    assert os.path.exists(tmp_path / "experiment.json")
