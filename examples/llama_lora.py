@@ -81,11 +81,10 @@ def main() -> int:
     for i in range(args.learners):
         ops = FlaxModelOps(module, sample, rng_seed=0, mesh=mesh,
                            partition_rules=TRANSFORMER_RULES,
-                           trainable_regex="lora_")
+                           trainable_regex="lora_",
+                           variables=template)  # learner 0 inits; rest reuse
         if template is None:
             template = ops.get_variables()
-        else:
-            ops.set_variables(template)
         fed.add_learner(ops, lm_shard(i))
     fed.seed_model(template)
     fed.start()

@@ -61,13 +61,11 @@ def main() -> int:
         n_params = 0
         for shard in shards:
             ops = FlaxModelOps(HousingMLP(features=(args.hidden, args.hidden)),
-                               shard.x[:2], loss="mse")
+                               shard.x[:2], loss="mse", variables=template)
             if template is None:
                 template = ops.get_variables()
                 n_params = sum(int(np.size(l))
                                for l in jax.tree.leaves(template))
-            else:
-                ops.set_variables(template)
             fed.add_learner(ops, shard)
         fed.seed_model(template)
         import time

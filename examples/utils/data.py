@@ -34,30 +34,23 @@ def iid_partition(x: np.ndarray, y: np.ndarray, num_learners: int,
 def non_iid_partition(x: np.ndarray, y: np.ndarray, num_learners: int,
                       classes_per_learner: int = 2,
                       seed: int = 0) -> List[ArrayDataset]:
-    """Label-skew partition: each learner draws from a limited class subset
-    (reference DataPartitioning.non_iid_partition's skew scheme)."""
+    """Label-skew partition by shard dealing (the reference's scheme,
+    DataPartitioning.non_iid_partition): sort by label, cut into
+    ``num_learners × classes_per_learner`` contiguous shards, deal each
+    learner ``classes_per_learner`` random shards. EVERY example is
+    assigned (no class is dropped) while each learner sees only a few
+    contiguous label regions."""
     rng = np.random.default_rng(seed)
-    classes = np.unique(y)
-    by_class = {c: list(rng.permutation(np.flatnonzero(y == c)))
-                for c in classes}
-    # assign each learner a rotating window of classes
-    picks: List[List[int]] = [[] for _ in range(num_learners)]
+    order = np.argsort(y, kind="stable")
+    num_shards = num_learners * classes_per_learner
+    shards = np.array_split(order, num_shards)
+    dealt = rng.permutation(num_shards)
+    out = []
     for i in range(num_learners):
-        owned = [classes[(i + j) % len(classes)]
-                 for j in range(classes_per_learner)]
-        for c in owned:
-            pool = by_class[c]
-            # owners of class c split its remaining examples equally
-            owners = sum(
-                1 for k in range(num_learners)
-                if c in [classes[(k + j) % len(classes)]
-                         for j in range(classes_per_learner)])
-            take = max(1, len(np.flatnonzero(y == c)) // max(1, owners))
-            picks[i].extend(pool[:take])
-            del pool[:take]
-    return [ArrayDataset(x[np.asarray(p, int)], y[np.asarray(p, int)],
-                         seed=seed + i)
-            for i, p in enumerate(picks)]
+        mine = dealt[i * classes_per_learner:(i + 1) * classes_per_learner]
+        picks = np.concatenate([shards[s] for s in mine])
+        out.append(ArrayDataset(x[picks], y[picks], seed=seed + i))
+    return out
 
 
 def synthetic_image_classification(

@@ -70,7 +70,15 @@ def _rotary(x, positions):
 
 
 class Attention(nn.Module):
-    """Multi-head attention with 2D projection kernels (TP-shardable)."""
+    """Multi-head attention with 2D projection kernels (TP-shardable).
+
+    ``sp_mesh`` switches the score/softmax/value stage to ring attention
+    over the mesh's ``sp`` axis (sequence parallelism — exact attention
+    with O(L/sp) per-device memory; see parallel/ringattn.py). Rotary runs
+    on the logically-global arrays before the shard_map island, so
+    positions stay global. Attention-weight dropout is a no-op on the ring
+    path (the (L, L) matrix never exists to drop from).
+    """
 
     dim: int
     heads: int
@@ -78,6 +86,8 @@ class Attention(nn.Module):
     rotary: bool = False
     dropout: float = 0.0
     lora_rank: int = 0
+    sp_mesh: object = None
+    sp_axis: str = "sp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -98,13 +108,21 @@ class Attention(nn.Module):
             positions = jnp.arange(L, dtype=jnp.float32)
             q = _rotary(q, positions)
             k = _rotary(k, positions)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(head_dim)
-        if self.causal:
-            mask = jnp.tril(jnp.ones((L, L), bool))
-            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        weights = nn.softmax(scores, axis=-1)
-        weights = nn.Dropout(self.dropout, deterministic=not train)(weights)
-        out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        if self.sp_mesh is not None:
+            from metisfl_tpu.parallel.ringattn import make_ring_attention
+            out = make_ring_attention(self.sp_mesh, self.sp_axis,
+                                      causal=self.causal)(q, k, v)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * float(
+                1.0 / np.sqrt(head_dim))
+            if self.causal:
+                mask = jnp.tril(jnp.ones((L, L), bool))
+                scores = jnp.where(mask, scores,
+                                   jnp.finfo(scores.dtype).min)
+            weights = nn.softmax(scores, axis=-1)
+            weights = nn.Dropout(self.dropout,
+                                 deterministic=not train)(weights)
+            out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, self.dim)
         return nn.Dense(self.dim, use_bias=False, name="wo")(out)
 
@@ -159,11 +177,12 @@ class DecoderBlock(nn.Module):
     heads: int
     mlp_ratio: int = 4
     lora_rank: int = 0
+    sp_mesh: object = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
-                          lora_rank=self.lora_rank,
+                          lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
                           name="attn")(nn.RMSNorm()(x), train=train)
         x = x + SwiGLU(self.dim, self.mlp_ratio * self.dim,
                        name="mlp")(nn.RMSNorm()(x))
@@ -237,6 +256,9 @@ class LlamaLite(nn.Module):
     depth: int = 4
     heads: int = 4
     lora_rank: int = 0
+    # sequence parallelism: a Mesh with an "sp" axis routes every block's
+    # attention through the ring schedule (long-context configs)
+    sp_mesh: object = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -244,6 +266,7 @@ class LlamaLite(nn.Module):
         for i in range(self.depth):
             x = DecoderBlock(self.dim, self.heads,
                              lora_rank=self.lora_rank,
+                             sp_mesh=self.sp_mesh,
                              name=f"block_{i}")(x, train=train)
         x = nn.RMSNorm()(x)
         return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)

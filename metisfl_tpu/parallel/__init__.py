@@ -15,6 +15,7 @@ TPU-native upgrade path:
 from metisfl_tpu.parallel.mesh import MeshConfig, build_mesh
 from metisfl_tpu.parallel.collectives import federated_mean_psum, make_pod_aggregator
 from metisfl_tpu.parallel.podfed import PodFederation
+from metisfl_tpu.parallel.ringattn import make_ring_attention, ring_attention
 
 __all__ = [
     "MeshConfig",
@@ -22,4 +23,6 @@ __all__ = [
     "federated_mean_psum",
     "make_pod_aggregator",
     "PodFederation",
+    "ring_attention",
+    "make_ring_attention",
 ]
