@@ -18,7 +18,6 @@ from metisfl_tpu.comm.messages import (
     EvalTask,
     EvalResult,
     TrainParams,
-    Envelope,
 )
 
 __all__ = [
@@ -31,5 +30,4 @@ __all__ = [
     "EvalTask",
     "EvalResult",
     "TrainParams",
-    "Envelope",
 ]

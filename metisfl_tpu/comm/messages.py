@@ -171,12 +171,3 @@ class EvalResult(Message):
     evaluations: Dict[str, Dict[str, float]] = field(default_factory=dict)
     duration_ms: float = 0.0
 
-
-@dataclass
-class Envelope(Message):
-    """Generic RPC envelope: method + payload + status for the bytes transport."""
-
-    method: str = ""
-    payload: bytes = b""
-    ok: bool = True
-    error: str = ""

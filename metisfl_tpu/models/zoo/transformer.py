@@ -88,6 +88,7 @@ class Attention(nn.Module):
     lora_rank: int = 0
     sp_mesh: object = None
     sp_axis: str = "sp"
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -112,6 +113,9 @@ class Attention(nn.Module):
             from metisfl_tpu.parallel.ringattn import make_ring_attention
             out = make_ring_attention(self.sp_mesh, self.sp_axis,
                                       causal=self.causal)(q, k, v)
+        elif self.use_flash:
+            from metisfl_tpu.ops import flash_attention
+            out = flash_attention(q, k, v, self.causal)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * float(
                 1.0 / np.sqrt(head_dim))
@@ -160,10 +164,12 @@ class EncoderBlock(nn.Module):
     heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, dropout=self.dropout,
+                          use_flash=self.use_flash,
                           name="attn")(nn.LayerNorm()(x), train=train)
         x = x + GeluMLP(self.dim, self.mlp_ratio * self.dim, self.dropout,
                         name="mlp")(nn.LayerNorm()(x), train=train)
@@ -178,11 +184,13 @@ class DecoderBlock(nn.Module):
     mlp_ratio: int = 4
     lora_rank: int = 0
     sp_mesh: object = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
                           lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
+                          use_flash=self.use_flash,
                           name="attn")(nn.RMSNorm()(x), train=train)
         x = x + SwiGLU(self.dim, self.mlp_ratio * self.dim,
                        name="mlp")(nn.RMSNorm()(x))
@@ -259,6 +267,8 @@ class LlamaLite(nn.Module):
     # sequence parallelism: a Mesh with an "sp" axis routes every block's
     # attention through the ring schedule (long-context configs)
     sp_mesh: object = None
+    # single-chip pallas flash-attention kernel (ops/flash_attention.py)
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -267,6 +277,7 @@ class LlamaLite(nn.Module):
             x = DecoderBlock(self.dim, self.heads,
                              lora_rank=self.lora_rank,
                              sp_mesh=self.sp_mesh,
+                             use_flash=self.use_flash,
                              name=f"block_{i}")(x, train=train)
         x = nn.RMSNorm()(x)
         return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)

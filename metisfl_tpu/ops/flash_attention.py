@@ -1,0 +1,131 @@
+"""Pallas flash attention (TPU kernel, interpret-mode on CPU).
+
+Blockwise attention with online softmax in VMEM: the (L, L) score matrix
+never reaches HBM — each grid step holds one (BLK_Q, D) query block and
+streams K/V blocks through VMEM, accumulating flash-style m/l/o statistics.
+Score/value products hit the MXU as dense (BLK_Q, BLK_K) @ (BLK_K, D)
+matmuls. The reference framework has no custom kernels at all (its hot loop
+is byte-blob C++ arithmetic, SURVEY.md §2.1 C3); this is the TPU-native hot
+path for the transformer ladder.
+
+Scope: forward pass is the pallas kernel; the backward pass (custom VJP)
+recomputes attention densely with XLA einsums — "flash forward, dense
+backward". For long-context training memory, use the ring-attention path
+(parallel/ringattn.py); this kernel targets single-chip speed at moderate L.
+
+Best on TPU with head_dim a multiple of 128 (lane width) and block sizes a
+multiple of 8 (f32 sublanes); any shape works in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
+                  scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0] * scale                       # (BLK_Q, D)
+    blk_q, D = q.shape
+    L = k_ref.shape[1]
+    nk = L // blk_k
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.dslice(j * blk_k, blk_k), :]      # (BLK_K, D)
+        v = v_ref[0, pl.dslice(j * blk_k, blk_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            k_pos = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        o_new = o * corr + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((blk_q, D), jnp.float32)
+    m0 = jnp.full((blk_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    o, _, l = jax.lax.fori_loop(0, nk, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
+                   interpret: bool):
+    B, H, L, D = q.shape
+    blk_q = min(blk_q, L)
+    blk_k = min(blk_k, L)
+    if L % blk_q or L % blk_k:
+        raise ValueError(f"sequence length {L} must divide into blocks "
+                         f"({blk_q}, {blk_k})")
+    scale = float(1.0 / np.sqrt(D))
+    qf = q.reshape(B * H, L, D)
+    kf = k.reshape(B * H, L, D)
+    vf = v.reshape(B * H, L, D)
+    kernel = functools.partial(_flash_kernel, blk_k=blk_k, causal=causal,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+        grid=(B * H, L // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, L, D)
+
+
+def _dense_attention(q, k, v, causal: bool):
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * float(1.0 / np.sqrt(D))
+    if causal:
+        L = q.shape[2]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask, s, _NEG)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, blk_q: int = 128,
+                    blk_k: int = 128, interpret: Optional[bool] = None):
+    """Flash attention over (B, H, L, D). ``interpret=None`` auto-selects
+    interpret mode off-TPU so the same call works in CI and on chip."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def _fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    return flash_attention(q, k, v, causal, blk_q, blk_k, interpret), (q, k, v)
+
+
+def _bwd(causal, blk_q, blk_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
