@@ -1,27 +1,34 @@
 #!/usr/bin/env python
-"""Aggregation performance harness.
+"""Federation performance harness — always prints ONE JSON line.
 
 TPU-native counterpart of the reference's scenario benchmark
 (reference metisfl/controller/scenarios/sync_model_aggregation_performance_main.cc:13-87
 + scenarios_common.cc: N synthetic learners x T tensors x V values, timing the
-aggregation hot loop and RSS) — here the hot loop is the controller's real
-FedAvg path: stride-blocked jit-compiled scaled-add fold over learner model
-pytrees (metisfl_tpu/aggregation/fedavg.py), including host->device transfer.
+aggregation hot loop and RSS).
 
 Headline metric (BASELINE.md north star): federation aggregation wall-clock
 per round at 64 learners, target <= 2000 ms. ``vs_baseline`` is the speedup
-against that target (>1 means beating it).
+against that target (>1 means beating it). Secondary metrics: learner
+training throughput, causal-LM MFU on an MXU-sized transformer (bf16),
+pallas flash-attention vs dense timings, CKKS secure-aggregation wall-clock,
+and model-store scale (64 learners x 1.6M params + 26 MB ciphertexts).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, "details": {...}}
+Robustness contract (the whole point after round 2's rc=1): the JSON line is
+ALWAYS printed. Backend init is probed in a subprocess with retries; on
+persistent failure the bench re-execs itself on CPU and records
+``degraded_to_cpu``. Every secondary section is individually guarded and
+failures land in ``details.errors`` instead of killing the run.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import resource
+import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -41,6 +48,56 @@ MODEL_SHAPES = {
     "head/kernel": (512, 10), "head/bias": (10,),
 }
 
+# bf16 peak FLOP/s per chip by device_kind substring (first match wins).
+_CHIP_PEAKS = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v6 lite", 918e12), ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+]
+
+
+def _chip_peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _CHIP_PEAKS:
+        if key in kind:
+            return peak
+    return None
+
+
+def ensure_backend(max_attempts: int = 3):
+    """Probe JAX backend init in a subprocess (so a hard failure can't take
+    this process down), retrying with backoff; fall back to CPU.
+
+    Round 2 died with ``Unable to initialize backend 'axon': UNAVAILABLE`` at
+    the first in-process device op — this makes that failure mode recoverable.
+    """
+    info = {"probe_attempts": 0, "degraded_to_cpu": False}
+    if os.environ.get("JAX_PLATFORMS"):
+        return info  # explicit platform: honor it, no probing
+    probe = ("import jax, jax.numpy as jnp; "
+             "jnp.ones((8, 8)).sum().block_until_ready(); "
+             "print(jax.default_backend())")
+    # first attempt gets the cold-compile budget; a wedged tunnel (init
+    # hangs, round-3 observation) then fails fast on the retries
+    timeouts = [240] + [120] * (max_attempts - 1)
+    for attempt in range(max_attempts):
+        info["probe_attempts"] = attempt + 1
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               capture_output=True, text=True,
+                               timeout=timeouts[attempt])
+            if r.returncode == 0:
+                info["probed_backend"] = r.stdout.strip().splitlines()[-1]
+                return info
+            info["probe_error"] = (r.stderr or "")[-400:]
+        except Exception as exc:  # timeout etc.
+            info["probe_error"] = repr(exc)[-400:]
+        time.sleep(5 * (attempt + 1))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    info["degraded_to_cpu"] = True
+    return info
+
 
 def synth_models(num_learners: int, seed: int = 0):
     rng = np.random.default_rng(seed)
@@ -56,7 +113,8 @@ def aggregate_once(agg, models, scales, stride: int):
     _compute_community_model): one block resident at a time."""
     agg.reset()
     for i in range(0, len(models), stride):
-        block = [( [models[j]], scales[j] ) for j in range(i, min(i + stride, len(models)))]
+        block = [([models[j]], scales[j])
+                 for j in range(i, min(i + stride, len(models)))]
         agg.accumulate(block)
     out = agg.result()
     agg.reset()
@@ -113,9 +171,8 @@ def bench_aggregation(num_learners: int, rounds: int, stride: int):
 
 
 def bench_train_step():
-    """Secondary: learner local-training throughput (samples/sec/chip) on the
+    """Learner local-training throughput (samples/sec/chip) on the
     FashionMNIST CNN — the reference ladder's first rung."""
-    import jax
     from metisfl_tpu.comm.messages import TrainParams
     from metisfl_tpu.models.dataset import ArrayDataset
     from metisfl_tpu.models.ops import FlaxModelOps
@@ -132,10 +189,109 @@ def bench_train_step():
     if out.ms_per_step <= 0:
         return {}
     return {
-        "train_samples_per_sec": batch / (out.ms_per_step / 1e3),
-        "train_ms_per_step": out.ms_per_step,
+        "train_samples_per_sec": round(batch / (out.ms_per_step / 1e3)),
+        "train_ms_per_step": round(out.ms_per_step, 2),
         "train_batch_size": batch,
     }
+
+
+def bench_mfu():
+    """Causal-LM MFU on an MXU-sized LlamaLite (dim 1024 / depth 8 / seq 1024,
+    bf16 compute): analytic matmul FLOPs per training step divided by measured
+    steady-state step time and the chip's bf16 peak. This is the perf axis the
+    first two rounds never measured (VERDICT r2 #1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.models.dataset import ArrayDataset
+    from metisfl_tpu.models.ops import FlaxModelOps
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    if jax.default_backend() != "tpu":
+        return {}  # MFU against a TPU peak is meaningless elsewhere
+
+    B, L = 8, 1024
+    dim, depth, heads, vocab = 1024, 8, 16, 32768
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, vocab, (B * 2, L)).astype(np.int32)
+    ds = ArrayDataset(x, np.roll(x, -1, axis=1))
+    module = LlamaLite(vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+                       dtype=jnp.bfloat16)
+    ops = FlaxModelOps(module, ds.x[:1])
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(ops.variables))
+    res = ops.train(ds, TrainParams(batch_size=B, local_steps=8,
+                                    optimizer="adam", learning_rate=1e-4))
+    if res.ms_per_step <= 0:
+        return {}
+
+    # Exact matmul FLOPs per forward (2*M*N*K per matmul, dense attention):
+    # qkv+o projections, scores + PV attention matmuls, SwiGLU, lm_head.
+    tokens = B * L
+    per_layer = (8 * tokens * dim * dim            # wq/wk/wv/wo
+                 + 4 * B * L * L * dim             # scores + PV (full, as executed)
+                 + 24 * tokens * dim * dim)        # SwiGLU (hidden = 4*dim)
+    fwd_flops = depth * per_layer + 2 * tokens * dim * vocab
+    step_flops = 3 * fwd_flops                     # fwd + backward (2x fwd)
+
+    kind = jax.devices()[0].device_kind
+    peak = _chip_peak_flops(kind)
+    out = {
+        "lm_ms_per_step": round(res.ms_per_step, 2),
+        "lm_tokens_per_sec": round(tokens / (res.ms_per_step / 1e3)),
+        "lm_params": n_params,
+        "lm_flops_per_step": step_flops,
+        "lm_config": f"dim{dim}/depth{depth}/heads{heads}/seq{L}/batch{B}/bf16",
+        "device_kind": kind,
+    }
+    if peak:
+        achieved = step_flops / (res.ms_per_step / 1e3)
+        out["lm_achieved_tflops"] = round(achieved / 1e12, 1)
+        out["chip_peak_bf16_tflops"] = round(peak / 1e12)
+        out["mfu"] = round(achieved / peak, 4)
+    return out
+
+
+def bench_flash(seq: int = 2048):
+    """Pallas flash-attention kernel vs dense XLA attention, fwd and
+    fwd+bwd, at seq >= 1024 (VERDICT r2 #5). TPU only — interpret mode is a
+    debugging path, far too slow to time."""
+    import jax
+    import jax.numpy as jnp
+
+    from metisfl_tpu.ops import flash_attention
+    from metisfl_tpu.ops.flash_attention import _dense_attention
+
+    if jax.default_backend() != "tpu":
+        return {}
+    B, H, D = 4, 16, 128
+    rng = jax.random.PRNGKey(0)
+    qkv = [jax.random.normal(jax.random.fold_in(rng, i), (B, H, seq, D),
+                             jnp.bfloat16) for i in range(3)]
+
+    def dense(q, k, v):
+        return _dense_attention(q, k, v, True)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, True)
+
+    out = {"flash_seq": seq}
+    for label, fn in (("flash", flash), ("dense", dense)):
+        fwd = jax.jit(fn)
+        loss = jax.jit(jax.grad(
+            lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))
+        jax.block_until_ready(fwd(*qkv))          # compile
+        jax.block_until_ready(loss(*qkv))
+        for tag, g in (("fwd", fwd), ("fwd_bwd", loss)):
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jax.block_until_ready(g(*qkv))
+                times.append((time.perf_counter() - t0) * 1e3)
+            out[f"attn_{label}_{tag}_ms"] = round(float(np.median(times)), 2)
+    return out
 
 
 def bench_secure_ckks(num_learners: int = 8):
@@ -175,42 +331,94 @@ def bench_secure_ckks(num_learners: int = 8):
     }
 
 
-def bench_transformer():
-    """Causal-LM training throughput (tokens/sec/chip) on LlamaLite; also
-    records the pallas flash-attention step time when the kernel compiles
-    on this backend."""
-    from metisfl_tpu.comm.messages import TrainParams
-    from metisfl_tpu.models.dataset import ArrayDataset
-    from metisfl_tpu.models.ops import FlaxModelOps
-    from metisfl_tpu.models.zoo import LlamaLite
+def bench_store(num_learners: int = 64):
+    """Model-store scale: insert/select/evict at 64 learners x 1.64M-param
+    models for the in-memory store, plus the disk store with a 26 MB
+    ciphertext-sized blob (reference redis_model_store.cc:120-260 scale
+    story; VERDICT r2 #8)."""
+    import tempfile
 
+    from metisfl_tpu.store.base import EvictionPolicy
+    from metisfl_tpu.store.disk import DiskModelStore
+    from metisfl_tpu.store.memory import InMemoryModelStore
+
+    models = synth_models(num_learners, seed=5)
+    ids = [f"learner_{i}" for i in range(num_learners)]
+    out = {"store_learners": num_learners}
+
+    mem = InMemoryModelStore(EvictionPolicy.LINEAGE_LENGTH, lineage_length=2)
+    t0 = time.perf_counter()
+    for _ in range(3):  # 3 rounds -> exercises eviction at lineage 2
+        for lid, m in zip(ids, models):
+            mem.insert(lid, m)
+    out["store_mem_insert_ms"] = round(
+        (time.perf_counter() - t0) * 1e3 / (3 * num_learners), 3)
+    t0 = time.perf_counter()
+    sel = mem.select(ids, k=2)
+    out["store_mem_select_all_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    assert len(sel) == num_learners and all(len(v) == 2 for v in sel.values())
+
+    with tempfile.TemporaryDirectory() as root:
+        disk = DiskModelStore(root, EvictionPolicy.LINEAGE_LENGTH,
+                              lineage_length=1)
+        t0 = time.perf_counter()
+        for lid, m in zip(ids, models):
+            disk.insert(lid, m)
+        out["store_disk_insert_ms"] = round(
+            (time.perf_counter() - t0) * 1e3 / num_learners, 2)
+        t0 = time.perf_counter()
+        sel = disk.select(ids, k=1)
+        out["store_disk_select_all_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        assert len(sel) == num_learners
+
+        # 26 MB opaque ciphertext blob (the CKKS model size measured above)
+        blob = np.random.default_rng(6).bytes(26_000_000)
+        t0 = time.perf_counter()
+        disk.insert("secure_learner", blob)
+        out["store_disk_ciphertext_insert_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        t0 = time.perf_counter()
+        got = disk.select(["secure_learner"], k=1)["secure_learner"][0]
+        out["store_disk_ciphertext_select_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        assert isinstance(got, (bytes, bytearray)) and len(got) == len(blob)
+    return out
+
+
+def run_bench(quick: bool):
     import jax
 
-    rng = np.random.default_rng(3)
-    batch, seq = 16, 128
-    x = rng.integers(0, 512, (batch * 4, seq)).astype(np.int32)
-    ds = ArrayDataset(x, np.roll(x, -1, axis=1))
-    cfg = TrainParams(batch_size=batch, local_steps=4, optimizer="adam",
-                      learning_rate=1e-3)
-    # pallas interpret mode (non-TPU) is a debugging path — far too slow
-    # for a benchmark; measure the kernel only where it compiles natively
-    variants = [("plain", False)]
-    if jax.default_backend() == "tpu":
-        variants.append(("flash", True))
-    out = {}
-    for label, flash in variants:
+    num_learners = 8 if quick else NUM_LEARNERS
+    rounds = 2 if quick else ROUNDS
+    errors = {}
+    details = {}
+
+    agg = bench_aggregation(num_learners, rounds, STRIDE)
+    details.update(agg)
+
+    secondary = [bench_secure_ckks] if quick else [
+        bench_train_step, bench_mfu, bench_flash, bench_secure_ckks,
+        bench_store]
+    for fn in secondary:
         try:
-            ops = FlaxModelOps(
-                LlamaLite(vocab_size=512, dim=128, depth=2, heads=8,
-                          use_flash=flash), ds.x[:2])
-            res = ops.train(ds, cfg)
-            if res.ms_per_step > 0:
-                out[f"lm_{label}_ms_per_step"] = round(res.ms_per_step, 2)
-                out[f"lm_{label}_tokens_per_sec"] = round(
-                    batch * seq / (res.ms_per_step / 1e3))
-        except Exception:  # e.g. pallas unsupported on this backend
-            continue
-    return out
+            details.update(fn())
+        except Exception:
+            errors[fn.__name__] = traceback.format_exc(limit=3)[-400:]
+
+    value = agg["ms_per_round_median"]
+    result = {
+        "metric": f"aggregation_ms_per_round_{num_learners}learners",
+        "value": round(value, 2),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / value, 2),
+        "details": details,
+    }
+    if "mfu" in details:
+        result["mfu"] = details["mfu"]
+    if errors:
+        result["details"]["errors"] = errors
+    return result
 
 
 def main():
@@ -220,44 +428,52 @@ def main():
     from metisfl_tpu.platform import honor_platform_env
     honor_platform_env()  # JAX_PLATFORMS beats any sitecustomize override
 
-    import jax
-
     parser = argparse.ArgumentParser("bench")
     parser.add_argument("--quick", action="store_true",
                         help="small sizes for CI/CPU smoke validation "
                              "(the driver runs the full bench on TPU)")
     args, _ = parser.parse_known_args()
 
-    num_learners = 8 if args.quick else NUM_LEARNERS
-    rounds = 2 if args.quick else ROUNDS
-    agg = bench_aggregation(num_learners, rounds, STRIDE)
-    secondary = [bench_secure_ckks] if args.quick else [
-        bench_train_step, bench_secure_ckks, bench_transformer]
-    extras = {}
-    for fn in secondary:
-        try:
-            extras.update(fn())
-        except Exception:  # secondary metrics must not sink the headline
-            continue
-    train = extras
+    backend_info = ensure_backend()
+    if backend_info.get("degraded_to_cpu"):
+        honor_platform_env()
 
-    value = agg["ms_per_round_median"]
-    result = {
-        "metric": f"aggregation_ms_per_round_{num_learners}learners",
-        "value": round(value, 2),
-        "unit": "ms",
-        "vs_baseline": round(BASELINE_MS / value, 2),
-        "details": {
-            **agg,
-            **train,
-            "baseline_ms": BASELINE_MS,
-            "backend": jax.default_backend(),
-            "devices": len(jax.devices()),
-            "peak_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
-            "bench_wall_s": round(time.time() - t_start, 1),
-        },
-    }
+    try:
+        result = run_bench(args.quick)
+    except Exception as exc:
+        # In-process backend death after a clean probe (the round-2 failure
+        # mode): one retry, whole-process, pinned to CPU.
+        if (os.environ.get("MFTPU_BENCH_CPU_RETRY") != "1"
+                and os.environ.get("JAX_PLATFORMS") != "cpu"):
+            os.environ["MFTPU_BENCH_CPU_RETRY"] = "1"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                os.execv(sys.executable, [sys.executable] + sys.argv)
+            except OSError:
+                pass
+        result = {
+            "metric": "aggregation_ms_per_round_failed",
+            "value": 0.0,
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "details": {"error": traceback.format_exc(limit=5)[-800:],
+                        "exc": repr(exc)[-200:]},
+        }
+
+    try:
+        import jax
+        result["details"]["backend"] = jax.default_backend()
+        result["details"]["devices"] = len(jax.devices())
+    except Exception:
+        result["details"]["backend"] = "unavailable"
+    result["details"].update(backend_info)
+    result["details"]["cpu_retry"] = os.environ.get(
+        "MFTPU_BENCH_CPU_RETRY") == "1"
+    result["details"]["peak_rss_kb"] = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss
+    result["details"]["bench_wall_s"] = round(time.time() - t_start, 1)
     print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":

@@ -19,7 +19,7 @@ federated_stride.cc:5-68, federated_recency.cc:7-107):
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 from metisfl_tpu.aggregation.base import (
     AggState,
@@ -70,6 +70,42 @@ class _RollingBase:
             sub = np_scaled_sub if state.use_numpy else scaled_sub
             state.wc_scaled = sub(state.wc_scaled, old_model, old_scale)
             state.z -= old_scale
+
+    # -- checkpoint / resume ----------------------------------------------
+    def export_scales(self) -> Dict[str, float]:
+        """``learner_id -> scale`` of every counted contribution — the part
+        of the rolling state that cannot be reconstructed from the model
+        store alone (the models CAN: they are the store's lineage heads)."""
+        return {lid: scale
+                for lid, (scale, _) in self._state.contributions.items()}
+
+    def rehydrate(self, store, scales: Dict[str, float]) -> int:
+        """Rebuild ``wc_scaled``/``z`` after a controller restart from the
+        persisted store lineage + checkpointed contribution scales.
+
+        This is the reference's store-driven reconstruction (the recency rule
+        reads the store's 2-model lineage to recover the subtraction term,
+        federated_recency.cc:68-99) adapted to a store that outlives the
+        process: for each checkpointed learner the *newest* stored model
+        (lineage[0]) re-enters the sum — if the learner inserted a model
+        between the checkpoint and the crash, the rebuilt state adopts it,
+        exactly matching the no-crash run's recency semantics. A blind
+        "subtract lineage[1] inside aggregate" would be unsound here: a
+        persistent store can carry lineage from a *previous* run that this
+        state never counted. Returns the number of contributions restored
+        (learners whose models the store did not persist — e.g. an in-memory
+        store after a restart — are skipped, best effort).
+        """
+        self.reset()
+        picked = store.select(list(scales), k=1)  # only the head re-enters
+        restored = 0
+        for lid, scale in scales.items():
+            lineage = picked.get(lid)
+            if not lineage:
+                continue
+            self._add(lid, lineage[0], float(scale))
+            restored += 1
+        return restored
 
 
 class FedStride(_RollingBase):

@@ -100,6 +100,11 @@ class TrainParams(Message):
     optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
     # FedProx proximal term weight; 0 disables (reference fed_prox.py:10-103).
     proximal_mu: float = 0.0
+    # jax.profiler trace capture (SURVEY.md §5.1): when set, each training
+    # task traces ``profile_steps`` steady-state (post-compile) steps into
+    # this directory — TensorBoard/xprof-readable.
+    profile_dir: str = ""
+    profile_steps: int = 3
 
 
 @dataclass
@@ -169,5 +174,32 @@ class EvalResult(Message):
     round_id: int = 0
     # dataset name -> {metric -> value}
     evaluations: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    duration_ms: float = 0.0
+
+
+@dataclass
+class InferTask(Message):
+    """Inference request — the reference learner's third task type
+    (reference metisfl/learner/learner.py:311-330 run_inference_task)."""
+
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    model: bytes = b""          # ModelBlob to infer with (may be encrypted)
+    batch_size: int = 256
+    # either a named local dataset split ("train"/"valid"/"test")...
+    dataset: str = "test"
+    # ...or explicit inputs shipped as a packed {"x": array} ModelBlob
+    inputs: bytes = b""
+    max_examples: int = 0       # 0 = all
+
+
+@dataclass
+class InferResult(Message):
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    predictions: bytes = b""    # packed {"predictions": array} ModelBlob
+    num_examples: int = 0
     duration_ms: float = 0.0
 

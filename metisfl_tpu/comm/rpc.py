@@ -56,6 +56,9 @@ class BytesService:
             try:
                 return fn(request)
             except Exception as exc:
+                code = getattr(exc, "code", None)
+                if isinstance(code, grpc.StatusCode):
+                    context.abort(code, str(exc))
                 logger.exception("RPC handler failed")
                 context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
 
@@ -144,15 +147,17 @@ class RpcClient:
     def call_async(self, method: str, payload: bytes,
                    callback: Optional[Callable[[bytes], None]] = None,
                    error_callback: Optional[Callable[[Exception], None]] = None,
-                   timeout: Optional[float] = None):
+                   timeout: Optional[float] = None,
+                   wait_ready: bool = True):
         """Non-blocking unary call (the reference's CompletionQueue pattern,
-        controller.cc:713-759, via grpc futures)."""
+        controller.cc:713-759, via grpc futures). ``wait_ready=False`` fails
+        fast with UNAVAILABLE on a dead endpoint instead of queueing."""
         fn = self._channel.unary_unary(
             f"/{self.service_name}/{method}",
             request_serializer=_IDENTITY,
             response_deserializer=_IDENTITY,
         )
-        future = fn.future(payload, timeout=timeout, wait_for_ready=True)
+        future = fn.future(payload, timeout=timeout, wait_for_ready=wait_ready)
 
         def _done(f):
             try:

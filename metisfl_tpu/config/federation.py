@@ -97,6 +97,11 @@ class FederationConfig:
     # (reference behavior: a hung learner stalls the round forever,
     # SURVEY.md §5.3).
     round_deadline_secs: float = 0.0
+    # Learner liveness: after this many consecutive failed train dispatches a
+    # learner is treated as unreachable and excluded from cohort sampling
+    # until it completes a task or rejoins (the reference only logs failed
+    # dispatches and keeps scheduling them, controller.cc:783-786). 0 → off.
+    max_dispatch_failures: int = 3
     aggregation: AggregationConfig = field(default_factory=AggregationConfig)
     model_store: ModelStoreConfig = field(default_factory=ModelStoreConfig)
     secure: SecureAggConfig = field(default_factory=SecureAggConfig)

@@ -85,6 +85,8 @@ class LearnerRecord:
     # latest task execution metadata (feeds scalers + semi-sync recompute)
     completed_batches: int = 0
     ms_per_step: float = 0.0
+    # consecutive failed train dispatches (liveness; reset on completion)
+    dispatch_failures: int = 0
     # per-learner train overrides (semi-sync step budgets)
     local_steps_override: int = 0
     proxy: Optional[LearnerProxy] = None
@@ -109,6 +111,9 @@ class RoundMetadata:
     model_insertion_duration_ms: Dict[str, float] = field(default_factory=dict)
     model_size: Dict[str, int] = field(default_factory=dict)
     peak_rss_kb: int = 0
+    # non-fatal round errors (e.g. partial-cohort secure aggregation after a
+    # deadline) — surfaced in lineage instead of vanishing into a log line
+    errors: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -205,6 +210,7 @@ class Controller:
                 record = self._learners[request.previous_id]
                 record.hostname, record.port = request.hostname, request.port
                 record.proxy = self._proxy_factory(record)
+                record.dispatch_failures = 0  # fresh endpoint, assume live
                 logger.info("learner %s rejoined", record.learner_id)
                 # Re-dispatch the current community model so a crash-restarted
                 # learner rejoins the in-flight round instead of idling until
@@ -333,6 +339,7 @@ class Controller:
             if record is None:
                 return
             record.completed_batches = result.completed_batches
+            record.dispatch_failures = 0  # provably reachable
             if result.processing_ms_per_step > 0:
                 record.ms_per_step = result.processing_ms_per_step
             self._tasks_in_flight.pop(result.task_id, None)
@@ -434,7 +441,23 @@ class Controller:
                 "round deadline (%.1fs) expired; aggregating %d reporter(s), "
                 "dropping stragglers %s", self.config.round_deadline_secs,
                 len(cohort), dropped)
-            self._complete_round(cohort)
+            try:
+                self._complete_round(cohort)
+            except Exception as exc:
+                # Partial-cohort aggregation can legitimately fail — masking
+                # secure-agg needs every party's payload to cancel the masks
+                # (secure/masking.py weighted_sum). Abandon the round and
+                # re-dispatch the FULL cohort instead of stalling: the round
+                # counter never advanced, so mask streams (keyed on round id)
+                # regenerate identically and a clean retry works.
+                logger.warning(
+                    "post-deadline aggregation failed (%r); abandoning round "
+                    "and re-dispatching the full cohort", exc)
+                with self._lock:
+                    self._current_meta.errors.append(
+                        f"post-deadline aggregation failed: {exc!r}")
+                self._scheduler.reset()
+                self._dispatch_train(self._sample_cohort())
         else:
             logger.warning(
                 "round deadline (%.1fs) expired with no reporters (%s); "
@@ -479,11 +502,21 @@ class Controller:
         self._dispatch_train(next_ids)
 
     def _sample_cohort(self) -> List[str]:
-        """Sample next round's participants from all active learners
+        """Sample next round's participants from reachable active learners
         (ControllerParams.participation_ratio). The scheduler barriers on the
-        dispatched sample, so ratio < 1 cannot stall a synchronous round."""
+        dispatched sample, so ratio < 1 cannot stall a synchronous round.
+
+        Learners with ``max_dispatch_failures`` consecutive failed dispatches
+        are skipped until they complete a task or rejoin — a dead endpoint
+        must not keep re-entering sync barriers (SURVEY.md §5.3)."""
         ratio = self.config.aggregation.participation_ratio
-        pool = self.active_learners()
+        limit = self.config.max_dispatch_failures
+        with self._lock:
+            pool = [lid for lid, r in self._learners.items()
+                    if limit <= 0 or r.dispatch_failures < limit]
+            if not pool:
+                # every learner looks dead: keep trying rather than halting
+                pool = list(self._learners.keys())
         if ratio >= 1.0 or not pool:
             return pool
         k = max(1, int(round(ratio * len(pool))))
@@ -666,13 +699,36 @@ class Controller:
                 self._current_meta.train_submitted_at[lid] = time.time()
                 proxy = record.proxy
             try:
-                proxy.run_task(task)
-            except Exception:
-                # Failed dispatches are logged and dropped, like the
-                # reference (controller.cc:783-786); async protocols recover,
-                # sync rounds rely on the round deadline / membership changes.
+                if hasattr(proxy, "run_task_with_callback"):
+                    # async transports surface failures via callback
+                    proxy.run_task_with_callback(
+                        task, lambda exc, lid=lid:
+                        self._note_dispatch_failure(lid, exc))
+                else:
+                    proxy.run_task(task)
+            except Exception as exc:
+                # Failed dispatches are logged and counted (the reference
+                # only logs and keeps scheduling them, controller.cc:783-786);
+                # async protocols recover, sync rounds rely on the round
+                # deadline / membership changes, and _sample_cohort skips
+                # learners past the consecutive-failure limit.
                 logger.exception("train dispatch to %s failed", lid)
+                self._note_dispatch_failure(lid, exc)
         self._arm_round_deadline(restart=restart_deadline)
+
+    def _note_dispatch_failure(self, learner_id: str, exc: Exception) -> None:
+        with self._lock:
+            record = self._learners.get(learner_id)
+            if record is None:
+                return
+            record.dispatch_failures += 1
+            count = record.dispatch_failures
+        limit = self.config.max_dispatch_failures
+        if limit > 0 and count == limit:
+            logger.warning(
+                "learner %s unreachable after %d failed dispatches (%r); "
+                "excluded from cohort sampling until it reports or rejoins",
+                learner_id, count, exc)
 
     def _send_eval_tasks(self) -> None:
         """SendEvaluationTasks (controller.cc:571-647) + digest callback."""
@@ -685,6 +741,12 @@ class Controller:
             blob = self._community_blob
             learners = list(self._learners.values())
             iteration = self.global_iteration
+            # bind eval timestamps to the SUBMITTING round's metadata — the
+            # digest callback may fire after _complete_round swapped
+            # _current_meta, and the received_at must land in the same round
+            # record as its submitted_at (the reference keeps this lineage
+            # clean, controller.cc:582-586, :673-675)
+            meta = self._current_meta
         if blob is None:
             return
         entry: Dict[str, Any] = {"global_iteration": iteration, "evaluations": {}}
@@ -701,12 +763,13 @@ class Controller:
                 metrics=list(cfg.metrics),
             )
             with self._lock:
-                self._current_meta.eval_submitted_at[record.learner_id] = time.time()
+                meta.eval_submitted_at[record.learner_id] = time.time()
 
-            def _digest(result: EvalResult, lid=record.learner_id, entry=entry):
+            def _digest(result: EvalResult, lid=record.learner_id,
+                        entry=entry, meta=meta):
                 with self._lock:
                     entry["evaluations"][lid] = result.evaluations
-                    self._current_meta.eval_received_at[lid] = time.time()
+                    meta.eval_received_at[lid] = time.time()
 
             try:
                 record.proxy.evaluate(task, _digest)
@@ -734,6 +797,11 @@ class Controller:
                 "round_metadata": [m.to_dict() for m in self.round_metadata],
                 "community_evaluations": self._snapshot_evaluations(),
             }
+            # Rolling rules (FedRec) carry cross-round state; persist the
+            # contribution scales so resume can rebuild wc_scaled/z from the
+            # store's lineage (aggregation/rolling.py rehydrate).
+            if hasattr(self._aggregator, "export_scales"):
+                state["agg_scales"] = self._aggregator.export_scales()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
@@ -764,6 +832,14 @@ class Controller:
                 global_iteration=self.global_iteration)
         if blob:
             self.set_community_model(blob)
+        agg_scales = state.get("agg_scales")
+        if agg_scales and hasattr(self._aggregator, "rehydrate"):
+            # FedRec restart-correctness: without this, the rolling sum would
+            # silently rebuild from scratch and stragglers' prior
+            # contributions would double-count on their next report.
+            restored = self._aggregator.rehydrate(self._store, agg_scales)
+            logger.info("rehydrated %d/%d rolling contributions from store",
+                        restored, len(agg_scales))
         logger.info("restored checkpoint %s at round %d",
                     path, self.global_iteration)
         return True

@@ -41,6 +41,17 @@ class RpcLearnerProxy:
     def run_task(self, task: TrainTask) -> None:
         self._client.call_async("RunTask", task.to_wire())
 
+    def run_task_with_callback(self, task: TrainTask, on_error) -> None:
+        """Dispatch + failure notification: feeds the controller's learner
+        liveness tracking (consecutive failed dispatches)."""
+        # RunTask acks immediately (non-blocking learner dispatch):
+        # wait_ready=False surfaces UNAVAILABLE from a dead endpoint at once
+        # (liveness counts in seconds, not 60 s deadlines), and the timeout
+        # bounds a connected-but-unresponsive peer.
+        self._client.call_async("RunTask", task.to_wire(),
+                                error_callback=on_error, timeout=60.0,
+                                wait_ready=False)
+
     def evaluate(self, task: EvalTask, callback: Callable[[EvalResult], None]) -> None:
         self._client.call_async(
             "EvaluateModel", task.to_wire(),
@@ -58,8 +69,15 @@ class ControllerServer:
 
     def __init__(self, controller: Controller, host: str = "0.0.0.0",
                  port: int = 50051, ssl=None):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+
         self.controller = controller
         self._server = RpcServer(host, port, ssl=ssl)
+        # standard grpc.health.v1 alongside the custom status RPC
+        # (reference controller_servicer.cc:7-9,32-33)
+        self._health_servicer = HealthServicer()
+        self._health_servicer.set_status(CONTROLLER_SERVICE, SERVING)
+        self._server.add_service(self._health_servicer.service())
         self._server.add_service(BytesService(CONTROLLER_SERVICE, {
             "JoinFederation": self._join,
             "LeaveFederation": self._leave,
@@ -117,6 +135,9 @@ class ControllerServer:
     def stop(self) -> None:
         if self._shutdown_event.is_set():
             return
+        from metisfl_tpu.comm.health import NOT_SERVING
+
+        self._health_servicer.set_all(NOT_SERVING)
         self._shutdown_event.set()
         self.controller.shutdown()
         self._server.stop()

@@ -24,11 +24,14 @@ from metisfl_tpu.tensor.pytree import pack_model
 
 
 class _DirectLearnerProxy:
-    """Controller → learner over direct calls (eval on a daemon thread to
-    keep the dispatch non-blocking like the reference's CompletionQueues)."""
+    """Controller → learner over direct calls (eval on a worker thread to
+    keep the dispatch non-blocking like the reference's CompletionQueues).
+    Eval threads are tracked so shutdown can join them — a daemon thread
+    killed mid-jit at interpreter exit aborts the process in C++."""
 
     def __init__(self, get_learner: Callable[[], Learner]):
         self._get_learner = get_learner
+        self._threads: List[threading.Thread] = []
 
     def run_task(self, task: TrainTask) -> None:
         self._get_learner().run_task(task)
@@ -39,10 +42,19 @@ class _DirectLearnerProxy:
         def _run():
             callback(learner.evaluate(task))
 
-        threading.Thread(target=_run, daemon=True).start()
+        thread = threading.Thread(target=_run, daemon=True)
+        self._threads = [t for t in self._threads if t.is_alive()]
+        self._threads.append(thread)
+        thread.start()
 
     def shutdown(self) -> None:
-        pass
+        self.join_evals()
+
+    def join_evals(self, timeout_s: float = 30.0) -> None:
+        deadline = time.time() + timeout_s
+        for thread in self._threads:
+            thread.join(timeout=max(0.1, deadline - time.time()))
+        self._threads = [t for t in self._threads if t.is_alive()]
 
 
 class InProcessFederation:
@@ -51,13 +63,16 @@ class InProcessFederation:
     def __init__(self, config: FederationConfig, secure_backend=None):
         self.config = config
         self._learners_by_port: Dict[int, Learner] = {}
+        self._proxies: List[_DirectLearnerProxy] = []
         self.controller = Controller(config, self._make_proxy,
                                      secure_backend=secure_backend)
         self.learners: List[Learner] = []
 
     def _make_proxy(self, record: LearnerRecord) -> LearnerProxy:
         port = record.port
-        return _DirectLearnerProxy(lambda: self._learners_by_port[port])
+        proxy = _DirectLearnerProxy(lambda: self._learners_by_port[port])
+        self._proxies.append(proxy)
+        return proxy
 
     def add_learner(self, model_ops, train_dataset, val_dataset=None,
                     test_dataset=None, secure_backend=None) -> Learner:
@@ -111,6 +126,10 @@ class InProcessFederation:
         for learner in self.learners:
             learner.shutdown()
         self.controller.shutdown()
+        # drain in-flight eval threads: dying mid-XLA at interpreter exit
+        # takes the whole process down with a C++ abort
+        for proxy in self._proxies:
+            proxy.join_evals()
 
     def statistics(self) -> dict:
         return self.controller.get_statistics()

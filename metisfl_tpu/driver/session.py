@@ -400,6 +400,50 @@ class DriverSession:
     def get_statistics(self) -> dict:
         return self._client.get_statistics()
 
+    def run_inference(self, learner_index: int = 0, inputs=None,
+                      dataset: str = "test", batch_size: int = 256,
+                      max_examples: int = 0, timeout_s: float = 120.0):
+        """Run the community model's inference on one learner and return its
+        predictions as a numpy array (the reference driver's counterpart to
+        the learner's third task type, reference learner.py:311-330).
+
+        ``inputs`` (optional numpy array) ships explicit examples; otherwise
+        the learner infers over its local ``dataset`` split.
+        """
+        import uuid as _uuid
+
+        import numpy as np
+
+        from metisfl_tpu.comm.messages import InferResult, InferTask
+        from metisfl_tpu.comm.rpc import RpcClient
+        from metisfl_tpu.controller.service import LEARNER_SERVICE
+        from metisfl_tpu.tensor.pytree import ModelBlob
+
+        endpoints = self._client.list_learners()
+        if not endpoints:
+            raise RuntimeError("no learners registered")
+        ep = endpoints[learner_index % len(endpoints)]
+        model = self._client.get_community_model()
+        task = InferTask(
+            task_id=_uuid.uuid4().hex,
+            learner_id=ep.get("learner_id", ""),
+            model=model,
+            batch_size=batch_size,
+            dataset=dataset,
+            inputs=(ModelBlob(tensors=[("x", np.asarray(inputs))]).to_bytes()
+                    if inputs is not None else b""),
+            max_examples=max_examples,
+        )
+        client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,
+                           ssl=self.config.ssl)
+        try:
+            result = InferResult.from_wire(
+                client.call("RunInference", task.to_wire(), timeout=timeout_s))
+        finally:
+            client.close()
+        return dict(ModelBlob.from_bytes(result.predictions).tensors)[
+            "predictions"]
+
     def save_experiment(self, path: Optional[str] = None) -> str:
         path = path or os.path.join(self.workdir, "experiment.json")
         with open(path, "w") as f:

@@ -31,6 +31,8 @@ import numpy as np
 from metisfl_tpu.comm.messages import (
     EvalResult,
     EvalTask,
+    InferResult,
+    InferTask,
     JoinReply,
     JoinRequest,
     TaskResult,
@@ -210,6 +212,40 @@ class Learner:
             learner_id=self.learner_id,
             round_id=task.round_id,
             evaluations=evaluations,
+            duration_ms=(time.time() - t0) * 1e3,
+        )
+
+    def infer(self, task: InferTask) -> InferResult:
+        """Blocking inference on a shipped model (the reference learner's
+        third task type, learner.py:311-330): predictions over explicit
+        inputs or a named local split."""
+        t0 = time.time()
+        variables = self._load_model(task.model) if task.model else None
+        if task.inputs:
+            blob = ModelBlob.from_bytes(task.inputs)
+            tensors = dict(blob.tensors)
+            if "x" not in tensors:
+                raise ValueError("InferTask.inputs must pack an 'x' tensor")
+            x = tensors["x"]
+        else:
+            name = task.dataset or "test"
+            ds = self.datasets.get(name)
+            if ds is None or len(ds) == 0:
+                raise ValueError(
+                    f"inference requested on dataset {name!r} but this "
+                    "learner has no such split (available: "
+                    f"{[k for k, v in self.datasets.items() if v]})")
+            x = ds.x
+        if task.max_examples > 0:
+            x = x[: task.max_examples]
+        preds = self.model_ops.infer(x, task.batch_size, variables=variables)
+        return InferResult(
+            task_id=task.task_id,
+            learner_id=self.learner_id,
+            round_id=task.round_id,
+            predictions=ModelBlob(
+                tensors=[("predictions", np.asarray(preds))]).to_bytes(),
+            num_examples=int(len(x)),
             duration_ms=(time.time() - t0) * 1e3,
         )
 

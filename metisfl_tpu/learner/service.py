@@ -12,7 +12,7 @@ import threading
 from typing import Optional
 
 from metisfl_tpu.comm.codec import dumps
-from metisfl_tpu.comm.messages import EvalTask, TrainTask
+from metisfl_tpu.comm.messages import EvalTask, InferTask, TrainTask
 from metisfl_tpu.comm.rpc import BytesService, RpcServer
 from metisfl_tpu.controller.service import LEARNER_SERVICE, ControllerClient
 from metisfl_tpu.learner.learner import Learner
@@ -23,11 +23,17 @@ logger = logging.getLogger("metisfl_tpu.learner.service")
 class LearnerServer:
     def __init__(self, learner: Learner, host: str = "0.0.0.0", port: int = 0,
                  ssl=None):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+
         self.learner = learner
         self._server = RpcServer(host, port, ssl=ssl)
+        self._health_servicer = HealthServicer()
+        self._health_servicer.set_status(LEARNER_SERVICE, SERVING)
+        self._server.add_service(self._health_servicer.service())
         self._server.add_service(BytesService(LEARNER_SERVICE, {
             "RunTask": self._run_task,
             "EvaluateModel": self._evaluate,
+            "RunInference": self._infer,
             "GetHealthStatus": self._health,
             "ShutDown": self._shutdown_rpc,
         }))
@@ -42,6 +48,9 @@ class LearnerServer:
 
     def _evaluate(self, raw: bytes) -> bytes:
         return self.learner.evaluate(EvalTask.from_wire(raw)).to_wire()
+
+    def _infer(self, raw: bytes) -> bytes:
+        return self.learner.infer(InferTask.from_wire(raw)).to_wire()
 
     def _health(self, raw: bytes) -> bytes:
         return dumps({"status": "SERVING", "tasks_received": self._tasks_received})
@@ -59,6 +68,9 @@ class LearnerServer:
     def stop(self, leave: bool = True) -> None:
         if self._shutdown_event.is_set():
             return
+        from metisfl_tpu.comm.health import NOT_SERVING
+
+        self._health_servicer.set_all(NOT_SERVING)
         logger.info("learner server stopping (leave=%s)", leave)
         self._shutdown_event.set()
         try:

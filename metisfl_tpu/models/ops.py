@@ -294,9 +294,18 @@ class FlaxModelOps:
 
         place = self._shard_batch if self.mesh is not None else jnp.asarray
         stream = dataset.infinite_batches(params_cfg.batch_size)
+        # jax.profiler trace of steady-state steps (SURVEY.md §5.1): start
+        # AFTER the compile step so the trace shows the hot loop, not tracing
+        profile_from = 1 if total_steps > 1 else 0
+        profile_until = profile_from + max(1, params_cfg.profile_steps)
+        profiling = False
         for step_idx in range(total_steps):
             if cancel_event is not None and cancel_event.is_set():
                 break
+            if (params_cfg.profile_dir and not profiling
+                    and step_idx == profile_from):
+                jax.profiler.start_trace(params_cfg.profile_dir)
+                profiling = True
             x, y = next(stream)
             rng = jax.random.fold_in(rng, step_idx)
             t0 = time.perf_counter()
@@ -307,6 +316,10 @@ class FlaxModelOps:
                 # skip the compile step for steady-state timing
                 jax.block_until_ready(loss)
                 step_times.append(time.perf_counter() - t0)
+            if profiling and step_idx + 1 >= profile_until:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                profiling = False
             completed += 1
             epoch_losses.append((loss, acc))
             if (step_idx + 1) % steps_per_epoch == 0 or step_idx == total_steps - 1:
@@ -317,6 +330,10 @@ class FlaxModelOps:
                 losses.extend(ls)
                 accs.extend(as_)
                 epoch_losses = []
+
+        if profiling:
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
 
         if epoch_losses:
             losses.extend(float(l) for l, _ in epoch_losses)
@@ -341,6 +358,33 @@ class FlaxModelOps:
             },
             epoch_metrics=epoch_metrics,
         )
+
+    # -- inference ---------------------------------------------------------
+    def infer(self, x: np.ndarray, batch_size: int = 256,
+              variables: Optional[Pytree] = None) -> np.ndarray:
+        """Batched forward pass → stacked model outputs (logits/predictions).
+
+        The reference's third ModelOps task type (model_ops.py ``infer``,
+        learner.py:311-330); here one cached jit forward reused across calls.
+        Passing ``variables`` runs inference on an explicit model without
+        touching the engine's training slot.
+        """
+        if not hasattr(self, "_infer_compiled"):
+            self._infer_compiled = jax.jit(
+                lambda v, xb: self._apply(v, xb, train=False))
+        if variables is None:
+            variables = self.variables
+        elif self.mesh is not None:
+            variables = self._shard(variables)
+        else:
+            variables = jax.tree.map(jnp.asarray, variables)
+        outs = []
+        for start in range(0, len(x), batch_size):
+            batch = jnp.asarray(x[start : start + batch_size])
+            outs.append(np.asarray(self._infer_compiled(variables, batch)))
+        if not outs:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(outs, axis=0)
 
     # -- evaluation --------------------------------------------------------
     def _make_eval(self, metric_names: Tuple[str, ...]):

@@ -17,6 +17,8 @@ reference examples/keras/models/imdb_lstm.py). Designed TPU-first:
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -45,15 +47,21 @@ class LoRADense(nn.Module):
     rank: int = 0
     alpha: float = 16.0
     use_bias: bool = True
+    # computation dtype (mixed precision: fp32 params, e.g. bf16 compute —
+    # the MXU-native mode); None keeps full fp32
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
-        y = nn.Dense(self.features, use_bias=self.use_bias, name="base")(x)
+        y = nn.Dense(self.features, use_bias=self.use_bias,
+                     dtype=self.dtype, name="base")(x)
         if self.rank > 0:
             a = self.param("lora_a", nn.initializers.normal(0.02),
                            (x.shape[-1], self.rank))
             b = self.param("lora_b", nn.initializers.zeros,
                            (self.rank, self.features))
+            if self.dtype is not None:
+                a, b = a.astype(self.dtype), b.astype(self.dtype)
             y = y + (x @ a) @ b * (self.alpha / self.rank)
         return y
 
@@ -89,14 +97,22 @@ class Attention(nn.Module):
     sp_mesh: object = None
     sp_axis: str = "sp"
     use_flash: bool = False
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         B, L, _ = x.shape
         head_dim = self.dim // self.heads
+        if self.dropout > 0.0 and (self.use_flash or self.sp_mesh is not None):
+            # neither kernelized path materializes the (L, L) weight matrix,
+            # so attention-weight dropout cannot be applied there
+            raise ValueError(
+                "attention dropout > 0 is only supported on the dense "
+                "attention path; set dropout=0 or disable use_flash/sp_mesh")
 
         def proj(name, rank=0):
-            return LoRADense(self.dim, rank=rank, use_bias=False, name=name)
+            return LoRADense(self.dim, rank=rank, use_bias=False,
+                             dtype=self.dtype, name=name)
 
         # LoRA on q/v only (standard practice)
         q = proj("wq", self.lora_rank)(x)
@@ -107,8 +123,9 @@ class Attention(nn.Module):
         v = v.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
         if self.rotary:
             positions = jnp.arange(L, dtype=jnp.float32)
-            q = _rotary(q, positions)
-            k = _rotary(k, positions)
+            dt = q.dtype
+            q = _rotary(q, positions).astype(dt)
+            k = _rotary(k, positions).astype(dt)
         if self.sp_mesh is not None:
             from metisfl_tpu.parallel.ringattn import make_ring_attention
             out = make_ring_attention(self.sp_mesh, self.sp_axis,
@@ -117,18 +134,22 @@ class Attention(nn.Module):
             from metisfl_tpu.ops import flash_attention
             out = flash_attention(q, k, v, self.causal)
         else:
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * float(
-                1.0 / np.sqrt(head_dim))
+            # softmax in fp32 regardless of compute dtype (bf16 exp/normalize
+            # loses too much precision), then back to the compute dtype so
+            # the PV matmul stays on the MXU's native path
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) * float(1.0 / np.sqrt(head_dim))
             if self.causal:
                 mask = jnp.tril(jnp.ones((L, L), bool))
                 scores = jnp.where(mask, scores,
                                    jnp.finfo(scores.dtype).min)
-            weights = nn.softmax(scores, axis=-1)
+            weights = nn.softmax(scores, axis=-1).astype(v.dtype)
             weights = nn.Dropout(self.dropout,
                                  deterministic=not train)(weights)
             out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, self.dim)
-        return nn.Dense(self.dim, use_bias=False, name="wo")(out)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                        name="wo")(out)
 
 
 class SwiGLU(nn.Module):
@@ -136,25 +157,29 @@ class SwiGLU(nn.Module):
 
     dim: int
     hidden: int
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
-        gate = nn.Dense(self.hidden, use_bias=False, name="gate")(x)
-        up = nn.Dense(self.hidden, use_bias=False, name="up")(x)
-        return nn.Dense(self.dim, use_bias=False, name="down")(
-            nn.silu(gate) * up)
+        gate = nn.Dense(self.hidden, use_bias=False, dtype=self.dtype,
+                        name="gate")(x)
+        up = nn.Dense(self.hidden, use_bias=False, dtype=self.dtype,
+                      name="up")(x)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                        name="down")(nn.silu(gate) * up)
 
 
 class GeluMLP(nn.Module):
     dim: int
     hidden: int
     dropout: float = 0.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.gelu(nn.Dense(self.hidden, name="fc1")(x))
+        x = nn.gelu(nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.Dense(self.dim, name="fc2")(x)
+        return nn.Dense(self.dim, dtype=self.dtype, name="fc2")(x)
 
 
 class EncoderBlock(nn.Module):
@@ -165,14 +190,17 @@ class EncoderBlock(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     use_flash: bool = False
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, dropout=self.dropout,
-                          use_flash=self.use_flash,
-                          name="attn")(nn.LayerNorm()(x), train=train)
+                          use_flash=self.use_flash, dtype=self.dtype,
+                          name="attn")(
+            nn.LayerNorm(dtype=self.dtype)(x), train=train)
         x = x + GeluMLP(self.dim, self.mlp_ratio * self.dim, self.dropout,
-                        name="mlp")(nn.LayerNorm()(x), train=train)
+                        dtype=self.dtype, name="mlp")(
+            nn.LayerNorm(dtype=self.dtype)(x), train=train)
         return x
 
 
@@ -185,15 +213,17 @@ class DecoderBlock(nn.Module):
     lora_rank: int = 0
     sp_mesh: object = None
     use_flash: bool = False
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
                           lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
-                          use_flash=self.use_flash,
-                          name="attn")(nn.RMSNorm()(x), train=train)
-        x = x + SwiGLU(self.dim, self.mlp_ratio * self.dim,
-                       name="mlp")(nn.RMSNorm()(x))
+                          use_flash=self.use_flash, dtype=self.dtype,
+                          name="attn")(
+            nn.RMSNorm(dtype=self.dtype)(x), train=train)
+        x = x + SwiGLU(self.dim, self.mlp_ratio * self.dim, dtype=self.dtype,
+                       name="mlp")(nn.RMSNorm(dtype=self.dtype)(x))
         return x
 
 
@@ -208,21 +238,23 @@ class ViTLite(nn.Module):
     heads: int = 4
     patch: int = 4
     dropout: float = 0.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim == 3:
             x = x[..., None]
         x = nn.Conv(self.dim, (self.patch,) * 2, strides=(self.patch,) * 2,
-                    name="patch_embed")(x)
+                    dtype=self.dtype, name="patch_embed")(x)
         x = x.reshape(x.shape[0], -1, self.dim)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, x.shape[1], self.dim))
-        x = x + pos
+        x = x + pos.astype(x.dtype)
         for i in range(self.depth):
             x = EncoderBlock(self.dim, self.heads, dropout=self.dropout,
-                             name=f"block_{i}")(x, train=train)
-        x = nn.LayerNorm()(x).mean(axis=1)
+                             dtype=self.dtype, name=f"block_{i}")(
+                x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x).mean(axis=1)
         return nn.Dense(self.num_classes, name="head")(x)
 
 
@@ -236,6 +268,7 @@ class BertLite(nn.Module):
     heads: int = 4
     max_len: int = 512
     dropout: float = 0.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -243,14 +276,16 @@ class BertLite(nn.Module):
         if L > self.max_len:
             raise ValueError(f"sequence length {L} exceeds max_len "
                              f"{self.max_len}")
-        x = nn.Embed(self.vocab_size, self.dim, name="embed")(tokens)
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, self.max_len, self.dim))
-        x = x + pos[:, :L]
+        x = x + pos[:, :L].astype(x.dtype)
         for i in range(self.depth):
             x = EncoderBlock(self.dim, self.heads, dropout=self.dropout,
-                             name=f"block_{i}")(x, train=train)
-        x = nn.LayerNorm()(x).mean(axis=1)
+                             dtype=self.dtype, name=f"block_{i}")(
+                x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x).mean(axis=1)
         return nn.Dense(self.num_classes, name="head")(x)
 
 
@@ -269,15 +304,23 @@ class LlamaLite(nn.Module):
     sp_mesh: object = None
     # single-chip pallas flash-attention kernel (ops/flash_attention.py)
     use_flash: bool = False
+    # computation dtype; jnp.bfloat16 is the MXU-native mixed-precision mode
+    # (params stay fp32, activations/matmuls run bf16; loss/logits fp32)
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        x = nn.Embed(self.vocab_size, self.dim, name="embed")(tokens)
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
         for i in range(self.depth):
             x = DecoderBlock(self.dim, self.heads,
                              lora_rank=self.lora_rank,
                              sp_mesh=self.sp_mesh,
                              use_flash=self.use_flash,
+                             dtype=self.dtype,
                              name=f"block_{i}")(x, train=train)
-        x = nn.RMSNorm()(x)
-        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)
+        x = nn.RMSNorm(dtype=self.dtype)(x)
+        # logits in fp32: softmax-cross-entropy over a large vocab is
+        # precision-sensitive, and this final cast is cheap
+        return nn.Dense(self.vocab_size, use_bias=False,
+                        name="lm_head")(x.astype(jnp.float32))

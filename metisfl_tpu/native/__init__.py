@@ -12,6 +12,7 @@ place atomically.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -20,13 +21,26 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "ckks.cc")
 _SO = os.path.join(_DIR, "libmetisfl_ckks.so")
+_HASH = _SO + ".srchash"
 _lock = threading.Lock()
 _lib = None
 
 
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _needs_build() -> bool:
-    return (not os.path.exists(_SO)
-            or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+    """The binary is never committed — it is identified by the sha256 of the
+    source it was built from (mtimes are meaningless after a fresh clone)."""
+    if not os.path.exists(_SO) or not os.path.exists(_HASH):
+        return True
+    try:
+        with open(_HASH) as f:
+            return f.read().strip() != _src_hash()
+    except OSError:
+        return True
 
 
 def _build() -> None:
@@ -37,6 +51,10 @@ def _build() -> None:
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, _SO)
+        fd, tmp_hash = tempfile.mkstemp(dir=_DIR)
+        with os.fdopen(fd, "w") as f:
+            f.write(_src_hash())
+        os.replace(tmp_hash, _HASH)
     except subprocess.CalledProcessError as exc:
         raise RuntimeError(
             f"native CKKS build failed:\n{exc.stderr}") from exc
@@ -53,7 +71,13 @@ def load_ckks() -> ctypes.CDLL:
             return _lib
         if _needs_build():
             _build()
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/foreign-platform binary (e.g. copied checkout):
+            # rebuild from source once and retry
+            _build()
+            lib = ctypes.CDLL(_SO)
         lib.ckks_n.restype = ctypes.c_long
         lib.ckks_ciphertext_size.restype = ctypes.c_long
         lib.ckks_ciphertext_size.argtypes = [ctypes.c_long]

@@ -22,7 +22,10 @@ from typing import Any, List
 from metisfl_tpu.store.base import EvictionPolicy, ModelStore
 from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
 
-_BLOB_RE = re.compile(r"^(\d+)\.blob$")
+# packed pytrees land as .blob; verbatim byte payloads (ciphertexts) as
+# .opaque — tagged at WRITE time so a corrupt .blob stays a loud parse
+# error instead of being silently misread as an opaque payload
+_BLOB_RE = re.compile(r"^(\d+)\.(blob|opaque)$")
 _SAFE_ID = re.compile(r"[^A-Za-z0-9_.-]")
 
 
@@ -36,37 +39,44 @@ class DiskModelStore(ModelStore):
     def _dir(self, learner_id: str) -> str:
         return os.path.join(self.root, _SAFE_ID.sub("_", learner_id))
 
-    def _seqs(self, learner_id: str) -> List[int]:
+    def _entries(self, learner_id: str) -> List[tuple]:
+        """Sorted [(seq, filename)] of stored models for one learner."""
         path = self._dir(learner_id)
         if not os.path.isdir(path):
             return []
-        seqs = []
+        entries = []
         for name in os.listdir(path):
             match = _BLOB_RE.match(name)
             if match:
-                seqs.append(int(match.group(1)))
-        return sorted(seqs)
+                entries.append((int(match.group(1)), name))
+        return sorted(entries)
 
     def _append(self, learner_id: str, model: Any) -> None:
         path = self._dir(learner_id)
         os.makedirs(path, exist_ok=True)
-        seqs = self._seqs(learner_id)
-        seq = (seqs[-1] + 1) if seqs else 0
-        data = model if isinstance(model, (bytes, bytearray)) else pack_model(model)
+        entries = self._entries(learner_id)
+        seq = (entries[-1][0] + 1) if entries else 0
+        if isinstance(model, (bytes, bytearray)):
+            data, ext = bytes(model), "opaque"
+        else:
+            data, ext = pack_model(model), "blob"
         tmp = os.path.join(path, f".{seq}.tmp")
         with open(tmp, "wb") as f:
             f.write(data)
-        os.replace(tmp, os.path.join(path, f"{seq}.blob"))
+        os.replace(tmp, os.path.join(path, f"{seq}.{ext}"))
 
     def _lineage(self, learner_id: str) -> List[Any]:
         path = self._dir(learner_id)
         out = []
-        for seq in reversed(self._seqs(learner_id)):
-            with open(os.path.join(path, f"{seq}.blob"), "rb") as f:
+        for _, name in reversed(self._entries(learner_id)):
+            with open(os.path.join(path, name), "rb") as f:
                 data = f.read()
-            blob = ModelBlob.from_bytes(data)
+            if name.endswith(".opaque"):
+                out.append(data)  # verbatim payload, by write-time contract
+                continue
+            blob = ModelBlob.from_bytes(data)  # corruption raises loudly here
             if blob.opaque and not blob.tensors:
-                out.append(data)  # encrypted blob: hand back raw bytes
+                out.append(data)  # encrypted ModelBlob: hand back raw bytes
             else:
                 out.append({name: arr for name, arr in blob.tensors})
         return out
@@ -75,12 +85,12 @@ class DiskModelStore(ModelStore):
         shutil.rmtree(self._dir(learner_id), ignore_errors=True)
 
     def _evict(self, learner_id: str) -> None:
-        seqs = self._seqs(learner_id)
-        excess = len(seqs) - self.lineage_length
+        entries = self._entries(learner_id)
+        excess = len(entries) - self.lineage_length
         if excess <= 0:
             return
-        for seq in seqs[:excess]:
-            os.unlink(os.path.join(self._dir(learner_id), f"{seq}.blob"))
+        for _, name in entries[:excess]:
+            os.unlink(os.path.join(self._dir(learner_id), name))
 
     def _learner_ids(self) -> List[str]:
         return [d for d in os.listdir(self.root)

@@ -1,6 +1,8 @@
 """End-to-end in-process federations: the 'minimum slice' milestone test
 (SURVEY.md §7 step 4) — real training, real aggregation, sync + async."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -222,6 +224,123 @@ def test_checkpoint_and_resume(tmp_path):
         assert fed2.controller.community_model_bytes() is not None
     finally:
         fed2.shutdown()
+
+
+def test_eval_metadata_lands_in_submitting_round():
+    """eval_received_at must land in the same round record as its
+    eval_submitted_at — the digest callback may arrive after the next round's
+    metadata went live (VERDICT r2 weak #7)."""
+    fed, _ = _make_federation(num_learners=2)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        assert fed.wait_for_evaluations(1, timeout_s=120)
+        # round 0's (already-appended) metadata receives its own eval stamps
+        assert fed.wait_until(
+            lambda: fed.controller.round_metadata[0].eval_received_at,
+            timeout_s=60)
+        meta = fed.controller.round_metadata[0]
+        for lid, received in meta.eval_received_at.items():
+            assert lid in meta.eval_submitted_at
+            assert received >= meta.eval_submitted_at[lid]
+    finally:
+        fed.shutdown()
+
+
+def _fedrec_harness(tmp_path, tag):
+    """Controller-only async FedRec federation over no-op proxies with a
+    persistent disk store + per-round checkpointing (the protocol-level
+    fake-learner technique, reference test/learner_notrain_noeval.py)."""
+    from metisfl_tpu.config import CheckpointConfig, ModelStoreConfig
+
+    class _NopProxy:
+        def run_task(self, task):
+            pass
+
+        def evaluate(self, task, callback):
+            pass
+
+        def shutdown(self):
+            pass
+
+    from metisfl_tpu.controller.core import Controller
+
+    config = FederationConfig(
+        protocol="asynchronous",
+        aggregation=AggregationConfig(rule="fedrec", scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        model_store=ModelStoreConfig(store="disk",
+                                     root=str(tmp_path / f"store_{tag}"),
+                                     lineage_length=2),
+        checkpoint=CheckpointConfig(dir=str(tmp_path / f"ckpt_{tag}"),
+                                    every_n_rounds=1),
+    )
+    return Controller(config, lambda record: _NopProxy())
+
+
+def _fake_model(seed):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal((4, 3)).astype(np.float32),
+            "b": rng.standard_normal((3,)).astype(np.float32)}
+
+
+def _submit(controller, learner_id, token, model, rounds_before):
+    from metisfl_tpu.comm.messages import TaskResult
+    from metisfl_tpu.tensor.pytree import pack_model
+
+    assert controller.task_completed(TaskResult(
+        task_id=f"t{rounds_before}_{learner_id}", learner_id=learner_id,
+        auth_token=token, model=pack_model(model), completed_batches=1))
+    deadline = time.time() + 30
+    while controller.global_iteration <= rounds_before:
+        assert time.time() < deadline, "round did not complete"
+        time.sleep(0.01)
+
+
+def test_fedrec_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Kill-and-resume correctness for rolling aggregation (VERDICT r2 #4):
+    a resumed FedRec controller rebuilds its rolling state from the disk
+    store's lineage + checkpointed scales, so the community model after
+    resume matches the run that never crashed."""
+    from metisfl_tpu.comm.messages import JoinRequest
+    from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+    m0a, m1a, m0b = _fake_model(1), _fake_model(2), _fake_model(3)
+    seed = _fake_model(0)
+
+    def run(tag, crash_after_two):
+        ctrl = _fedrec_harness(tmp_path, tag)
+        ctrl.set_community_model(pack_model(seed))
+        joins = [ctrl.join(JoinRequest(hostname="h", port=5000 + i,
+                                       num_train_examples=10))
+                 for i in range(2)]
+        ids = [(j.learner_id, j.auth_token) for j in joins]
+        _submit(ctrl, ids[0][0], ids[0][1], m0a, 0)
+        _submit(ctrl, ids[1][0], ids[1][1], m1a, 1)
+        if crash_after_two:
+            ctrl.shutdown()  # "crash": state is whatever the checkpoint has
+            ctrl = _fedrec_harness(tmp_path, tag)
+            assert ctrl.restore_checkpoint()
+            assert ctrl.global_iteration == 2
+            # learners re-register with the same host/port order -> same ids
+            joins = [ctrl.join(JoinRequest(hostname="h", port=5000 + i,
+                                           num_train_examples=10))
+                     for i in range(2)]
+            ids = [(j.learner_id, j.auth_token) for j in joins]
+        _submit(ctrl, ids[0][0], ids[0][1], m0b, 2)
+        blob = ModelBlob.from_bytes(ctrl.community_model_bytes())
+        ctrl.shutdown()
+        return dict(blob.tensors)
+
+    expected = run("nocrash", False)
+    resumed = run("crash", True)
+    for name in expected:
+        np.testing.assert_allclose(resumed[name], expected[name], atol=1e-6)
+    # the resumed model reflects recency (m0b replaced m0a, m1a retained)
+    hand = {name: (m0b[name] + m1a[name]) / 2.0 for name in m0b}
+    for name in hand:
+        np.testing.assert_allclose(resumed[name], hand[name], atol=1e-5)
 
 
 def test_restore_without_checkpoint_is_fresh_start(tmp_path):

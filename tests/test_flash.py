@@ -40,10 +40,27 @@ def test_flash_gradients_match(qkv):
                                    atol=1e-5, rtol=1e-5)
 
 
-def test_flash_rejects_ragged_blocks(qkv):
-    q, k, v = qkv
-    with pytest.raises(ValueError, match="divide into blocks"):
-        flash_attention(q, k, v, False, 48, 48)
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("L", [40, 48, 80])
+def test_flash_handles_ragged_lengths(causal, L):
+    """Sequence lengths that do not divide the block size are padded and
+    masked inside the kernel (round 2 raised ValueError for these)."""
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 2, L, 16)), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal, 32, 32)
+    want = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    g_flash = jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal, 32, 32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(
+        lambda q, k, v: _dense_attention(q, k, v, causal).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
 
 
 def test_llama_flash_forward_matches_plain():

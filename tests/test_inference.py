@@ -1,0 +1,94 @@
+"""Inference task parity (the reference learner's third task type,
+reference metisfl/learner/learner.py:311-330): engine-level infer, the
+learner handler, and the RunInference RPC end to end."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import InferResult, InferTask
+from metisfl_tpu.comm.rpc import RpcClient
+from metisfl_tpu.controller.service import LEARNER_SERVICE
+from metisfl_tpu.learner.learner import Learner
+from metisfl_tpu.learner.service import LearnerServer
+from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+from metisfl_tpu.models.zoo import MLP
+from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+
+@pytest.fixture(scope="module")
+def engine_and_data():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((40, 6)).astype(np.float32)
+    y = rng.integers(0, 3, size=(40,)).astype(np.int32)
+    ops = FlaxModelOps(MLP(features=(8,), num_outputs=3), x[:2])
+    return ops, ArrayDataset(x, y)
+
+
+def test_model_ops_infer_matches_apply(engine_and_data):
+    ops, ds = engine_and_data
+    preds = ops.infer(ds.x, batch_size=16)
+    assert preds.shape == (40, 3)
+    direct = np.asarray(ops.module.apply(ops.variables, ds.x))
+    np.testing.assert_allclose(preds, direct, atol=1e-5)
+
+
+def test_model_ops_infer_explicit_variables(engine_and_data):
+    ops, ds = engine_and_data
+    other = FlaxModelOps(MLP(features=(8,), num_outputs=3), ds.x[:2],
+                         rng_seed=9)
+    preds = ops.infer(ds.x, batch_size=64, variables=other.get_variables())
+    direct = np.asarray(other.module.apply(other.variables, ds.x))
+    np.testing.assert_allclose(preds, direct, atol=1e-5)
+
+
+class _NopController:
+    def join(self, request):  # pragma: no cover - not used here
+        raise AssertionError
+
+    def leave(self, learner_id, auth_token):
+        return True
+
+    def task_completed(self, result):
+        return True
+
+
+def test_run_inference_rpc_roundtrip(engine_and_data):
+    """Seeded model over real gRPC: RunInference returns its predictions."""
+    ops, ds = engine_and_data
+    learner = Learner(model_ops=ops, train_dataset=ds, test_dataset=ds,
+                      controller=_NopController())
+    server = LearnerServer(learner, host="127.0.0.1", port=0)
+    port = server.start()
+    try:
+        seeded = FlaxModelOps(MLP(features=(8,), num_outputs=3), ds.x[:2],
+                              rng_seed=42)
+        task = InferTask(task_id="t1", model=pack_model(seeded.get_variables()),
+                         batch_size=16, dataset="test", max_examples=24)
+        client = RpcClient("127.0.0.1", port, LEARNER_SERVICE)
+        result = InferResult.from_wire(
+            client.call("RunInference", task.to_wire(), timeout=60))
+        client.close()
+        preds = dict(ModelBlob.from_bytes(result.predictions).tensors)[
+            "predictions"]
+        assert result.num_examples == 24
+        assert result.duration_ms > 0
+        want = np.asarray(seeded.module.apply(seeded.variables, ds.x[:24]))
+        np.testing.assert_allclose(preds, want, atol=1e-5)
+    finally:
+        server.stop(leave=False)
+
+
+def test_infer_task_explicit_inputs(engine_and_data):
+    ops, ds = engine_and_data
+    learner = Learner(model_ops=ops, train_dataset=ds,
+                      controller=_NopController())
+    inputs = ds.x[:8]
+    task = InferTask(
+        task_id="t2", model=pack_model(ops.get_variables()),
+        inputs=ModelBlob(tensors=[("x", inputs)]).to_bytes())
+    result = learner.infer(task)
+    preds = dict(ModelBlob.from_bytes(result.predictions).tensors)[
+        "predictions"]
+    assert preds.shape == (8, 3)
+    want = np.asarray(ops.module.apply(ops.variables, inputs))
+    np.testing.assert_allclose(preds, want, atol=1e-5)

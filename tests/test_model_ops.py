@@ -127,3 +127,18 @@ def test_register_custom_metric(ops):
     out = engine.evaluate(ds, metrics=["const_half"])
     assert out["const_half"] == pytest.approx(0.5)
     assert "loss" in out
+
+
+def test_train_profiler_traces(tmp_path):
+    """profile_dir captures jax.profiler traces of steady-state steps
+    (SURVEY.md §5.1 asks the rebuild to add exactly this)."""
+    import glob
+
+    ds = _toy_classification(seed=5)
+    engine = FlaxModelOps(MLP(features=(8,), num_outputs=3), ds.x[:2])
+    out = engine.train(ds, TrainParams(batch_size=16, local_steps=5,
+                                       profile_dir=str(tmp_path),
+                                       profile_steps=2))
+    assert out.completed_steps == 5
+    traces = glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
+    assert traces, "no profiler trace captured"

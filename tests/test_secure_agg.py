@@ -75,7 +75,8 @@ def test_identity_backend_weighted_sum():
     np.testing.assert_allclose(out, [2.0, 4.0])
 
 
-def _secure_federation(num_learners, backends, controller_backend):
+def _secure_federation(num_learners, backends, controller_backend,
+                       **cfg_kwargs):
     config = FederationConfig(
         protocol="synchronous",
         aggregation=AggregationConfig(rule="secure_agg", scaler="participants"),
@@ -83,6 +84,7 @@ def _secure_federation(num_learners, backends, controller_backend):
         train=TrainParams(batch_size=16, local_steps=3, learning_rate=0.05),
         eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=2),
+        **cfg_kwargs,
     )
     fed = InProcessFederation(config, secure_backend=controller_backend)
     rng = np.random.default_rng(3)
@@ -118,6 +120,45 @@ def test_masked_federation_end_to_end():
         from metisfl_tpu.tensor.pytree import ModelBlob
         blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
         assert blob.opaque and not blob.tensors
+    finally:
+        fed.shutdown()
+
+
+def test_masking_straggler_deadline_recovers():
+    """Masking + round deadline must not stall the federation: the deadline
+    drops the straggler, partial-cohort aggregation fails (masks only cancel
+    across ALL parties), and the controller abandons the round and
+    re-dispatches the full cohort — which succeeds because the round counter
+    (and hence the mask streams) never advanced."""
+    n = 3
+    backends = [MaskingBackend(federation_secret="fed", party_index=i,
+                               num_parties=n) for i in range(n)]
+    fed = _secure_federation(n, backends, MaskingBackend(num_parties=n),
+                             round_deadline_secs=2.0)
+    # learner 2 hangs on its first dispatch only, then behaves
+    target = fed.learners[2]
+    orig_run_task = target.run_task
+    seen = []
+
+    def flaky(task):
+        if not seen:
+            seen.append(task.task_id)
+            return  # hung: accepted, never reports
+        orig_run_task(task)
+
+    target.run_task = flaky
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=90), \
+            "federation stalled after masking straggler"
+        stats = fed.statistics()
+        assert stats["global_iteration"] >= 1
+        # the failed partial aggregation was surfaced into round metadata
+        assert any("aggregation failed" in err
+                   for meta in stats["round_metadata"]
+                   for err in meta["errors"])
+        # the completed round aggregated the FULL cohort
+        assert len(stats["round_metadata"][0]["selected_learners"]) == n
     finally:
         fed.shutdown()
 
