@@ -387,8 +387,6 @@ def bench_store(num_learners: int = 64):
 
 
 def run_bench(quick: bool):
-    import jax
-
     num_learners = 8 if quick else NUM_LEARNERS
     rounds = 2 if quick else ROUNDS
     errors = {}

@@ -129,6 +129,15 @@ class FederationConfig:
             raise ValueError(
                 "masking secure aggregation requires the 'participants' "
                 "scaler (pairwise masks only cancel under uniform scales)")
+        if (self.secure.enabled and self.secure.scheme == "masking"
+                and self.protocol == "asynchronous"):
+            # Pairwise masks only cancel when ALL parties' payloads enter one
+            # combine — structurally a synchronous barrier. Async secure
+            # federations need a partial-cohort-capable scheme (ckks).
+            raise ValueError(
+                "masking secure aggregation requires a synchronous or "
+                "semi-synchronous protocol; use scheme='ckks' for "
+                "asynchronous secure federations")
         if self.protocol not in ("synchronous", "semi_synchronous", "asynchronous"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:

@@ -177,6 +177,10 @@ class Controller:
         self._round_serial = 0
         self._deadline_timer: Optional[threading.Timer] = None
         self._expired_tasks: Dict[str, None] = {}  # ordered set of task_ids
+        # consecutive aggregation failures (reset on success): distinguishes
+        # transient partial-cohort failures from a deterministically broken
+        # federation, which must halt instead of retraining forever
+        self._agg_failures = 0
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -317,6 +321,9 @@ class Controller:
     # scheduling executor internals
     # ------------------------------------------------------------------ #
 
+    # consecutive aggregation failures tolerated before halting re-dispatch
+    _MAX_AGG_FAILURES = 10
+
     def _guard(self, fn, *args) -> None:
         try:
             fn(*args)
@@ -441,23 +448,10 @@ class Controller:
                 "round deadline (%.1fs) expired; aggregating %d reporter(s), "
                 "dropping stragglers %s", self.config.round_deadline_secs,
                 len(cohort), dropped)
-            try:
-                self._complete_round(cohort)
-            except Exception as exc:
-                # Partial-cohort aggregation can legitimately fail — masking
-                # secure-agg needs every party's payload to cancel the masks
-                # (secure/masking.py weighted_sum). Abandon the round and
-                # re-dispatch the FULL cohort instead of stalling: the round
-                # counter never advanced, so mask streams (keyed on round id)
-                # regenerate identically and a clean retry works.
-                logger.warning(
-                    "post-deadline aggregation failed (%r); abandoning round "
-                    "and re-dispatching the full cohort", exc)
-                with self._lock:
-                    self._current_meta.errors.append(
-                        f"post-deadline aggregation failed: {exc!r}")
-                self._scheduler.reset()
-                self._dispatch_train(self._sample_cohort())
+            # partial-cohort aggregation can legitimately fail (masking
+            # secure-agg needs every party); _complete_round records the
+            # error and re-dispatches a fresh full cohort itself
+            self._complete_round(cohort)
         else:
             logger.warning(
                 "round deadline (%.1fs) expired with no reporters (%s); "
@@ -472,9 +466,42 @@ class Controller:
 
     def _complete_round(self, cohort: Sequence[str]) -> None:
         """One ScheduleTasks pass (controller.cc:428-518): select, aggregate,
-        record metadata, evaluate, re-dispatch."""
+        record metadata, evaluate, re-dispatch.
+
+        Aggregation failure must never strand the federation: the error is
+        recorded in round metadata and the round re-dispatches — async
+        re-dispatches the reporters (so they are not left idle forever
+        waiting for a completion ack that aborted), sync abandons the round
+        and re-dispatches a fresh full cohort (mask streams are keyed on the
+        round counter, which did not advance, so secure retries are clean).
+        """
         selected = self._selector.select(cohort, self.active_learners())
-        self._compute_community_model(selected)
+        try:
+            self._compute_community_model(selected)
+            self._agg_failures = 0
+        except Exception as exc:
+            self._agg_failures += 1
+            with self._lock:
+                self._current_meta.errors.append(f"aggregation failed: {exc!r}")
+            if self._agg_failures >= self._MAX_AGG_FAILURES:
+                # deterministic breakage (version skew, corrupt payloads):
+                # retraining forever would never terminate — halt dispatch
+                # and leave the error trail; the driver's wall-clock cutoff
+                # (or an operator) takes it from here
+                logger.error(
+                    "aggregation failed %d consecutive times (%r); halting "
+                    "re-dispatch", self._agg_failures, exc)
+                return
+            logger.warning("aggregation failed (%r); re-dispatching", exc)
+            if self._shutdown.is_set():
+                return
+            if self._scheduler.name == "asynchronous":
+                active = self.active_learners()
+                self._dispatch_train([lid for lid in cohort if lid in active])
+            else:
+                self._scheduler.reset()
+                self._dispatch_train(self._sample_cohort())
+            return
         self._send_eval_tasks()
         with self._lock:
             self.global_iteration += 1
@@ -679,6 +706,11 @@ class Controller:
         # The dispatched set is the synchronous round barrier (participation
         # sampling means it can be a strict subset of the active learners).
         self._scheduler.notify_dispatched(list(learner_ids))
+        with self._lock:
+            if not self._current_meta.started_at:
+                # first dispatch of this round == round start
+                # (reference controller.cc:406-418)
+                self._current_meta.started_at = time.time()
         for lid in learner_ids:
             with self._lock:
                 record = self._learners.get(lid)
@@ -864,3 +896,21 @@ class Controller:
                 "round_metadata": [m.to_dict() for m in self.round_metadata],
                 "community_evaluations": self._snapshot_evaluations(),
             }
+
+    def get_runtime_metadata(self, tail: int = 0) -> List[dict]:
+        """Round-metadata lineage, optionally only the last ``tail`` rounds
+        (the reference's granular lineage getters, controller.proto:27-44 —
+        a 10k-round federation must not ship its whole history per poll)."""
+        with self._lock:
+            metas = (self.round_metadata[-tail:] if tail > 0
+                     else list(self.round_metadata))
+            return [m.to_dict() for m in metas]
+
+    def get_evaluation_lineage(self, tail: int = 0) -> List[dict]:
+        """Community-model evaluation lineage, optionally tail-bounded
+        (reference GetCommunityModelEvaluationLineage, controller.proto:27)."""
+        with self._lock:
+            evals = (self.community_evaluations[-tail:] if tail > 0
+                     else self.community_evaluations)
+            return [{**e, "evaluations": dict(e["evaluations"])}
+                    for e in evals]

@@ -85,6 +85,8 @@ class ControllerServer:
             "ReplaceCommunityModel": self._replace_model,
             "GetCommunityModel": self._get_model,
             "GetStatistics": self._get_statistics,
+            "GetRuntimeMetadata": self._get_runtime_metadata,
+            "GetEvaluationLineage": self._get_evaluation_lineage,
             "ListLearners": self._list_learners,
             "GetHealthStatus": self._health,
             "ShutDown": self._shutdown_rpc,
@@ -114,6 +116,17 @@ class ControllerServer:
 
     def _get_statistics(self, raw: bytes) -> bytes:
         return dumps(self.controller.get_statistics())
+
+    def _get_runtime_metadata(self, raw: bytes) -> bytes:
+        tail = int(loads(raw).get("tail", 0)) if raw else 0
+        return dumps({"global_iteration": self.controller.global_iteration,
+                      "round_metadata":
+                      self.controller.get_runtime_metadata(tail)})
+
+    def _get_evaluation_lineage(self, raw: bytes) -> bytes:
+        tail = int(loads(raw).get("tail", 0)) if raw else 0
+        return dumps({"community_evaluations":
+                      self.controller.get_evaluation_lineage(tail)})
 
     def _list_learners(self, raw: bytes) -> bytes:
         return dumps({"learners": self.controller.learner_endpoints()})
@@ -174,6 +187,17 @@ class ControllerClient:
 
     def get_statistics(self) -> dict:
         return loads(self._client.call("GetStatistics", b""))
+
+    def get_runtime_metadata(self, tail: int = 0) -> dict:
+        """{'global_iteration', 'round_metadata': last ``tail`` rounds}
+        (0 = full lineage)."""
+        raw = self._client.call("GetRuntimeMetadata", dumps({"tail": tail}))
+        return loads(raw)
+
+    def get_evaluation_lineage(self, tail: int = 0) -> list:
+        """Last ``tail`` evaluation entries (0 = full lineage)."""
+        raw = self._client.call("GetEvaluationLineage", dumps({"tail": tail}))
+        return loads(raw)["community_evaluations"]
 
     def list_learners(self) -> list:
         """Registered learner endpoints [{learner_id, hostname, port}] — the

@@ -355,13 +355,16 @@ class DriverSession:
         while True:
             time.sleep(poll_every_s)
             self._check_procs_alive()
-            stats = self._client.get_statistics()
+            # poll the tail-bounded lineage RPCs — a long-running federation
+            # must not ship its full history every 2 s (the unbounded
+            # GetStatistics dump is fetched once, at termination)
+            progress = self._client.get_runtime_metadata(tail=1)
             try:
                 self._known_endpoints = self._client.list_learners()
             except Exception:  # noqa: BLE001 - keep the stale snapshot
                 pass
 
-            if stats["global_iteration"] >= term.federation_rounds > 0:
+            if progress["global_iteration"] >= term.federation_rounds > 0:
                 logger.info("termination: reached %d rounds",
                             term.federation_rounds)
                 break
@@ -373,7 +376,9 @@ class DriverSession:
                 break
 
             if term.metric_cutoff_score > 0:
-                score = self._latest_mean_metric(stats, term.metric_name)
+                evals = self._client.get_evaluation_lineage(tail=5)
+                score = self._latest_mean_metric(
+                    {"community_evaluations": evals}, term.metric_name)
                 if score is not None and score >= term.metric_cutoff_score:
                     logger.info("termination: %s=%.4f ≥ cutoff",
                                 term.metric_name, score)

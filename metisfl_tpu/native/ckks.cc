@@ -404,6 +404,13 @@ long ckks_decrypt(void* vctx, const unsigned char* payload, long size,
   if (h.magic != MAGIC) return -2;
   if ((long)h.n_values < n) return -3;
   if (size != payload_size((long)h.n_values)) return -2;
+  // The header travels through the (honest-but-curious) aggregator; only
+  // the two scales the protocol can legitimately produce are accepted —
+  // a fresh ciphertext (2^V_BITS) or a weighted sum (2^(V_BITS+S_BITS)).
+  // Anything else would let a malicious aggregator rescale the recovered
+  // model undetected. (No MAC/freshness beyond this: the threat model is
+  // the reference's honest-but-curious controller, he_scheme.h.)
+  if (h.scale_bits != V_BITS && h.scale_bits != V_BITS + S_BITS) return -4;
   const double inv_scale = 1.0 / (double)(1ULL << h.scale_bits);
   const uint64_t* body = (const uint64_t*)(payload + sizeof(Header));
   const long blocks = h.n_blocks;

@@ -145,3 +145,16 @@ def test_ckks_federation_end_to_end(keys):
         assert blob.opaque and not blob.tensors  # ciphertext on the wire
     finally:
         fed.shutdown()
+
+
+def test_decrypt_rejects_tampered_scale(learner):
+    """A malicious aggregator must not be able to rescale the recovered
+    model by editing the payload header: only the two protocol-legitimate
+    plaintext scales (fresh ciphertext, weighted sum) decrypt."""
+    import struct
+
+    vec = np.linspace(-1, 1, 50)
+    ct = bytearray(learner.encrypt(vec))
+    struct.pack_into("<I", ct, 4, 8)  # scale_bits: header offset 4
+    with pytest.raises(RuntimeError):
+        learner.decrypt(bytes(ct), 50)

@@ -75,3 +75,28 @@ def test_fashionmnist_example_completes_rounds(tmp_path):
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "completed" in proc.stdout
     assert os.path.exists(tmp_path / "experiment.json")
+
+
+def test_ladder_rungs_execute(tmp_path):
+    """BASELINE.md config ladder (VERDICT r3 #2): each rung's protocol x
+    model combination actually executes and records round wall-clock. The
+    vit (semi-sync) and bert (async + CKKS secure agg) rungs run here; the
+    heavier resnet x16 rung runs in examples/ladder.py's default set."""
+    import json
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "ladder.py"),
+         "--rungs", "vit,bert", "--rounds", "1",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    with open(tmp_path / "ladder.json") as f:
+        summary = json.load(f)
+    assert {r["rung"] for r in summary} == {"vitlite_x8_semisync",
+                                           "bertlite_x8_async_ckks"}
+    for record in summary:
+        assert record["rounds_completed"] >= 1
+        assert record["round_wall_clock_s"][0] > 0
+    for key in ("vit", "bert"):
+        assert os.path.exists(tmp_path / f"experiment_{key}.json")

@@ -83,3 +83,32 @@ def test_large_payload(echo_server):
     payload = b"\xab" * (8 * 1024 * 1024)
     assert client.call("Echo", payload) == payload
     client.close()
+
+
+def test_lineage_tail_rpcs():
+    """Tail-bounded lineage getters (reference controller.proto:27-44):
+    polling must not ship the whole round history."""
+    from metisfl_tpu.config import FederationConfig
+    from metisfl_tpu.controller.core import Controller, RoundMetadata
+    from metisfl_tpu.controller.service import ControllerClient, ControllerServer
+
+    controller = Controller(FederationConfig(), lambda record: None)
+    # synthesize a 5-round history
+    for i in range(5):
+        controller.round_metadata.append(RoundMetadata(global_iteration=i))
+        controller.community_evaluations.append(
+            {"global_iteration": i, "evaluations": {}})
+    controller.global_iteration = 5
+    server = ControllerServer(controller, host="127.0.0.1", port=0)
+    port = server.start()
+    client = ControllerClient("127.0.0.1", port)
+    try:
+        out = client.get_runtime_metadata(tail=2)
+        assert out["global_iteration"] == 5
+        assert [m["global_iteration"] for m in out["round_metadata"]] == [3, 4]
+        assert len(client.get_runtime_metadata()["round_metadata"]) == 5
+        evals = client.get_evaluation_lineage(tail=3)
+        assert [e["global_iteration"] for e in evals] == [2, 3, 4]
+    finally:
+        client.close()
+        server.stop()
