@@ -383,6 +383,30 @@ def bench_store(num_learners: int = 64):
         out["store_disk_ciphertext_select_ms"] = round(
             (time.perf_counter() - t0) * 1e3, 1)
         assert isinstance(got, (bytes, bytearray)) and len(got) == len(blob)
+
+    # cached_disk: persistence + byte-bounded LRU (RedisModelStore role).
+    # Budgeted to the full working set: select serves from memory at disk
+    # durability (the byte-bound eviction itself is unit-tested; an LRU under
+    # a sequential scan of a larger-than-budget set degrades to disk reads)
+    from metisfl_tpu.store.cached import CachedDiskStore
+
+    model_bytes = sum(int(np.prod(s)) * 4 for s in MODEL_SHAPES.values())
+    with tempfile.TemporaryDirectory() as root:
+        cached = CachedDiskStore(root, EvictionPolicy.LINEAGE_LENGTH,
+                                 lineage_length=1,
+                                 cache_bytes=model_bytes * (num_learners + 1))
+        for lid, m in zip(ids, models):
+            cached.insert(lid, m)
+        t0 = time.perf_counter()
+        sel = cached.select(ids, k=1)
+        out["store_cached_select_all_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        assert len(sel) == num_learners
+        out["store_cached_hit_rate"] = round(
+            cached.cache_hits / max(1, cached.cache_hits
+                                    + cached.cache_misses), 3)
+        out["store_cached_resident_mb"] = round(
+            cached._cached_total / 1e6, 1)
     return out
 
 

@@ -100,6 +100,8 @@ class TrainParams(Message):
     optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
     # FedProx proximal term weight; 0 disables (reference fed_prox.py:10-103).
     proximal_mu: float = 0.0
+    # weight on sown auxiliary losses (MoE router load balancing); 0 disables
+    moe_aux_weight: float = 0.01
     # jax.profiler trace capture (SURVEY.md §5.1): when set, each training
     # task traces ``profile_steps`` steady-state (post-compile) steps into
     # this directory — TensorBoard/xprof-readable.

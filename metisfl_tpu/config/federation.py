@@ -41,9 +41,10 @@ class AggregationConfig:
 
 @dataclass
 class ModelStoreConfig:
-    store: str = "in_memory"                 # in_memory | disk
+    store: str = "in_memory"                 # in_memory | disk | cached_disk
     lineage_length: int = 0                  # 0 → derive from aggregation rule
     root: str = ""                           # disk store directory
+    cache_mb: int = 256                      # cached_disk memory budget
 
 
 @dataclass

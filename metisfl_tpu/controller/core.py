@@ -152,8 +152,10 @@ class Controller:
         lineage = store_cfg.lineage_length or self._aggregator.required_lineage
         lineage = max(lineage, self._aggregator.required_lineage)
         store_kwargs = {"lineage_length": lineage}
-        if store_cfg.store == "disk":
+        if store_cfg.store in ("disk", "cached_disk"):
             store_kwargs["root"] = store_cfg.root or "/tmp/metisfl_tpu_store"
+        if store_cfg.store == "cached_disk":
+            store_kwargs["cache_bytes"] = store_cfg.cache_mb << 20
         self._store = make_store(store_cfg.store, **store_kwargs)
 
         # community model state
@@ -836,10 +838,19 @@ class Controller:
                 state["agg_scales"] = self._aggregator.export_scales()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(buf)
-        os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+        # unique temp per writer: concurrent saves (per-round auto-checkpoint
+        # racing an operator-initiated one) must not share a staging file
+        import tempfile as _tempfile
+        fd, tmp = _tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                    prefix=".ckpt_", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(buf)
+            os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
         return path
 
     def restore_checkpoint(self, path: Optional[str] = None) -> bool:
@@ -880,13 +891,15 @@ class Controller:
     # statistics (driver)
     # ------------------------------------------------------------------ #
 
-    def _snapshot_evaluations(self) -> List[dict]:
+    def _snapshot_evaluations(self, tail: int = 0) -> List[dict]:
         """Copy evaluation entries deep enough to detach the mutable
         ``evaluations`` dict, which eval-digest callbacks keep inserting into
         under the lock — a caller serializing a shallow copy outside the lock
         would race those inserts. Call with ``self._lock`` held."""
+        entries = (self.community_evaluations[-tail:] if tail > 0
+                   else self.community_evaluations)
         return [{**e, "evaluations": dict(e["evaluations"])}
-                for e in self.community_evaluations]
+                for e in entries]
 
     def get_statistics(self) -> dict:
         with self._lock:
@@ -910,7 +923,4 @@ class Controller:
         """Community-model evaluation lineage, optionally tail-bounded
         (reference GetCommunityModelEvaluationLineage, controller.proto:27)."""
         with self._lock:
-            evals = (self.community_evaluations[-tail:] if tail > 0
-                     else self.community_evaluations)
-            return [{**e, "evaluations": dict(e["evaluations"])}
-                    for e in evals]
+            return self._snapshot_evaluations(tail)

@@ -175,12 +175,19 @@ class FlaxModelOps:
         except (TypeError, ValueError):  # pragma: no cover
             return False
 
-    def _apply(self, variables, x, train: bool, rngs=None):
+    def _apply(self, variables, x, train: bool, rngs=None,
+               collect_intermediates: bool = False):
         kwargs = {}
         if self._accepts_train_kwarg():
             kwargs["train"] = train
-        mutable = ["batch_stats"] if (train and self._has_batch_stats) else False
-        return self.module.apply(variables, x, rngs=rngs, mutable=mutable, **kwargs)
+        mutable = []
+        if train and self._has_batch_stats:
+            mutable.append("batch_stats")
+        if collect_intermediates:
+            # sown auxiliary losses (e.g. the MoE router's load-balance term)
+            mutable.append("intermediates")
+        return self.module.apply(variables, x, rngs=rngs,
+                                 mutable=mutable or False, **kwargs)
 
     # -- weights I/O -------------------------------------------------------
     def get_variables(self) -> Pytree:
@@ -199,6 +206,7 @@ class FlaxModelOps:
             float(params_cfg.learning_rate),
             tuple(sorted((params_cfg.optimizer_kwargs or {}).items())),
             float(params_cfg.proximal_mu),
+            float(params_cfg.moe_aux_weight),
             self._loss_name,
         )
         if key in self._step_cache:
@@ -232,18 +240,29 @@ class FlaxModelOps:
         has_bs = self._has_batch_stats
         loss_fn = self.loss_fn
 
+        aux_weight = float(params_cfg.moe_aux_weight)
+
         def loss_and_aux(params, batch_stats, global_params, x, y, rng):
             variables = {"params": params}
             if has_bs:
                 variables["batch_stats"] = batch_stats
-            out = self._apply(variables, x, train=True,
-                              rngs={"dropout": rng})
-            if has_bs:
-                logits, mutated = out
-                new_bs = mutated["batch_stats"]
-            else:
-                logits, new_bs = out, batch_stats
+            logits, mutated = self._apply(variables, x, train=True,
+                                          rngs={"dropout": rng},
+                                          collect_intermediates=True)
+            new_bs = mutated.get("batch_stats", batch_stats)
             loss = loss_fn(logits, y)
+            # sown auxiliary losses enter the objective (Switch MoE
+            # load-balancing — without this term the router can collapse
+            # onto one expert and capacity-drop most tokens)
+            if aux_weight > 0.0:
+                aux_terms = [
+                    leaf for path, leaf in
+                    jax.tree_util.tree_flatten_with_path(
+                        mutated.get("intermediates", {}))[0]
+                    if "aux_loss" in jax.tree_util.keystr(path)
+                ]
+                if aux_terms:
+                    loss = loss + aux_weight * sum(aux_terms)
             if mu > 0.0:
                 prox = sum(
                     jnp.sum(jnp.square(p - p0))

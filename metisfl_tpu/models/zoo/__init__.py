@@ -14,10 +14,12 @@ from metisfl_tpu.models.zoo.transformer import (
     BertLite,
     LlamaLite,
     LoRADense,
+    MoEMLP,
     ViTLite,
 )
 
 __all__ = [
     "MLP", "HousingMLP", "FashionMnistCNN", "Cifar10CNN", "ResNet20",
-    "ViTLite", "BertLite", "LlamaLite", "LoRADense", "TRANSFORMER_RULES",
+    "ViTLite", "BertLite", "LlamaLite", "LoRADense", "MoEMLP",
+    "TRANSFORMER_RULES",
 ]

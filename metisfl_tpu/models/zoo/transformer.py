@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
@@ -28,7 +29,12 @@ from jax.sharding import PartitionSpec as P
 # Megatron-style: column-parallel into the head/hidden dimension,
 # row-parallel back out, embeddings sharded over vocab rows. LoRA wraps the
 # base kernel under ``<name>/base/kernel``, hence the optional segment.
+# MoE expert stacks shard their leading expert axis over ``ep`` (expert
+# parallelism) and their hidden axis over ``tp`` — XLA inserts the
+# dispatch/combine all-to-alls between token- and expert-sharded layouts.
 TRANSFORMER_RULES = [
+    (r"experts_w1", P("ep", None, "tp")),
+    (r"experts_w2", P("ep", "tp", None)),
     (r"(wq|wk|wv|gate|up|fc1)(/base)?/kernel", P(None, "tp")),
     (r"(wo|down|fc2)(/base)?/kernel", P("tp", None)),
     (r"lora_b", P(None, "tp")),
@@ -169,6 +175,72 @@ class SwiGLU(nn.Module):
                         name="down")(nn.silu(gate) * up)
 
 
+class MoEMLP(nn.Module):
+    """Switch-style top-1 mixture-of-experts FFN (expert parallelism).
+
+    Expert weights are stacked on a leading expert axis (``experts_w1`` /
+    ``experts_w2``) that :data:`TRANSFORMER_RULES` shards over ``ep``.
+    Dispatch and combine are one-hot einsums over a fixed per-expert
+    capacity — static shapes, MXU-shaped (E, C, D) @ (E, D, H) batched
+    matmuls, and when token shardings (dp) and expert shardings (ep) differ
+    XLA inserts the all-to-alls over ICI. Routing follows the Switch
+    transformer: top-1 expert, tokens beyond an expert's capacity are
+    dropped (residual connections carry them through), and the standard
+    load-balance auxiliary loss is sown under
+    ``intermediates/moe_aux_loss``.
+    """
+
+    dim: int
+    hidden: int
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, D = x.shape
+        T = B * L
+        E = self.num_experts
+        tokens = x.reshape(T, D)
+        # routing in fp32: tiny matmul, precision-sensitive softmax
+        logits = nn.Dense(E, use_bias=False, name="router")(
+            tokens.astype(jnp.float32))
+        probs = nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)                  # (T,)
+        gate = jnp.max(probs, axis=-1)                           # (T,)
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (T, E)
+
+        # load-balance aux loss (Switch eq. 4): E * Σ_e fraction_e * prob_e
+        density = onehot.mean(axis=0)
+        router_prob = probs.mean(axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 E * jnp.sum(density * router_prob))
+
+        capacity = int(np.ceil(T / E * self.capacity_factor))
+        # position of each token within its expert's capacity buffer
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (T, E)
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        pos_cap = jax.nn.one_hot(
+            (pos * keep).sum(-1).astype(jnp.int32), capacity,
+            dtype=jnp.float32)                                   # (T, C)
+        dispatch = keep[:, :, None] * pos_cap[:, None, :]        # (T, E, C)
+
+        dt = self.dtype or tokens.dtype
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt),
+                               tokens.astype(dt))                # (E, C, D)
+        w1 = self.param("experts_w1",
+                        nn.initializers.normal(1.0 / np.sqrt(D)),
+                        (E, D, self.hidden))
+        w2 = self.param("experts_w2",
+                        nn.initializers.normal(1.0 / np.sqrt(self.hidden)),
+                        (E, self.hidden, D))
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1.astype(dt)))
+        out = jnp.einsum("ech,ehd->ecd", h, w2.astype(dt))       # (E, C, D)
+        combine = dispatch * gate[:, None, None]                 # (T, E, C)
+        mixed = jnp.einsum("tec,ecd->td", combine.astype(dt), out)
+        return mixed.reshape(B, L, D)
+
+
 class GeluMLP(nn.Module):
     dim: int
     hidden: int
@@ -213,6 +285,8 @@ class DecoderBlock(nn.Module):
     lora_rank: int = 0
     sp_mesh: object = None
     use_flash: bool = False
+    # > 0 replaces the SwiGLU FFN with a Switch MoE of this many experts
+    moe_experts: int = 0
     dtype: Any = None
 
     @nn.compact
@@ -222,8 +296,14 @@ class DecoderBlock(nn.Module):
                           use_flash=self.use_flash, dtype=self.dtype,
                           name="attn")(
             nn.RMSNorm(dtype=self.dtype)(x), train=train)
-        x = x + SwiGLU(self.dim, self.mlp_ratio * self.dim, dtype=self.dtype,
-                       name="mlp")(nn.RMSNorm(dtype=self.dtype)(x))
+        if self.moe_experts > 0:
+            ffn = MoEMLP(self.dim, self.mlp_ratio * self.dim,
+                         num_experts=self.moe_experts, dtype=self.dtype,
+                         name="moe")
+        else:
+            ffn = SwiGLU(self.dim, self.mlp_ratio * self.dim,
+                         dtype=self.dtype, name="mlp")
+        x = x + ffn(nn.RMSNorm(dtype=self.dtype)(x))
         return x
 
 
@@ -304,6 +384,9 @@ class LlamaLite(nn.Module):
     sp_mesh: object = None
     # single-chip pallas flash-attention kernel (ops/flash_attention.py)
     use_flash: bool = False
+    # expert parallelism: > 0 gives every block a Switch MoE FFN of this
+    # many experts (weights shardable over the mesh's "ep" axis)
+    moe_experts: int = 0
     # computation dtype; jnp.bfloat16 is the MXU-native mixed-precision mode
     # (params stay fp32, activations/matmuls run bf16; loss/logits fp32)
     dtype: Any = None
@@ -317,6 +400,7 @@ class LlamaLite(nn.Module):
                              lora_rank=self.lora_rank,
                              sp_mesh=self.sp_mesh,
                              use_flash=self.use_flash,
+                             moe_experts=self.moe_experts,
                              dtype=self.dtype,
                              name=f"block_{i}")(x, train=train)
         x = nn.RMSNorm(dtype=self.dtype)(x)

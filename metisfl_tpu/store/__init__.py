@@ -11,10 +11,14 @@ restarts without an external service.
 from metisfl_tpu.store.base import EvictionPolicy, ModelStore
 from metisfl_tpu.store.memory import InMemoryModelStore
 from metisfl_tpu.store.disk import DiskModelStore
+from metisfl_tpu.store.cached import CachedDiskStore
 
 STORES = {
     "in_memory": InMemoryModelStore,
     "disk": DiskModelStore,
+    # disk persistence + byte-bounded LRU memory cache (the reference's
+    # RedisModelStore role without an external service)
+    "cached_disk": CachedDiskStore,
 }
 
 
@@ -30,6 +34,7 @@ __all__ = [
     "EvictionPolicy",
     "InMemoryModelStore",
     "DiskModelStore",
+    "CachedDiskStore",
     "STORES",
     "make_store",
 ]

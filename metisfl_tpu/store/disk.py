@@ -51,7 +51,9 @@ class DiskModelStore(ModelStore):
                 entries.append((int(match.group(1)), name))
         return sorted(entries)
 
-    def _append(self, learner_id: str, model: Any) -> None:
+    def _append(self, learner_id: str, model: Any) -> int:
+        """Store one model; returns the sequence number it was filed under
+        (subclasses key caches off it)."""
         path = self._dir(learner_id)
         os.makedirs(path, exist_ok=True)
         entries = self._entries(learner_id)
@@ -64,22 +66,22 @@ class DiskModelStore(ModelStore):
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, os.path.join(path, f"{seq}.{ext}"))
+        return seq
+
+    def _read_entry(self, learner_id: str, filename: str) -> Any:
+        """Read + decode one stored model file."""
+        with open(os.path.join(self._dir(learner_id), filename), "rb") as f:
+            data = f.read()
+        if filename.endswith(".opaque"):
+            return data  # verbatim payload, by write-time contract
+        blob = ModelBlob.from_bytes(data)  # corruption raises loudly here
+        if blob.opaque and not blob.tensors:
+            return data  # encrypted ModelBlob: hand back raw bytes
+        return {name: arr for name, arr in blob.tensors}
 
     def _lineage(self, learner_id: str) -> List[Any]:
-        path = self._dir(learner_id)
-        out = []
-        for _, name in reversed(self._entries(learner_id)):
-            with open(os.path.join(path, name), "rb") as f:
-                data = f.read()
-            if name.endswith(".opaque"):
-                out.append(data)  # verbatim payload, by write-time contract
-                continue
-            blob = ModelBlob.from_bytes(data)  # corruption raises loudly here
-            if blob.opaque and not blob.tensors:
-                out.append(data)  # encrypted ModelBlob: hand back raw bytes
-            else:
-                out.append({name: arr for name, arr in blob.tensors})
-        return out
+        return [self._read_entry(learner_id, name)
+                for _, name in reversed(self._entries(learner_id))]
 
     def _erase(self, learner_id: str) -> None:
         shutil.rmtree(self._dir(learner_id), ignore_errors=True)

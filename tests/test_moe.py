@@ -1,0 +1,121 @@
+"""Expert parallelism: Switch-style MoE layer (models/zoo/transformer.py
+MoEMLP) — routing correctness, training, and ep-axis sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metisfl_tpu.models.zoo import MoEMLP
+
+
+@pytest.fixture(scope="module")
+def moe_setup():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+    module = MoEMLP(dim=16, hidden=32, num_experts=4, capacity_factor=8.0)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    return module, variables, x
+
+
+def test_moe_matches_per_token_expert_oracle(moe_setup):
+    """With capacity >= tokens the dispatch/combine einsums must equal the
+    obvious per-token computation: gate * expert(token)."""
+    module, variables, x = moe_setup
+    out = module.apply(variables, x)
+    params = variables["params"]
+    tokens = np.asarray(x).reshape(-1, 16)
+    logits = tokens.astype(np.float64) @ np.asarray(
+        params["router"]["kernel"], np.float64)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    idx = probs.argmax(-1)
+    gate = probs.max(-1)
+    w1 = np.asarray(params["experts_w1"])
+    w2 = np.asarray(params["experts_w2"])
+    want = np.stack([
+        g * (np.asarray(jax.nn.gelu(t @ w1[e])) @ w2[e])
+        for t, e, g in zip(tokens, idx, gate)
+    ]).reshape(2, 8, 16)
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """Tokens past an expert's capacity produce zero output (residuals carry
+    them); nothing crashes and shapes stay static."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((1, 16, 8)), jnp.float32)
+    module = MoEMLP(dim=8, hidden=16, num_experts=2, capacity_factor=0.25)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    out = module.apply(variables, x)
+    assert out.shape == x.shape
+    # capacity 2 per expert -> at most 4 tokens routed; the rest are zeros
+    nonzero_tokens = np.count_nonzero(
+        np.abs(np.asarray(out).reshape(16, 8)).sum(-1))
+    assert nonzero_tokens <= 4
+
+
+def test_moe_aux_loss_sown(moe_setup):
+    module, variables, x = moe_setup
+    _, state = module.apply(variables, x, mutable=["intermediates"])
+    aux = state["intermediates"]["moe_aux_loss"][0]
+    # perfectly balanced routing gives exactly 1.0; anything routed is >= 1
+    assert float(aux) >= 1.0 - 1e-6
+
+
+def test_moe_llama_trains_on_ep_mesh():
+    """LlamaLite(moe_experts=4) trains with experts sharded over an ep axis
+    (TRANSFORMER_RULES) — the expert-parallel path end to end."""
+    from jax.sharding import Mesh
+
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import TRANSFORMER_RULES, LlamaLite
+    from metisfl_tpu.parallel.sharding import tree_shardings
+
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devices, ("dp", "ep"))
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 64, (16, 8)).astype(np.int32)
+    ds = ArrayDataset(x, np.roll(x, -1, axis=1))
+    ops = FlaxModelOps(
+        LlamaLite(vocab_size=64, dim=16, depth=2, heads=2, moe_experts=4),
+        ds.x[:2], mesh=mesh, partition_rules=TRANSFORMER_RULES)
+
+    # the expert stacks are actually sharded over ep
+    shardings = tree_shardings(ops.variables, mesh, TRANSFORMER_RULES)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    expert_specs = [s.spec for path, s in flat
+                    if "experts_w1" in jax.tree_util.keystr(path)]
+    assert expert_specs and all(spec[0] == "ep" for spec in expert_specs)
+
+    out = ops.train(ds, TrainParams(batch_size=8, local_steps=2,
+                                    optimizer="adam", learning_rate=1e-3))
+    assert out.completed_steps == 2
+    assert np.isfinite(out.train_metrics["loss"])
+
+
+def test_moe_aux_loss_enters_objective():
+    """The sown load-balance term must reach the training loss: training
+    with moe_aux_weight=0 vs a large weight must produce different routers
+    (review finding: sow alone is a no-op unless the step collects it)."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 32, (8, 8)).astype(np.int32)
+    ds = ArrayDataset(x, np.roll(x, -1, axis=1))
+
+    def train(weight):
+        ops = FlaxModelOps(
+            LlamaLite(vocab_size=32, dim=8, depth=1, heads=2, moe_experts=4),
+            ds.x[:2], rng_seed=7)
+        ops.train(ds, TrainParams(batch_size=8, local_steps=2,
+                                  optimizer="sgd", learning_rate=0.5,
+                                  moe_aux_weight=weight))
+        return ops.get_variables()["params"]["block_0"]["moe"]["router"]
+
+    r_off = train(0.0)["kernel"]
+    r_on = train(50.0)["kernel"]
+    assert not np.allclose(np.asarray(r_off), np.asarray(r_on), atol=1e-7)

@@ -220,6 +220,61 @@ class TestDiskStore:
         assert ModelBlob.from_bytes(out).opaque["w"][0] == b"cipher"
 
 
+class TestCachedDiskStore:
+    """Byte-bounded LRU cache over the disk store (the reference's
+    RedisModelStore role, redis_model_store.cc:1-307, without a service)."""
+
+    def _store(self, tmp_path, cache_bytes):
+        from metisfl_tpu.store import CachedDiskStore
+        return CachedDiskStore(str(tmp_path / "store"), lineage_length=2,
+                               cache_bytes=cache_bytes)
+
+    def test_roundtrip_matches_disk_semantics(self, tmp_path):
+        store = self._store(tmp_path, 1 << 20)
+        for v in (1, 2, 3):
+            store.insert("L0", _m(v))
+        lineage = store.select(["L0"], k=5)["L0"]
+        assert len(lineage) == 2
+        np.testing.assert_allclose(lineage[0]["w"], 3.0)
+        np.testing.assert_allclose(lineage[1]["w"], 2.0)
+
+    def test_inserts_hit_cache_on_select(self, tmp_path):
+        store = self._store(tmp_path, 1 << 20)
+        store.insert("L0", _m(1))
+        store.select(["L0"])
+        assert store.cache_hits >= 1 and store.cache_misses == 0
+
+    def test_byte_budget_bounds_residency(self, tmp_path):
+        one_model = _m(1)["w"].nbytes
+        store = self._store(tmp_path, int(one_model * 2.5))
+        for i in range(8):
+            store.insert(f"L{i}", _m(i))
+        assert store._cached_total <= one_model * 2.5
+        # evicted-from-cache models still read back from disk
+        out = store.select([f"L{i}" for i in range(8)], k=1)
+        assert len(out) == 8
+        np.testing.assert_allclose(out["L0"][0]["w"], 0.0)
+        assert store.cache_misses > 0
+
+    def test_cache_consistent_after_erase_and_evict(self, tmp_path):
+        store = self._store(tmp_path, 1 << 20)
+        for v in (1, 2, 3):
+            store.insert("L0", _m(v))     # lineage 2: seq 0 evicted
+        store.insert("L1", _m(9))
+        store.erase(["L0"])
+        assert store.select(["L0"]) == {}
+        np.testing.assert_allclose(store.select(["L1"])["L1"][0]["w"], 9.0)
+        assert store._cached_total <= 2 * _m(0)["w"].nbytes + 64
+
+    def test_survives_reopen_cold_cache(self, tmp_path):
+        from metisfl_tpu.store import CachedDiskStore
+        root = str(tmp_path / "store")
+        CachedDiskStore(root, lineage_length=2).insert("L0", _m(7))
+        reopened = CachedDiskStore(root, lineage_length=2)
+        np.testing.assert_allclose(reopened.select(["L0"])["L0"][0]["w"], 7.0)
+        assert reopened.cache_misses == 1
+
+
 class TestStragglerExpiry:
     """expire_pending: the straggler-deadline hook (SURVEY.md §5.3 gap)."""
 

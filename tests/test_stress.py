@@ -1,0 +1,157 @@
+"""Controller concurrency stress: many threads hammer the RPC surface while
+rounds run. The reference relies on two coarse mutexes with no automated
+race story (SURVEY.md §5.2: "plan TSAN in CI from day one"); this is the
+Python-side equivalent — every public entry point called concurrently under
+the round loop, asserting liveness and internal-state consistency."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import JoinRequest, TaskResult, TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    TerminationConfig,
+)
+from metisfl_tpu.controller.core import Controller
+from metisfl_tpu.tensor.pytree import pack_model
+
+
+class _NopProxy:
+    def run_task(self, task):
+        pass
+
+    def evaluate(self, task, callback):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+def _model(seed):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal((8, 4)).astype(np.float32)}
+
+
+@pytest.mark.parametrize("protocol", ["asynchronous", "synchronous"])
+def test_concurrent_rpc_surface_stays_consistent(protocol):
+    """8 writer threads x (join / complete / leave / stats / lineage) for a
+    few seconds; the controller must neither deadlock nor corrupt state."""
+    config = FederationConfig(
+        protocol=protocol,
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=10_000),
+    )
+    ctrl = Controller(config, lambda record: _NopProxy())
+    ctrl.set_community_model(pack_model(_model(0)))
+
+    stop = threading.Event()
+    errors = []
+
+    def churn(idx):
+        """join -> complete a few tasks -> leave, in a loop."""
+        try:
+            i = 0
+            while not stop.is_set():
+                reply = ctrl.join(JoinRequest(hostname="h", port=6000 + idx,
+                                              num_train_examples=16))
+                for k in range(3):
+                    ctrl.task_completed(TaskResult(
+                        task_id=f"s{idx}_{i}_{k}",
+                        learner_id=reply.learner_id,
+                        auth_token=reply.auth_token,
+                        model=pack_model(_model(idx)),
+                        completed_batches=1))
+                ctrl.leave(reply.learner_id, reply.auth_token)
+                i += 1
+        except Exception as exc:  # noqa: BLE001 - collected for the assert
+            errors.append(exc)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                stats = ctrl.get_statistics()
+                assert stats["global_iteration"] >= 0
+                ctrl.get_runtime_metadata(tail=2)
+                ctrl.get_evaluation_lineage(tail=2)
+                ctrl.active_learners()
+                ctrl.learner_endpoints()
+                time.sleep(0.001)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=churn, args=(i,)) for i in range(6)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    alive = [t for t in threads if t.is_alive()]
+    ctrl.shutdown()
+
+    assert not alive, "stress threads deadlocked"
+    assert not errors, f"concurrent access raised: {errors[:3]}"
+    # internal consistency after the storm: every in-flight bookkeeping
+    # structure refers only to known learners or is bounded
+    assert len(ctrl._expired_tasks) <= 512
+    stats = ctrl.get_statistics()
+    assert stats["global_iteration"] == len(stats["round_metadata"])
+
+
+def test_concurrent_checkpoint_while_rounds_run(tmp_path):
+    """save_checkpoint racing task completions must always write a loadable
+    snapshot (atomic replace, consistent locking)."""
+    from metisfl_tpu.config import CheckpointConfig
+
+    config = FederationConfig(
+        protocol="asynchronous",
+        aggregation=AggregationConfig(rule="fedrec", scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        checkpoint=CheckpointConfig(dir=str(tmp_path)),
+    )
+    ctrl = Controller(config, lambda record: _NopProxy())
+    ctrl.set_community_model(pack_model(_model(0)))
+    reply = ctrl.join(JoinRequest(hostname="h", port=7000,
+                                  num_train_examples=16))
+    stop = threading.Event()
+    errors = []
+
+    def completions():
+        i = 0
+        while not stop.is_set():
+            ctrl.task_completed(TaskResult(
+                task_id=f"c{i}", learner_id=reply.learner_id,
+                auth_token=reply.auth_token, model=pack_model(_model(i)),
+                completed_batches=1))
+            i += 1
+            time.sleep(0.002)
+
+    def checkpoints():
+        try:
+            while not stop.is_set():
+                path = ctrl.save_checkpoint()
+                fresh = Controller(config, lambda record: _NopProxy())
+                assert fresh.restore_checkpoint(path)
+                fresh.shutdown()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=completions),
+               threading.Thread(target=checkpoints)]
+    for t in threads:
+        t.start()
+    time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    ctrl.shutdown()
+    assert not errors, f"checkpoint race: {errors[:3]}"
