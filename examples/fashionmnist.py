@@ -40,6 +40,10 @@ def main() -> int:
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--examples-per-learner", type=int, default=600)
     parser.add_argument("--workdir", default="")
+    parser.add_argument("--profile-dir", default="",
+                        help="capture jax.profiler traces of steady-state "
+                             "training steps into this directory "
+                             "(TensorBoard/xprof-readable)")
     args = parser.parse_args()
 
     from metisfl_tpu.platform import honor_platform_env
@@ -77,6 +81,8 @@ def main() -> int:
     config = generate_localhost_env(
         args.learners, rounds=args.rounds, protocol=args.protocol,
         batch_size=args.batch_size, secure_scheme=args.secure)
+    if args.profile_dir:
+        config.train.profile_dir = args.profile_dir
     template = FlaxModelOps(FashionMnistCNN(),
                             np.zeros((2, 28, 28, 1), np.float32),
                             rng_seed=0).get_variables()

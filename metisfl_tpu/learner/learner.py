@@ -165,8 +165,19 @@ class Learner:
     def _train_and_report(self, task: TrainTask) -> None:
         self._cancel.clear()
         try:
+            params = task.params
+            if params.profile_dir:
+                # per-learner trace subdir: same-host learners start traces
+                # within the same second and jax.profiler session dirs are
+                # timestamped + hostname-named, so a shared dir would clobber
+                import dataclasses as _dc
+                import os as _os
+                params = _dc.replace(
+                    params, profile_dir=_os.path.join(
+                        params.profile_dir,
+                        self.learner_id or f"port_{self.port}"))
             self.model_ops.set_variables(self._load_model(task.model))
-            out = self.model_ops.train(self.datasets["train"], task.params,
+            out = self.model_ops.train(self.datasets["train"], params,
                                        cancel_event=self._cancel)
             # round-scoped mask derivation (pairwise-masking secure agg)
             if self.secure_backend is not None and hasattr(

@@ -10,10 +10,16 @@ TPU-native upgrade path:
 - :mod:`podfed`      — N learners co-resident on one pod slice: weights never
   leave the device; the controller reduces to bookkeeping (the BASELINE.json
   north star).
+- :mod:`pipeline`    — GPipe microbatch schedule over the ``pp`` axis.
 """
 
 from metisfl_tpu.parallel.mesh import MeshConfig, build_mesh
 from metisfl_tpu.parallel.collectives import federated_mean_psum, make_pod_aggregator
+from metisfl_tpu.parallel.pipeline import (
+    make_pipeline,
+    pipeline_apply,
+    stack_stage_params,
+)
 from metisfl_tpu.parallel.podfed import PodFederation
 from metisfl_tpu.parallel.ringattn import make_ring_attention, ring_attention
 
@@ -25,4 +31,7 @@ __all__ = [
     "PodFederation",
     "ring_attention",
     "make_ring_attention",
+    "pipeline_apply",
+    "make_pipeline",
+    "stack_stage_params",
 ]

@@ -1,0 +1,56 @@
+"""bench.py harness plumbing — the sweep/guard logic must be CI-covered so
+the driver's one TPU run per round can't be the first execution of it."""
+
+import numpy as np
+
+
+def test_mfu_sweep_plumbing_toy_shapes():
+    """All three variants run, report per-variant timings, and a best
+    variant is selected (toy shapes, CPU — no chip peak, so no mfu key)."""
+    from bench import bench_mfu
+
+    out = bench_mfu(L=32, dim=16, depth=1, heads=2, vocab=64,
+                    require_tpu=False)
+    for label in ("b8_dense", "b8_flash", "b16_flash_remat"):
+        assert f"lm_{label}_ms_per_step" in out, out.get(
+            f"lm_{label}_error", f"variant {label} missing")
+        assert out[f"lm_{label}_tokens_per_sec"] > 0
+    assert out["lm_best_variant"].startswith("b")
+    assert out["lm_ms_per_step"] > 0
+    assert out["lm_flops_per_step"] > 0
+    assert out["lm_params"] > 0
+
+
+def test_lm_step_flops_accounting():
+    """One FLOPs accounting for every variant: causal-halved attention,
+    backward = 2x forward."""
+    from bench import _lm_step_flops
+
+    B, L, dim, depth, vocab = 2, 64, 32, 3, 128
+    tokens = B * L
+    per_layer = 8 * tokens * dim * dim + 2 * B * L * L * dim \
+        + 24 * tokens * dim * dim
+    want = 3 * (depth * per_layer + 2 * tokens * dim * vocab)
+    assert _lm_step_flops(B, L, dim, depth, vocab) == want
+
+
+def test_store_bench_section():
+    from bench import bench_store
+
+    out = bench_store(4)
+    assert out["store_learners"] == 4
+    assert out["store_cached_hit_rate"] == 1.0
+    assert out["store_disk_insert_ms"] > 0
+
+
+def test_aggregation_headline_correctness():
+    from bench import STRIDE, aggregate_once, synth_models
+
+    from metisfl_tpu.aggregation.fedavg import FedAvg
+
+    models = synth_models(4)
+    scales = np.full((4,), 0.25)
+    out = aggregate_once(FedAvg(), models, scales, STRIDE)
+    expect = np.mean([m["head/bias"] for m in models], axis=0)
+    np.testing.assert_allclose(np.asarray(out["head/bias"]), expect,
+                               atol=1e-5)

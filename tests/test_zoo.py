@@ -134,3 +134,31 @@ class TestShardedTraining:
         for a, b in zip(flat_p, flat_s):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
+
+
+def test_llama_remat_matches_plain():
+    """remat=True must change memory, not math: identical outputs and
+    gradients vs the plain model on shared weights."""
+    import jax
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, (2, 16)), jnp.int32)
+    plain = LlamaLite(vocab_size=64, dim=16, depth=2, heads=2)
+    remat = LlamaLite(vocab_size=64, dim=16, depth=2, heads=2, remat=True)
+    variables = plain.init(jax.random.PRNGKey(0), tokens)
+    np.testing.assert_allclose(
+        np.asarray(remat.apply(variables, tokens)),
+        np.asarray(plain.apply(variables, tokens)), atol=1e-5)
+
+    def loss(module, variables):
+        return jnp.sum(module.apply(variables, tokens,
+                                    train=True,
+                                    rngs={"dropout": jax.random.PRNGKey(1)}
+                                    ) ** 2)
+
+    g_plain = jax.grad(lambda v: loss(plain, v))(variables)
+    g_remat = jax.grad(lambda v: loss(remat, v))(variables)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
