@@ -442,37 +442,80 @@ def bench_store(num_learners: int = 64):
     return out
 
 
+# partial-result state for the watchdog/signal emergency print: sections
+# fill this in as they finish, so a hang (or the driver's kill) in a later
+# section still surfaces everything measured so far
+_PARTIAL = {"details": {}, "errors": {}}
+_printed = False
+
+
+def _emit(result) -> None:
+    global _printed
+    if _printed:
+        return
+    _printed = True
+    print(json.dumps(result), flush=True)
+
+
+def _result_from(details, errors, num_learners):
+    value = details.get("ms_per_round_median", 0.0)
+    result = {
+        "metric": f"aggregation_ms_per_round_{num_learners}learners",
+        "value": round(value, 2),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / value, 2) if value else 0.0,
+        "details": dict(details),
+    }
+    if "mfu" in details:
+        result["mfu"] = details["mfu"]
+    if errors:
+        result["details"]["errors"] = dict(errors)
+    return result
+
+
+def _install_watchdog(num_learners: int, budget_secs: int) -> None:
+    """Emergency partial-result print on SIGTERM/SIGALRM.
+
+    A section that hangs inside a TPU compile (the tunnel can wedge — round
+    3 observation) would otherwise eat the driver's whole timeout and print
+    NOTHING; the alarm may not fire while blocked in native code, but the
+    driver's SIGTERM and socket-level stalls are catchable."""
+    import signal
+
+    def _bail(signum, frame):
+        details = dict(_PARTIAL["details"])
+        errors = dict(_PARTIAL["errors"])
+        errors["watchdog"] = f"interrupted by signal {signum} (partial result)"
+        _emit(_result_from(details, errors, num_learners))
+        os._exit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGALRM):
+        try:
+            signal.signal(sig, _bail)
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            return
+    signal.alarm(budget_secs)
+
+
 def run_bench(quick: bool):
     num_learners = 8 if quick else NUM_LEARNERS
     rounds = 2 if quick else ROUNDS
-    errors = {}
-    details = {}
+    errors = _PARTIAL["errors"]
+    details = _PARTIAL["details"]
 
     agg = bench_aggregation(num_learners, rounds, STRIDE)
     details.update(agg)
 
     secondary = [bench_secure_ckks] if quick else [
-        bench_train_step, bench_mfu, bench_flash, bench_secure_ckks,
-        bench_store]
+        bench_train_step, bench_secure_ckks, bench_store, bench_mfu,
+        bench_flash]
     for fn in secondary:
         try:
             details.update(fn())
         except Exception:
             errors[fn.__name__] = traceback.format_exc(limit=3)[-400:]
 
-    value = agg["ms_per_round_median"]
-    result = {
-        "metric": f"aggregation_ms_per_round_{num_learners}learners",
-        "value": round(value, 2),
-        "unit": "ms",
-        "vs_baseline": round(BASELINE_MS / value, 2),
-        "details": details,
-    }
-    if "mfu" in details:
-        result["mfu"] = details["mfu"]
-    if errors:
-        result["details"]["errors"] = errors
-    return result
+    return _result_from(details, errors, num_learners)
 
 
 def main():
@@ -492,6 +535,8 @@ def main():
     if backend_info.get("degraded_to_cpu"):
         honor_platform_env()
 
+    _install_watchdog(8 if args.quick else NUM_LEARNERS,
+                      budget_secs=600 if args.quick else 1800)
     try:
         result = run_bench(args.quick)
     except Exception as exc:
@@ -502,6 +547,9 @@ def main():
             os.environ["MFTPU_BENCH_CPU_RETRY"] = "1"
             os.environ["JAX_PLATFORMS"] = "cpu"
             try:
+                import signal
+                signal.alarm(0)  # pending alarms survive execv (handler
+                # resets to SIG_DFL = terminate): disarm before re-exec
                 os.execv(sys.executable, [sys.executable] + sys.argv)
             except OSError:
                 pass
@@ -526,7 +574,7 @@ def main():
     result["details"]["peak_rss_kb"] = resource.getrusage(
         resource.RUSAGE_SELF).ru_maxrss
     result["details"]["bench_wall_s"] = round(time.time() - t_start, 1)
-    print(json.dumps(result))
+    _emit(result)
     return 0
 
 

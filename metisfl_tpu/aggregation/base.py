@@ -158,15 +158,71 @@ def np_scaled_sub(acc: Pytree, model: Pytree, scale) -> Pytree:
                         acc, model)
 
 
+_hostfold_lib = None
+
+
+def _get_hostfold():
+    """Native streaming-fold library (metisfl_tpu/native/hostfold.cc), or
+    None when the toolchain is unavailable — the numpy path then serves."""
+    global _hostfold_lib
+    if _hostfold_lib is None:
+        try:
+            from metisfl_tpu.native import load_hostfold
+            _hostfold_lib = load_hostfold()
+        except Exception:  # no g++ / build failure: numpy fallback
+            _hostfold_lib = False
+    return _hostfold_lib or None
+
+
+def _native_fold(a, arrs, scales):
+    """acc (+)= Σ scalesᵢ·arrsᵢ via hostfold.cc; None if not applicable.
+
+    Streams each model once with no staging copy (the numpy path pays a
+    full ``np.stack`` pass before its GEMV) — this is the controller's
+    cross-host aggregation hot loop (BASELINE.md headline metric)."""
+    import ctypes
+
+    lib = _get_hostfold()
+    if lib is None:
+        return None
+    dt = arrs[0].dtype
+    if any(x.dtype != dt for x in arrs):
+        return None
+    if dt == np.float32:
+        fold, cptr = lib.hostfold_f32, ctypes.c_float
+    elif dt == np.float64:
+        fold, cptr = lib.hostfold_f64, ctypes.c_double
+    else:
+        return None
+    if a is None:
+        out, init = np.empty(arrs[0].shape, dt), 1
+    elif a.dtype == dt and a.flags["C_CONTIGUOUS"]:
+        out, init = a, 0
+    else:
+        return None
+    ptr_t = ctypes.POINTER(cptr)
+    contig = [np.ascontiguousarray(x) for x in arrs]
+    ptrs = (ptr_t * len(contig))(*[x.ctypes.data_as(ptr_t) for x in contig])
+    sc = np.ascontiguousarray(scales, np.float64)
+    fold(out.ctypes.data_as(ptr_t), ptrs,
+         sc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+         len(contig), out.size, init)
+    return out
+
+
 def np_stacked_scaled_add(acc: Optional[Pytree], block: Sequence[Pytree],
                           scales: np.ndarray) -> Pytree:
-    """Host-BLAS block fold: acc += Σᵢ scalesᵢ · blockᵢ.
+    """Host block fold: acc += Σᵢ scalesᵢ · blockᵢ.
 
-    One stacked (L, n) matvec per leaf — the host counterpart of
-    :func:`stacked_scaled_add`, ~an order of magnitude faster than per-model
-    axpy for f32 models."""
+    Fast path: the native streaming fold (hostfold.cc — one pass per model,
+    no staging copy). Fallback: one stacked (L, n) matvec per leaf, still ~an
+    order of magnitude faster than per-model axpy for f32 models."""
     def fold(a, *xs):
-        stack = np.stack([np.asarray(x) for x in xs])
+        arrs = [np.asarray(x) for x in xs]
+        native = _native_fold(a, arrs, scales)
+        if native is not None:
+            return native
+        stack = np.stack(arrs)
         acc_dt = _np_acc_dtype(stack.dtype)
         flat = stack.reshape(len(xs), -1)
         v = (scales.astype(acc_dt) @ flat).reshape(stack.shape[1:])

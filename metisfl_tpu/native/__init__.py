@@ -2,11 +2,16 @@
 
 The reference builds its native layer with Bazel + pybind11
 (reference WORKSPACE:1-120, controller/pybind/controller_pybind.cc:17-50);
-this rebuild compiles a small C-ABI shared library with ``g++`` on first use
-(pybind11 is not available here — Python binds via ctypes) and caches the
-``.so`` next to the source. Concurrent builders (learner subprocesses) race
-safely: the compile goes to a unique temp file then ``os.replace``s into
-place atomically.
+this rebuild compiles small C-ABI shared libraries with ``g++`` on first use
+(pybind11 is not available here — Python binds via ctypes) and caches each
+``.so`` next to its source, keyed by the sha256 of that source (mtimes are
+meaningless after a fresh clone; binaries are never committed). Concurrent
+builders (learner subprocesses) race safely: the compile goes to a unique
+temp file then ``os.replace``s into place atomically.
+
+Libraries:
+- ``ckks.cc``     — coefficient-packed RLWE CKKS (secure aggregation).
+- ``hostfold.cc`` — streaming weighted fold for host-path aggregation.
 """
 
 from __future__ import annotations
@@ -19,65 +24,69 @@ import tempfile
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "ckks.cc")
-_SO = os.path.join(_DIR, "libmetisfl_ckks.so")
-_HASH = _SO + ".srchash"
 _lock = threading.Lock()
-_lib = None
+_libs: dict = {}
 
 
-def _src_hash() -> str:
-    with open(_SRC, "rb") as f:
+def _src_hash(src: str) -> str:
+    with open(src, "rb") as f:
         return hashlib.sha256(f.read()).hexdigest()
 
 
-def _needs_build() -> bool:
-    """The binary is never committed — it is identified by the sha256 of the
-    source it was built from (mtimes are meaningless after a fresh clone)."""
-    if not os.path.exists(_SO) or not os.path.exists(_HASH):
+def _needs_build(src: str, so: str) -> bool:
+    hash_path = so + ".srchash"
+    if not os.path.exists(so) or not os.path.exists(hash_path):
         return True
     try:
-        with open(_HASH) as f:
-            return f.read().strip() != _src_hash()
+        with open(hash_path) as f:
+            return f.read().strip() != _src_hash(src)
     except OSError:
         return True
 
 
-def _build() -> None:
+def _build(src: str, so: str) -> None:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
     os.close(fd)
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
-           "-o", tmp, _SRC]
+           "-o", tmp, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
         fd, tmp_hash = tempfile.mkstemp(dir=_DIR)
         with os.fdopen(fd, "w") as f:
-            f.write(_src_hash())
-        os.replace(tmp_hash, _HASH)
+            f.write(_src_hash(src))
+        os.replace(tmp_hash, so + ".srchash")
     except subprocess.CalledProcessError as exc:
         raise RuntimeError(
-            f"native CKKS build failed:\n{exc.stderr}") from exc
+            f"native build of {os.path.basename(src)} failed:\n"
+            f"{exc.stderr}") from exc
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
+def _load(name: str) -> ctypes.CDLL:
+    """Build (if stale) and dlopen ``<name>.cc`` → ``libmetisfl_<name>.so``.
+    Call with ``_lock`` held."""
+    src = os.path.join(_DIR, f"{name}.cc")
+    so = os.path.join(_DIR, f"libmetisfl_{name}.so")
+    if _needs_build(src, so):
+        _build(src, so)
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        # stale/foreign-platform binary (e.g. copied checkout):
+        # rebuild from source once and retry
+        _build(src, so)
+        return ctypes.CDLL(so)
+
+
 def load_ckks() -> ctypes.CDLL:
-    """Build (if stale) and load the CKKS library with typed signatures."""
-    global _lib
+    """The CKKS library with typed signatures."""
     with _lock:
-        if _lib is not None:
-            return _lib
-        if _needs_build():
-            _build()
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            # stale/foreign-platform binary (e.g. copied checkout):
-            # rebuild from source once and retry
-            _build()
-            lib = ctypes.CDLL(_SO)
+        if "ckks" in _libs:
+            return _libs["ckks"]
+        lib = _load("ckks")
         lib.ckks_n.restype = ctypes.c_long
         lib.ckks_ciphertext_size.restype = ctypes.c_long
         lib.ckks_ciphertext_size.argtypes = [ctypes.c_long]
@@ -102,5 +111,28 @@ def load_ckks() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
             ctypes.POINTER(ctypes.c_double), ctypes.c_long]
         lib.ckks_selftest.restype = ctypes.c_int
-        _lib = lib
-        return _lib
+        _libs["ckks"] = lib
+        return lib
+
+
+def load_hostfold() -> ctypes.CDLL:
+    """The host-aggregation fold library with typed signatures."""
+    with _lock:
+        if "hostfold" in _libs:
+            return _libs["hostfold"]
+        lib = _load("hostfold")
+        lib.hostfold_f32.restype = None
+        lib.hostfold_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long, ctypes.c_long, ctypes.c_int]
+        lib.hostfold_f64.restype = None
+        lib.hostfold_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long, ctypes.c_long, ctypes.c_int]
+        lib.hostfold_selftest.restype = ctypes.c_int
+        _libs["hostfold"] = lib
+        return lib

@@ -24,7 +24,6 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metisfl_tpu.parallel.collectives import to_varying
@@ -104,7 +103,7 @@ def pipeline_apply(
         return jax.lax.psum(outputs, axis)
 
     spec_params = jax.tree.map(lambda _: P(axis), stacked_params)
-    fn = shard_map(
+    fn = jax.shard_map(
         ranked, mesh=mesh,
         in_specs=(spec_params, P()),
         out_specs=P(),

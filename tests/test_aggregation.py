@@ -161,3 +161,31 @@ def test_multi_tensor_tree_aggregation():
     np.testing.assert_allclose(out["a"], np.full((2, 2), 2.0))
     np.testing.assert_allclose(out["b"]["c"], np.full(3, 4.0))
     assert np.asarray(out["b"]["c"]).dtype == np.float64
+
+
+def test_native_hostfold_matches_numpy_fold():
+    """The native streaming fold (hostfold.cc) must produce the numpy
+    fallback's result bit-for-bit-close on the host aggregation path."""
+    import metisfl_tpu.aggregation.base as base
+    from metisfl_tpu.aggregation.base import np_stacked_scaled_add
+
+    rng = np.random.default_rng(13)
+    block = [{"w": rng.standard_normal((64, 32)).astype(np.float32),
+              "b": rng.standard_normal((7,)).astype(np.float64)}
+             for _ in range(5)]
+    scales = rng.random(5)
+
+    saved = base._hostfold_lib
+    try:
+        base._hostfold_lib = None  # force (re)load: native path
+        native_init = np_stacked_scaled_add(None, block, scales)
+        native_acc = np_stacked_scaled_add(native_init, block, scales)
+        base._hostfold_lib = False  # force numpy fallback
+        np_init = np_stacked_scaled_add(None, block, scales)
+        np_acc = np_stacked_scaled_add(np_init, block, scales)
+    finally:
+        base._hostfold_lib = saved
+    for key in ("w", "b"):
+        assert native_acc[key].dtype == np_acc[key].dtype
+        np.testing.assert_allclose(native_acc[key], np_acc[key],
+                                   atol=1e-4, rtol=1e-5)
