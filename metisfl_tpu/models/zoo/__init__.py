@@ -7,8 +7,9 @@ from BASELINE.md (ResNet-20, ViT, BERT, Llama+LoRA).
 """
 
 from metisfl_tpu.models.zoo.mlp import MLP, HousingMLP
-from metisfl_tpu.models.zoo.cnn import FashionMnistCNN, Cifar10CNN
+from metisfl_tpu.models.zoo.cnn import BrainAge3DCNN, FashionMnistCNN, Cifar10CNN
 from metisfl_tpu.models.zoo.resnet import ResNet20
+from metisfl_tpu.models.zoo.rnn import LSTMClassifier
 from metisfl_tpu.models.zoo.transformer import (
     TRANSFORMER_RULES,
     BertLite,
@@ -20,6 +21,7 @@ from metisfl_tpu.models.zoo.transformer import (
 
 __all__ = [
     "MLP", "HousingMLP", "FashionMnistCNN", "Cifar10CNN", "ResNet20",
+    "BrainAge3DCNN", "LSTMClassifier",
     "ViTLite", "BertLite", "LlamaLite", "LoRADense", "MoEMLP",
     "TRANSFORMER_RULES",
 ]

@@ -51,22 +51,36 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
         # after i forward rotations we hold the block produced by (my - i)
         owner = (my_idx - i) % n
         k_pos = owner * Lc + jnp.arange(Lc)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+
+        def attend(args):
+            o, m, l = args
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]     # (Lc, Lc)
+                s = jnp.where(mask, s, _NEG)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            if causal:
+                # rows whose whole block is masked would otherwise get
+                # exp(NEG - NEG) = 1 contributions
+                p = jnp.where(mask, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + p.sum(axis=-1)
+            o_new = o * corr[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_blk)
+            return o_new, m_new, l_new
+
         if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]         # (Lc, Lc)
-            s = jnp.where(mask, s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        if causal:
-            # rows whose whole block is masked would otherwise get
-            # exp(NEG - NEG) = 1 contributions
-            p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+            # blocks strictly in the future are entirely masked: skip their
+            # matmuls (halves the causal ring's FLOPs; the K/V rotation
+            # below still runs so the ring stays in step)
+            o, m, l = jax.lax.cond(owner > my_idx,
+                                   lambda args: args, attend, (o, m, l))
+        else:
+            o, m, l = attend((o, m, l))
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_next, v_next), None
+        return (o, m, l, k_next, v_next), None
 
     o0 = jnp.zeros_like(q)
     m0 = jnp.full((B, H, Lc), _NEG, q.dtype)
