@@ -81,6 +81,12 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
+    # multi-host learner (one learner owning a multi-host TPU slice): join
+    # the global runtime before any jax use (after logging setup so the
+    # confirmation line is visible)
+    from metisfl_tpu.platform import maybe_init_distributed
+    maybe_init_distributed()
+
     with open(args.recipe, "rb") as f:
         recipe = cloudpickle.load(f)
     built = recipe()

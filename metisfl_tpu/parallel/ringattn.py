@@ -54,7 +54,12 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
 
         def attend(args):
             o, m, l = args
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+            # scores + online statistics in fp32 regardless of the compute
+            # dtype — bf16 exp/normalize across ring steps compounds; the
+            # score/PV matmuls still run MXU-native on the input dtype
+            s = jax.lax.dot_general(
+                q, k_blk, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32) * scale
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]     # (Lc, Lc)
                 s = jnp.where(mask, s, _NEG)
@@ -66,8 +71,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
                 p = jnp.where(mask, p, 0.0)
             corr = jnp.exp(m - m_new)
             l_new = l * corr + p.sum(axis=-1)
-            o_new = o * corr[..., None] + jnp.einsum(
-                "bhqk,bhkd->bhqd", p, v_blk)
+            o_new = o * corr[..., None] + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk,
+                (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
             return o_new, m_new, l_new
 
         if causal:
@@ -82,12 +89,12 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_next, v_next), None
 
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full((B, H, Lc), _NEG, q.dtype)
-    l0 = jnp.zeros((B, H, Lc), q.dtype)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((B, H, Lc), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Lc), jnp.float32)
     (o, m, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(n))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",

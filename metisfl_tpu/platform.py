@@ -2,7 +2,56 @@
 
 from __future__ import annotations
 
+import logging
 import os
+
+logger = logging.getLogger("metisfl_tpu.platform")
+
+
+def maybe_init_distributed() -> bool:
+    """Join a multi-host JAX runtime when the environment asks for it.
+
+    A learner that owns a multi-host TPU slice (SURVEY.md §7: one learner
+    per host, in-learner sharding across its slice) must call
+    ``jax.distributed.initialize`` before any backend use so every host
+    sees the global device set. Env-driven so launchers (SSH or k8s) wire
+    it without new CLI surface:
+
+    - ``METISFL_JAX_COORDINATOR``   — ``host:port`` of process 0
+    - ``METISFL_JAX_NUM_PROCESSES`` — world size
+    - ``METISFL_JAX_PROCESS_ID``    — this process's rank
+
+    Returns True when initialization ran. No-op (False) when unset.
+    """
+    coordinator = os.environ.get("METISFL_JAX_COORDINATOR")
+    if not coordinator:
+        return False
+    try:
+        num = int(os.environ["METISFL_JAX_NUM_PROCESSES"])
+        pid = int(os.environ["METISFL_JAX_PROCESS_ID"])
+    except (KeyError, ValueError) as exc:
+        raise RuntimeError(
+            "METISFL_JAX_COORDINATOR is set, so METISFL_JAX_NUM_PROCESSES "
+            "and METISFL_JAX_PROCESS_ID must both be set to integers "
+            f"(got NUM_PROCESSES={os.environ.get('METISFL_JAX_NUM_PROCESSES')!r}, "
+            f"PROCESS_ID={os.environ.get('METISFL_JAX_PROCESS_ID')!r})"
+        ) from exc
+    if pid != 0:
+        # Every rank must execute the SAME jit programs for the slice's
+        # collectives to rendezvous; the learner's federation client runs
+        # on rank 0 only, and a follower-rank task-broadcast loop is not
+        # implemented yet. Refuse loudly — silently registering follower
+        # ranks as extra learners would hang the first collective.
+        raise RuntimeError(
+            "multi-host learner follower ranks (METISFL_JAX_PROCESS_ID != 0)"
+            " are not supported yet: run the learner on rank 0 of its slice")
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num, process_id=pid)
+    logger.info("jax.distributed initialized: process %d/%d via %s",
+                pid, num, coordinator)
+    return True
 
 
 def honor_platform_env() -> None:

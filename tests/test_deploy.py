@@ -289,3 +289,10 @@ def test_ssh_launcher_end_to_end_with_path_shim(tmp_path):
             break
         time.sleep(0.1)
     assert "ssh-probe ok42" in open(proc.log_path).read()
+
+
+def test_maybe_init_distributed_noop_without_env(monkeypatch):
+    from metisfl_tpu.platform import maybe_init_distributed
+
+    monkeypatch.delenv("METISFL_JAX_COORDINATOR", raising=False)
+    assert maybe_init_distributed() is False

@@ -86,3 +86,19 @@ def test_llama_sequence_parallel_trains():
                                     learning_rate=0.05))
     assert out.completed_steps == 3
     assert np.isfinite(out.train_metrics["loss"])
+
+
+def test_ring_attention_bf16_close_to_f32_oracle():
+    """bf16 inputs: statistics accumulate in fp32 inside the ring, so the
+    result tracks the f32 oracle at bf16 input-rounding error, not at
+    compounded bf16-statistics error."""
+    mesh = build_mesh(MeshConfig(("sp",), (4,)), devices=jax.devices()[:4])
+    rng = np.random.default_rng(21)
+    qkv32 = [jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.float32)
+             for _ in range(3)]
+    qkv16 = [x.astype(jnp.bfloat16) for x in qkv32]
+    out = make_ring_attention(mesh, causal=True)(*qkv16)
+    assert out.dtype == jnp.bfloat16
+    want = reference_attention(*qkv32, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want), atol=0.03, rtol=0.05)
