@@ -1,0 +1,28 @@
+"""metisfl_tpu — a TPU-native federated learning framework.
+
+A ground-up rebuild of the capabilities of MetisFL (reference:
+weaver158/metisfl) designed for TPU hardware: learners run jit-compiled
+JAX/Flax training loops, aggregation is an XLA-compiled weighted average
+(or a weighted ``psum`` over ICI when learners co-reside on a pod slice),
+and the federation runtime (controller, schedulers, model store) is a
+native state machine with a compact binary wire contract.
+
+Top-level layout (mirrors SURVEY.md §2's component inventory):
+
+- :mod:`metisfl_tpu.tensor`      — wire contract: dtype-preserving tensor serde.
+- :mod:`metisfl_tpu.comm`        — binary message codec + gRPC bytes transport.
+- :mod:`metisfl_tpu.aggregation` — FedAvg / FedStride / FedRec / secure agg (jit).
+- :mod:`metisfl_tpu.controller`  — federation controller core + service.
+- :mod:`metisfl_tpu.learner`     — learner runtime + service.
+- :mod:`metisfl_tpu.models`      — Flax model zoo + ModelOps train/eval engine.
+- :mod:`metisfl_tpu.ops`         — Pallas TPU kernels (ring attention, fused agg).
+- :mod:`metisfl_tpu.parallel`    — meshes, shardings, collectives, pod federation.
+- :mod:`metisfl_tpu.store`       — model lineage stores (in-memory / disk).
+- :mod:`metisfl_tpu.secure`      — secure aggregation (pairwise masking, CKKS).
+- :mod:`metisfl_tpu.driver`      — federation driver session (launch/monitor).
+- :mod:`metisfl_tpu.config`      — typed federation environment config.
+"""
+
+from metisfl_tpu.version import __version__
+
+__all__ = ["__version__"]

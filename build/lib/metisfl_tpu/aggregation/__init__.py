@@ -1,0 +1,50 @@
+"""Aggregation rules — XLA-compiled federated averaging.
+
+Capability map to the reference's C++ aggregators
+(reference metisfl/controller/aggregation/):
+
+- :class:`FedAvg`      ≈ ``FederatedAverage`` (federated_average.cc:70-150)
+- :class:`FedStride`   ≈ ``FederatedStride`` (federated_stride.cc:5-68)
+- :class:`FedRec`      ≈ ``FederatedRecency`` (federated_recency.cc:7-107)
+- :class:`SecureAgg`   ≈ ``PWA`` over CKKS (private_weighted_average.cc:9-111)
+
+The reference loops over variables with OpenMP and does byte-blob arithmetic
+per dtype; here a model is a pytree and one jit-compiled scaled-add runs the
+whole model as a single fused XLA computation (compile-once per model
+shape — no per-variable dispatch, no host round trips when arrays are
+already on device).
+"""
+
+from metisfl_tpu.aggregation.base import AggregationRule, AggState
+from metisfl_tpu.aggregation.fedavg import FedAvg
+from metisfl_tpu.aggregation.rolling import FedRec, FedStride
+from metisfl_tpu.aggregation.secure import SecureAgg
+
+AGGREGATION_RULES = {
+    "fedavg": FedAvg,
+    "fedstride": FedStride,
+    "fedrec": FedRec,
+    "secure_agg": SecureAgg,
+}
+
+
+def make_aggregation_rule(name: str, **kwargs) -> AggregationRule:
+    try:
+        cls = AGGREGATION_RULES[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown aggregation rule {name!r}; have {sorted(AGGREGATION_RULES)}"
+        ) from None
+    return cls(**kwargs)
+
+
+__all__ = [
+    "AggregationRule",
+    "AggState",
+    "FedAvg",
+    "FedStride",
+    "FedRec",
+    "SecureAgg",
+    "AGGREGATION_RULES",
+    "make_aggregation_rule",
+]

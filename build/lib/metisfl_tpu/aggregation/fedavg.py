@@ -1,0 +1,105 @@
+"""FedAvg — weighted average of learner models.
+
+Equivalent of the reference's ``FederatedAverage`` (reference
+metisfl/controller/aggregation/federated_average.cc:70-150): community =
+Σ scaleᵢ · modelᵢ, computed here as a fold of one jit-compiled scaled-add
+over pytrees. The fold API (``accumulate``/``result``) lets the controller
+feed models block-by-block from the store so only one stride block is ever
+resident — bounded memory for huge federations, the point of the reference's
+stride loop (controller.cc:842-936). The math is identical for any blocking
+because addition is associative.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metisfl_tpu.aggregation.base import (
+    AggState,
+    Pytree,
+    finalize,
+    is_host_tree,
+    np_finalize,
+    np_stacked_scaled_add,
+    stacked_scaled_add,
+    stacked_scaled_init,
+    use_numpy_fold,
+)
+
+
+class FedAvg:
+    name = "fedavg"
+    required_lineage = 1
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._acc: Optional[Pytree] = None
+        self._total: float = 0.0
+        self._dtypes: Optional[Tuple[str, ...]] = None
+        self._np: bool = False
+
+    def accumulate(
+        self, models: Sequence[Tuple[Sequence[Pytree], float]]
+    ) -> None:
+        """Fold one block of ``(lineage, scale)`` pairs into the running sum.
+
+        Only the accumulator (plus the current block, stacked) stays resident
+        between calls — callers stream blocks of any size. The block enters
+        the device as one stacked array per leaf and folds in a single fused
+        weighted reduce (vs the reference's per-variable OpenMP loop,
+        federated_average.cc:101).
+        """
+        if not models:
+            return
+        first = models[0][0][0]
+        if self._dtypes is None:
+            # fold locale: host BLAS for wire-arrived numpy models (FedAvg is
+            # bandwidth-bound — see is_host_tree), device fold for
+            # device-resident trees, psum for pod mode.
+            self._np = use_numpy_fold(first) or is_host_tree(first)
+            self._dtypes = tuple(
+                str(np.asarray(x).dtype) for x in jax.tree.leaves(first))
+        block = [lineage[0] for lineage, _ in models]
+        # f64 scales: the host fold downcasts per-leaf to its accumulator
+        # dtype, so wide (f64) model trees keep double-precision weights
+        scales = np.asarray([scale for _, scale in models], np.float64)
+        if self._np:
+            self._acc = np_stacked_scaled_add(self._acc, block, scales)
+        else:
+            scales_dev = jnp.asarray(scales.astype(np.float32))
+            if self._acc is None:
+                self._acc = stacked_scaled_init(scales_dev, *block)
+            else:
+                self._acc = stacked_scaled_add(self._acc, scales_dev, *block)
+        self._total += float(scales.sum())
+
+    def result(self) -> Pytree:
+        """Normalize the running sum → community model (storage dtypes).
+
+        Scales from the standard scalers sum to 1; normalize anyway so the
+        rule is correct for unnormalized weights.
+        """
+        if self._acc is None:
+            raise ValueError("FedAvg.result called before any accumulate")
+        fin = np_finalize if self._np else finalize
+        return fin(self._acc, self._total, dtypes=self._dtypes)
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+    ) -> Pytree:
+        """One-shot aggregation (equivalent to accumulate-all + result)."""
+        if not models:
+            raise ValueError("FedAvg.aggregate called with no models")
+        self.reset()
+        self.accumulate(models)
+        out = self.result()
+        self.reset()
+        return out

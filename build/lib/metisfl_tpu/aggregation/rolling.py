@@ -1,0 +1,159 @@
+"""Rolling (incremental) aggregation: FedStride and FedRec.
+
+Equivalent of the reference's ``FederatedRollingAverageBase`` family
+(reference metisfl/controller/aggregation/federated_rolling_average_base.cc:17-291,
+federated_stride.cc:5-68, federated_recency.cc:7-107):
+
+- The community model is maintained incrementally as ``wc_scaled / z`` where
+  ``wc_scaled = Σ scaleᵢ·modelᵢ`` and ``z = Σ scaleᵢ``.
+- **FedStride**: learners arrive in stride blocks within a round; each block
+  is added to the running sum so only ``stride`` models are ever resident —
+  bounded memory for huge federations. State resets between rounds.
+- **FedRec** (async recency): when a learner reports again, its *previous*
+  contribution is subtracted and the newest added (the reference's case II-B,
+  federated_recency.cc:68-99), so stragglers never double-count. Requires
+  model lineage length 2 (federated_recency.h:19); here the exact previous
+  ``(scale, model)`` is tracked in :class:`AggState` so the subtraction is
+  bit-consistent with what was added.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from metisfl_tpu.aggregation.base import (
+    AggState,
+    Pytree,
+    finalize,
+    np_finalize,
+    np_scaled_add,
+    np_scaled_init,
+    np_scaled_sub,
+    scaled_add,
+    scaled_init,
+    scaled_sub,
+    is_host_tree,
+    use_numpy_fold,
+)
+
+
+class _RollingBase:
+    def __init__(self):
+        self._state = AggState()
+
+    def reset(self) -> None:
+        self._state.reset()
+
+    def _community(self, template: Pytree) -> Pytree:
+        fin = np_finalize if self._state.use_numpy else finalize
+        return fin(self._state.wc_scaled, self._state.z, template)
+
+    def _add(self, learner_id: str, model: Pytree, scale: float) -> None:
+        state = self._state
+        if state.wc_scaled is None:
+            # host-resident models fold on host (see is_host_tree): the
+            # incremental add/remove is a streaming axpy, not MXU work
+            state.use_numpy = use_numpy_fold(model) or is_host_tree(model)
+            init = np_scaled_init if state.use_numpy else scaled_init
+            state.wc_scaled = init(model, scale)
+        else:
+            add = np_scaled_add if state.use_numpy else scaled_add
+            state.wc_scaled = add(state.wc_scaled, model, scale)
+        state.z += float(scale)
+        state.contributions[learner_id] = (float(scale), model)
+
+    def _remove(self, learner_id: str) -> None:
+        state = self._state
+        prev = state.contributions.pop(learner_id, None)
+        if prev is not None and state.wc_scaled is not None:
+            old_scale, old_model = prev
+            sub = np_scaled_sub if state.use_numpy else scaled_sub
+            state.wc_scaled = sub(state.wc_scaled, old_model, old_scale)
+            state.z -= old_scale
+
+    # -- checkpoint / resume ----------------------------------------------
+    def export_scales(self) -> Dict[str, float]:
+        """``learner_id -> scale`` of every counted contribution — the part
+        of the rolling state that cannot be reconstructed from the model
+        store alone (the models CAN: they are the store's lineage heads)."""
+        return {lid: scale
+                for lid, (scale, _) in self._state.contributions.items()}
+
+    def rehydrate(self, store, scales: Dict[str, float]) -> int:
+        """Rebuild ``wc_scaled``/``z`` after a controller restart from the
+        persisted store lineage + checkpointed contribution scales.
+
+        This is the reference's store-driven reconstruction (the recency rule
+        reads the store's 2-model lineage to recover the subtraction term,
+        federated_recency.cc:68-99) adapted to a store that outlives the
+        process: for each checkpointed learner the *newest* stored model
+        (lineage[0]) re-enters the sum — if the learner inserted a model
+        between the checkpoint and the crash, the rebuilt state adopts it,
+        exactly matching the no-crash run's recency semantics. A blind
+        "subtract lineage[1] inside aggregate" would be unsound here: a
+        persistent store can carry lineage from a *previous* run that this
+        state never counted. Returns the number of contributions restored
+        (learners whose models the store did not persist — e.g. an in-memory
+        store after a restart — are skipped, best effort).
+        """
+        self.reset()
+        picked = store.select(list(scales), k=1)  # only the head re-enters
+        restored = 0
+        for lid, scale in scales.items():
+            lineage = picked.get(lid)
+            if not lineage:
+                continue
+            self._add(lid, lineage[0], float(scale))
+            restored += 1
+        return restored
+
+
+class FedStride(_RollingBase):
+    """Stride-blocked synchronous rolling FedAvg (bounded memory)."""
+
+    name = "fedstride"
+    required_lineage = 1
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+        learner_ids: Optional[Sequence[str]] = None,
+    ) -> Pytree:
+        if not models:
+            raise ValueError("FedStride.aggregate called with no models")
+        ids = learner_ids or [f"_anon{i}" for i in range(len(models))]
+        template = None
+        for lid, (lineage, scale) in zip(ids, models):
+            model = lineage[0]
+            if template is None:
+                template = model
+            # Same learner re-submitting within a round replaces its block.
+            self._remove(lid)
+            self._add(lid, model, scale)
+        return self._community(template)
+
+
+class FedRec(_RollingBase):
+    """Asynchronous recency aggregation: newest contribution wins."""
+
+    name = "fedrec"
+    required_lineage = 2
+
+    def aggregate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        state: Optional[AggState] = None,
+        learner_ids: Optional[Sequence[str]] = None,
+    ) -> Pytree:
+        if not models:
+            raise ValueError("FedRec.aggregate called with no models")
+        ids = learner_ids or [f"_anon{i}" for i in range(len(models))]
+        template = None
+        for lid, (lineage, scale) in zip(ids, models):
+            model = lineage[0]
+            if template is None:
+                template = model
+            self._remove(lid)   # case II-B: drop the stale contribution
+            self._add(lid, model, scale)
+        return self._community(template)

@@ -1,0 +1,33 @@
+"""Transport layer: binary message codec + gRPC bytes services.
+
+The reference speaks protobuf over unary gRPC with unlimited message sizes
+(reference metisfl/utils/grpc_services.py:22-110,
+metisfl/controller/core/controller_servicer.cc:26-89). This rebuild keeps
+gRPC/HTTP2 as the cross-host control+bulk plane but replaces protobuf with a
+compact self-describing binary codec (no codegen, shared Python/C++
+implementation) — model payloads are raw little-endian tensor blobs, not
+proto-embedded byte strings.
+"""
+
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.comm.messages import (
+    JoinRequest,
+    JoinReply,
+    TrainTask,
+    TaskResult,
+    EvalTask,
+    EvalResult,
+    TrainParams,
+)
+
+__all__ = [
+    "dumps",
+    "loads",
+    "JoinRequest",
+    "JoinReply",
+    "TrainTask",
+    "TaskResult",
+    "EvalTask",
+    "EvalResult",
+    "TrainParams",
+]

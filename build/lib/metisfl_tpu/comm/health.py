@@ -1,0 +1,124 @@
+"""Standard gRPC health-checking protocol (``grpc.health.v1.Health``).
+
+The reference registers grpc's default health-check service so off-the-shelf
+probes (grpc_health_probe, k8s) work against controller and learner
+(reference metisfl/controller/core/controller_servicer.cc:7-9,32-33). The
+``grpc_health`` codegen package is not available in this environment, so the
+two protobuf messages are encoded by hand — they are a single string field
+(HealthCheckRequest.service, field 1) and a single enum field
+(HealthCheckResponse.status, field 1), both trivially wire-stable:
+
+    https://github.com/grpc/grpc/blob/master/doc/health-checking.md
+
+Served alongside the framework's richer custom ``GetHealthStatus`` RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import grpc
+
+from metisfl_tpu.comm.rpc import BytesService
+
+HEALTH_SERVICE = "grpc.health.v1.Health"
+
+UNKNOWN = 0
+SERVING = 1
+NOT_SERVING = 2
+SERVICE_UNKNOWN = 3
+
+
+def _read_varint(raw: bytes, pos: int):
+    value, shift = 0, 0
+    while pos < len(raw):
+        byte = raw[pos]
+        pos += 1
+        value |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return value, pos
+        shift += 7
+    raise ValueError("truncated varint")
+
+
+def decode_request(raw: bytes) -> str:
+    """HealthCheckRequest → service name ('' = overall server health)."""
+    pos = 0
+    while pos < len(raw):
+        tag, pos = _read_varint(raw, pos)
+        if tag == 0x0A:  # field 1, length-delimited
+            length, pos = _read_varint(raw, pos)
+            return raw[pos : pos + length].decode("utf-8", "replace")
+        # skip unknown fields conservatively
+        wire_type = tag & 0x07
+        if wire_type == 0:
+            _, pos = _read_varint(raw, pos)
+        elif wire_type == 2:
+            length, pos = _read_varint(raw, pos)
+            pos += length
+        else:  # pragma: no cover - not produced by health clients
+            break
+    return ""
+
+
+def encode_response(status: int) -> bytes:
+    """HealthCheckResponse{status}: field 1 varint (status < 128 always)."""
+    return bytes([0x08, status])
+
+
+def encode_request(service: str = "") -> bytes:
+    """Client-side helper (tests / probing peers)."""
+    if not service:
+        return b""
+    payload = service.encode()
+    if len(payload) > 127:  # pragma: no cover - service names are short
+        raise ValueError("service name too long")
+    return bytes([0x0A, len(payload)]) + payload
+
+
+def decode_response(raw: bytes) -> int:
+    pos = 0
+    while pos < len(raw):
+        tag, pos = _read_varint(raw, pos)
+        if tag == 0x08:
+            value, pos = _read_varint(raw, pos)
+            return value
+        break
+    return UNKNOWN
+
+
+class HealthServicer:
+    """Serve ``Check`` with per-service statuses (Watch is streaming and not
+    required by probes; unary-only transport here)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._status: Dict[str, int] = {"": SERVING}
+
+    def set_status(self, service: str, status: int) -> None:
+        with self._lock:
+            self._status[service] = status
+
+    def set_all(self, status: int) -> None:
+        with self._lock:
+            for service in self._status:
+                self._status[service] = status
+
+    def service(self) -> BytesService:
+        return BytesService(HEALTH_SERVICE, {"Check": self._check})
+
+    def _check(self, raw: bytes) -> bytes:
+        service = decode_request(raw)
+        with self._lock:
+            status = self._status.get(service)
+        if status is None:
+            # spec: unknown service → NOT_FOUND
+            raise _NotFound(service)
+        return encode_response(status)
+
+
+class _NotFound(Exception):
+    def __init__(self, service: str):
+        super().__init__(f"unknown health service {service!r}")
+        self.code = grpc.StatusCode.NOT_FOUND

@@ -1,0 +1,207 @@
+"""Federation message schemas.
+
+Typed dataclass messages serialized through :mod:`metisfl_tpu.comm.codec`.
+Capability map to the reference's protos:
+
+- ``JoinRequest``/``JoinReply``  ≈ JoinFederationRequest/Response
+  (reference metisfl/proto/controller.proto:120-150, metis.proto ServerEntity).
+- ``TrainParams``/``TrainTask``  ≈ LearningTask + Hyperparameters + RunTaskRequest
+  (metis.proto:95-147, learner.proto:9-24).
+- ``TaskResult``                 ≈ CompletedLearningTask + TaskExecutionMetadata
+  (metis.proto:104-147).
+- ``EvalTask``/``EvalResult``    ≈ EvaluateModelRequest/Response + ModelEvaluations
+  (metis.proto:149-196).
+
+Unlike the reference, ML metric values are typed floats, not strings
+(SURVEY.md §5.5 flags the reference's stringly-typed metrics as a defect).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, get_type_hints
+
+from metisfl_tpu.comm.codec import dumps, loads
+
+
+@functools.lru_cache(maxsize=None)
+def _hints_for(cls):
+    return get_type_hints(cls)
+
+
+class Message:
+    """Base: dataclass ⇄ codec bytes, with nested-message support."""
+
+    def to_dict(self) -> dict:
+        out = {}
+        for f in dataclasses.fields(self):
+            value = getattr(self, f.name)
+            if isinstance(value, Message):
+                value = value.to_dict()
+            elif isinstance(value, list) and value and isinstance(value[0], Message):
+                value = [v.to_dict() for v in value]
+            out[f.name] = value
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        hints = _hints_for(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in data:
+                continue
+            value = data[f.name]
+            hint = hints.get(f.name)
+            nested = _nested_message_type(hint)
+            if nested is not None and isinstance(value, dict):
+                value = nested.from_dict(value)
+            elif isinstance(value, list):
+                item_type = _list_item_message_type(hint)
+                if item_type is not None:
+                    value = [item_type.from_dict(v) for v in value]
+            kwargs[f.name] = value
+        return cls(**kwargs)
+
+    def to_wire(self) -> bytes:
+        return dumps(self.to_dict())
+
+    @classmethod
+    def from_wire(cls, buf):
+        return cls.from_dict(loads(buf))
+
+
+def _nested_message_type(hint):
+    if isinstance(hint, type) and issubclass(hint, Message):
+        return hint
+    for arg in getattr(hint, "__args__", ()):  # Optional[Msg]
+        if isinstance(arg, type) and issubclass(arg, Message):
+            return arg
+    return None
+
+
+def _list_item_message_type(hint):
+    args = getattr(hint, "__args__", ())
+    if args and isinstance(args[0], type) and issubclass(args[0], Message):
+        return args[0]
+    return None
+
+
+@dataclass
+class TrainParams(Message):
+    """Local-training hyperparameters shipped with every task."""
+
+    batch_size: int = 32
+    local_steps: int = 0        # exact optimizer steps; 0 → derive from epochs
+    local_epochs: float = 1.0   # used when local_steps == 0
+    optimizer: str = "sgd"
+    learning_rate: float = 0.01
+    optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # FedProx proximal term weight; 0 disables (reference fed_prox.py:10-103).
+    proximal_mu: float = 0.0
+    # weight on sown auxiliary losses (MoE router load balancing); 0 disables
+    moe_aux_weight: float = 0.01
+    # jax.profiler trace capture (SURVEY.md §5.1): when set, each training
+    # task traces ``profile_steps`` steady-state (post-compile) steps into
+    # this directory — TensorBoard/xprof-readable.
+    profile_dir: str = ""
+    profile_steps: int = 3
+
+
+@dataclass
+class JoinRequest(Message):
+    hostname: str = "localhost"
+    port: int = 0
+    num_train_examples: int = 0
+    num_val_examples: int = 0
+    num_test_examples: int = 0
+    # Rejoin support: a learner that restarts presents its previous identity
+    # (reference grpc_controller_client.py:96-107 rejoin-on-ALREADY_EXISTS).
+    previous_id: str = ""
+    auth_token: str = ""
+    capabilities: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class JoinReply(Message):
+    learner_id: str = ""
+    auth_token: str = ""
+    rejoined: bool = False
+
+
+@dataclass
+class TrainTask(Message):
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    global_iteration: int = 0
+    model: bytes = b""          # ModelBlob wire bytes (community model)
+    params: TrainParams = field(default_factory=TrainParams)
+
+
+@dataclass
+class TaskResult(Message):
+    task_id: str = ""
+    learner_id: str = ""
+    # Composite-key auth: the controller validates (learner_id, auth_token)
+    # before accepting a model (reference controller.proto:146-148).
+    auth_token: str = ""
+    round_id: int = 0
+    model: bytes = b""          # locally trained ModelBlob
+    num_train_examples: int = 0
+    completed_steps: int = 0
+    completed_epochs: float = 0.0
+    completed_batches: int = 0
+    processing_ms_per_step: float = 0.0
+    train_metrics: Dict[str, float] = field(default_factory=dict)
+    epoch_metrics: List[Dict[str, float]] = field(default_factory=list)
+
+
+@dataclass
+class EvalTask(Message):
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    model: bytes = b""
+    batch_size: int = 256
+    datasets: List[str] = field(default_factory=lambda: ["test"])
+    metrics: List[str] = field(default_factory=lambda: ["loss", "accuracy"])
+
+
+@dataclass
+class EvalResult(Message):
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    # dataset name -> {metric -> value}
+    evaluations: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    duration_ms: float = 0.0
+
+
+@dataclass
+class InferTask(Message):
+    """Inference request — the reference learner's third task type
+    (reference metisfl/learner/learner.py:311-330 run_inference_task)."""
+
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    model: bytes = b""          # ModelBlob to infer with (may be encrypted)
+    batch_size: int = 256
+    # either a named local dataset split ("train"/"valid"/"test")...
+    dataset: str = "test"
+    # ...or explicit inputs shipped as a packed {"x": array} ModelBlob
+    inputs: bytes = b""
+    max_examples: int = 0       # 0 = all
+
+
+@dataclass
+class InferResult(Message):
+    task_id: str = ""
+    learner_id: str = ""
+    round_id: int = 0
+    predictions: bytes = b""    # packed {"predictions": array} ModelBlob
+    num_examples: int = 0
+    duration_ms: float = 0.0
+

@@ -1,0 +1,178 @@
+"""gRPC bytes transport.
+
+The reference builds protobuf-codegen services with unlimited message sizes
+(reference metisfl/utils/grpc_services.py:22-110). Here services are generic
+byte methods (no codegen): each endpoint is a named unary handler taking and
+returning codec/blob bytes. Retry-with-backoff on UNAVAILABLE mirrors
+grpc_services.py:60-75; unlimited message lengths mirror :28-30 and :93-97.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from concurrent import futures
+from typing import Callable, Dict, Optional
+
+import grpc
+
+logger = logging.getLogger("metisfl_tpu.rpc")
+
+_UNLIMITED = [
+    ("grpc.max_send_message_length", -1),
+    ("grpc.max_receive_message_length", -1),
+    # gRPC servers default to SO_REUSEPORT on Linux: two federations (or a
+    # stale controller from a crashed run) binding the same port would
+    # silently load-balance RPCs between unrelated processes. Fail loudly.
+    ("grpc.so_reuseport", 0),
+]
+
+_IDENTITY = lambda b: b  # noqa: E731 - bytes in, bytes out
+
+
+class BytesService:
+    """A named set of unary bytes→bytes methods served over gRPC."""
+
+    def __init__(self, service_name: str,
+                 handlers: Dict[str, Callable[[bytes], bytes]]):
+        self.service_name = service_name
+        self.handlers = dict(handlers)
+
+    def _generic_handler(self) -> grpc.GenericRpcHandler:
+        method_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                self._wrap(fn),
+                request_deserializer=_IDENTITY,
+                response_serializer=_IDENTITY,
+            )
+            for name, fn in self.handlers.items()
+        }
+        return grpc.method_handlers_generic_handler(
+            self.service_name, method_handlers)
+
+    @staticmethod
+    def _wrap(fn: Callable[[bytes], bytes]):
+        def handler(request: bytes, context: grpc.ServicerContext) -> bytes:
+            try:
+                return fn(request)
+            except Exception as exc:
+                code = getattr(exc, "code", None)
+                if isinstance(code, grpc.StatusCode):
+                    context.abort(code, str(exc))
+                logger.exception("RPC handler failed")
+                context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+
+        return handler
+
+
+class RpcServer:
+    """gRPC server hosting one or more :class:`BytesService`s.
+
+    ``ssl``: an enabled :class:`metisfl_tpu.comm.ssl.SSLConfig` serves TLS
+    (reference controller_servicer.cc:38-74); None serves plaintext.
+    """
+
+    def __init__(self, host: str, port: int, max_workers: int = 16, ssl=None):
+        self.host = host
+        self.port = port
+        self.ssl = ssl if (ssl is not None and ssl.enabled) else None
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_UNLIMITED,
+        )
+        self._bound_port: Optional[int] = None
+
+    def add_service(self, service: BytesService) -> None:
+        self._server.add_generic_rpc_handlers((service._generic_handler(),))
+
+    def start(self) -> int:
+        addr = f"{self.host}:{self.port}"
+        if self.ssl is not None:
+            from metisfl_tpu.comm.ssl import server_credentials
+            self._bound_port = self._server.add_secure_port(
+                addr, server_credentials(self.ssl))
+        else:
+            self._bound_port = self._server.add_insecure_port(addr)
+        if self._bound_port == 0:
+            raise RuntimeError(f"could not bind gRPC server on {addr}")
+        self._server.start()
+        logger.info("gRPC server listening on %s:%d%s", self.host,
+                    self._bound_port, " (TLS)" if self.ssl else "")
+        return self._bound_port
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+
+class RpcClient:
+    """Channel to a :class:`BytesService` with retry/backoff on UNAVAILABLE."""
+
+    def __init__(self, host: str, port: int, service_name: str,
+                 retries: int = 10, retry_sleep_s: float = 1.0, ssl=None):
+        self.target = f"{host}:{port}"
+        self.service_name = service_name
+        self.retries = retries
+        self.retry_sleep_s = retry_sleep_s
+        if ssl is not None and ssl.enabled:
+            from metisfl_tpu.comm.ssl import channel_credentials
+            self._channel = grpc.secure_channel(
+                self.target, channel_credentials(ssl), options=_UNLIMITED)
+        else:
+            self._channel = grpc.insecure_channel(self.target, options=_UNLIMITED)
+
+    def call(self, method: str, payload: bytes, timeout: Optional[float] = None,
+             wait_ready: bool = True) -> bytes:
+        fn = self._channel.unary_unary(
+            f"/{self.service_name}/{method}",
+            request_serializer=_IDENTITY,
+            response_deserializer=_IDENTITY,
+        )
+        attempt = 0
+        while True:
+            try:
+                return fn(payload, timeout=timeout, wait_for_ready=wait_ready)
+            except grpc.RpcError as exc:
+                code = exc.code() if hasattr(exc, "code") else None
+                if code == grpc.StatusCode.UNAVAILABLE and attempt < self.retries:
+                    attempt += 1
+                    logger.warning("%s/%s unavailable (attempt %d/%d)",
+                                   self.target, method, attempt, self.retries)
+                    time.sleep(self.retry_sleep_s)
+                    continue
+                raise
+
+    def call_async(self, method: str, payload: bytes,
+                   callback: Optional[Callable[[bytes], None]] = None,
+                   error_callback: Optional[Callable[[Exception], None]] = None,
+                   timeout: Optional[float] = None,
+                   wait_ready: bool = True):
+        """Non-blocking unary call (the reference's CompletionQueue pattern,
+        controller.cc:713-759, via grpc futures). ``wait_ready=False`` fails
+        fast with UNAVAILABLE on a dead endpoint instead of queueing."""
+        fn = self._channel.unary_unary(
+            f"/{self.service_name}/{method}",
+            request_serializer=_IDENTITY,
+            response_deserializer=_IDENTITY,
+        )
+        future = fn.future(payload, timeout=timeout, wait_for_ready=wait_ready)
+
+        def _done(f):
+            try:
+                result = f.result()
+            except Exception as exc:  # noqa: BLE001 - surfaced via callback
+                if error_callback is not None:
+                    error_callback(exc)
+                else:
+                    logger.warning("async RPC %s failed: %s", method, exc)
+                return
+            if callback is not None:
+                callback(result)
+
+        future.add_done_callback(_done)
+        return future
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,23 @@
+from metisfl_tpu.config.federation import (
+    AggregationConfig,
+    CheckpointConfig,
+    EvalConfig,
+    FederationConfig,
+    LearnerEndpoint,
+    ModelStoreConfig,
+    SecureAggConfig,
+    TerminationConfig,
+    load_config,
+)
+
+__all__ = [
+    "FederationConfig",
+    "AggregationConfig",
+    "CheckpointConfig",
+    "ModelStoreConfig",
+    "SecureAggConfig",
+    "TerminationConfig",
+    "EvalConfig",
+    "LearnerEndpoint",
+    "load_config",
+]

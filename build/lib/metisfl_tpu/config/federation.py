@@ -1,0 +1,202 @@
+"""Typed federation environment.
+
+Single source of truth for runtime configuration — replaces the reference's
+three duplicated tiers (YAML env, ``ControllerParams`` proto, hex-proto CLI
+args; SURVEY.md §5.6 flags the duplication): one dataclass tree, loadable
+from YAML (reference examples/config/template.yaml shape) or built in code,
+serializable through the wire codec for process launch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.comm.ssl import SSLConfig
+
+
+@dataclass
+class TerminationConfig:
+    """Reference fedenv_parser.py TerminationSignals + driver monitor loop
+    (driver_session.py:443-477): stop on rounds, wall-clock, or metric."""
+
+    federation_rounds: int = 10
+    execution_cutoff_mins: float = 0.0       # 0 → no wall-clock cutoff
+    metric_cutoff_score: float = 0.0         # 0 → no metric cutoff
+    metric_name: str = "accuracy"
+
+
+@dataclass
+class AggregationConfig:
+    rule: str = "fedavg"                     # fedavg | fedstride | fedrec | secure_agg
+    scaler: str = "train_dataset_size"       # participants | train_dataset_size | batches
+    stride_length: int = 0                   # 0 → all models in one block
+    # how many learners participate per round (1.0 = all) — reference
+    # ControllerParams.participation_ratio
+    participation_ratio: float = 1.0
+
+
+@dataclass
+class ModelStoreConfig:
+    store: str = "in_memory"                 # in_memory | disk | cached_disk
+    lineage_length: int = 0                  # 0 → derive from aggregation rule
+    root: str = ""                           # disk store directory
+    cache_mb: int = 256                      # cached_disk memory budget
+
+
+@dataclass
+class SecureAggConfig:
+    enabled: bool = False
+    scheme: str = "masking"                  # masking | ckks | identity
+    # CKKS params (reference ckks_scheme.cc:13-75 defaults; the native ring
+    # packs 8192 coefficients regardless — kept for config parity)
+    batch_size: int = 4096
+    scaling_factor_bits: int = 52
+    key_dir: str = ""
+    # masking: the controller must know the party count to verify that all
+    # masks cancel; the driver fills this in (secrets never enter this
+    # config — they travel in per-learner secure files only)
+    num_parties: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Controller-side global checkpoint (SURVEY.md §5.4: the reference has
+    no resume flow; community model + round counter are rebuilt here)."""
+
+    dir: str = ""                            # "" → checkpointing disabled
+    every_n_rounds: int = 1
+
+
+@dataclass
+class EvalConfig:
+    batch_size: int = 256
+    datasets: List[str] = field(default_factory=lambda: ["test"])
+    metrics: List[str] = field(default_factory=lambda: ["loss", "accuracy"])
+    every_n_rounds: int = 1
+
+
+@dataclass
+class LearnerEndpoint:
+    hostname: str = "localhost"
+    port: int = 0
+    # per-learner dataset shard paths / recipe names (driver-side concern)
+    dataset: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class FederationConfig:
+    protocol: str = "synchronous"            # synchronous | semi_synchronous | asynchronous
+    semi_sync_lambda: float = 1.0
+    semi_sync_recompute_every_round: bool = False
+    # Straggler deadline for sync/semi-sync rounds: a dispatched learner that
+    # has not reported within this many seconds is dropped from the round
+    # barrier and the round proceeds with whoever did report. 0 → no deadline
+    # (reference behavior: a hung learner stalls the round forever,
+    # SURVEY.md §5.3).
+    round_deadline_secs: float = 0.0
+    # Learner liveness: after this many consecutive failed train dispatches a
+    # learner is treated as unreachable and excluded from cohort sampling
+    # until it completes a task or rejoins (the reference only logs failed
+    # dispatches and keeps scheduling them, controller.cc:783-786). 0 → off.
+    max_dispatch_failures: int = 3
+    aggregation: AggregationConfig = field(default_factory=AggregationConfig)
+    model_store: ModelStoreConfig = field(default_factory=ModelStoreConfig)
+    secure: SecureAggConfig = field(default_factory=SecureAggConfig)
+    termination: TerminationConfig = field(default_factory=TerminationConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    ssl: SSLConfig = field(default_factory=SSLConfig)
+    train: TrainParams = field(default_factory=TrainParams)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+    controller_host: str = "localhost"
+    controller_port: int = 50051
+    learners: List[LearnerEndpoint] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.secure.enabled and self.aggregation.rule not in ("secure_agg",):
+            raise ValueError(
+                "secure aggregation requires aggregation.rule == 'secure_agg' "
+                "(reference fedenv_parser.py:301-309 enforces PWA iff HE)"
+            )
+        if self.aggregation.rule == "secure_agg" and not self.secure.enabled:
+            raise ValueError("aggregation.rule 'secure_agg' requires secure.enabled")
+        if (self.secure.enabled and self.secure.scheme == "masking"
+                and self.aggregation.scaler != "participants"):
+            # MaskingBackend.weighted_sum rejects non-uniform scales at
+            # aggregation time; fail at startup instead of stalling round 1.
+            raise ValueError(
+                "masking secure aggregation requires the 'participants' "
+                "scaler (pairwise masks only cancel under uniform scales)")
+        if (self.secure.enabled and self.secure.scheme == "masking"
+                and self.protocol == "asynchronous"):
+            # Pairwise masks only cancel when ALL parties' payloads enter one
+            # combine — structurally a synchronous barrier. Async secure
+            # federations need a partial-cohort-capable scheme (ckks).
+            raise ValueError(
+                "masking secure aggregation requires a synchronous or "
+                "semi-synchronous protocol; use scheme='ckks' for "
+                "asynchronous secure federations")
+        if self.protocol not in ("synchronous", "semi_synchronous", "asynchronous"):
+            raise ValueError(f"unknown protocol {self.protocol!r}")
+        if not 0.0 < self.aggregation.participation_ratio <= 1.0:
+            raise ValueError("participation_ratio must be in (0, 1]")
+
+    # -- wire/launch serialization ----------------------------------------
+    def to_wire(self) -> bytes:
+        return dumps(_to_plain(self))
+
+    @classmethod
+    def from_wire(cls, buf) -> "FederationConfig":
+        return _from_plain(cls, loads(buf))
+
+    def to_dict(self) -> dict:
+        return _to_plain(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "FederationConfig":
+        return _from_plain(cls, data)
+
+
+def _to_plain(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_plain(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, list):
+        return [_to_plain(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _to_plain(v) for k, v in obj.items()}
+    return obj
+
+
+def _from_plain(cls, data):
+    if not dataclasses.is_dataclass(cls):
+        return data
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        value = data[f.name]
+        hint = hints.get(f.name)
+        if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+            value = _from_plain(hint, value)
+        elif isinstance(value, list):
+            args = typing.get_args(hint)
+            if args and dataclasses.is_dataclass(args[0]):
+                value = [_from_plain(args[0], v) for v in value]
+        kwargs[f.name] = value
+    return cls(**kwargs)
+
+
+def load_config(path: str) -> FederationConfig:
+    """Load a federation environment from YAML."""
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    return _from_plain(FederationConfig, data)

@@ -1,0 +1,3 @@
+from metisfl_tpu.controller.core import Controller, LearnerProxy, RoundMetadata
+
+__all__ = ["Controller", "LearnerProxy", "RoundMetadata"]

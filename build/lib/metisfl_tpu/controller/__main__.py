@@ -1,0 +1,82 @@
+"""Controller process entry point: ``python -m metisfl_tpu.controller``.
+
+Reference: metisfl/controller/__main__.py:12-94 — but configuration arrives
+as one file (codec-serialized ``FederationConfig`` or YAML), not hex-proto
+CLI flags (SURVEY.md §5.6 flags that design as user-hostile).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from metisfl_tpu.config import FederationConfig, load_config
+from metisfl_tpu.controller.core import Controller
+from metisfl_tpu.controller.service import ControllerServer, RpcLearnerProxy
+
+
+def main(argv=None) -> int:
+    from metisfl_tpu.platform import honor_platform_env
+    honor_platform_env()
+    parser = argparse.ArgumentParser("metisfl_tpu.controller")
+    parser.add_argument("--config", required=True,
+                        help="path to FederationConfig (.bin codec or .yaml)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0,
+                        help="override config controller_port")
+    parser.add_argument("--resume", action="store_true",
+                        help="restore community model + round counter from "
+                             "config.checkpoint.dir before serving")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.config.endswith((".yaml", ".yml")):
+        config = load_config(args.config)
+    else:
+        with open(args.config, "rb") as f:
+            config = FederationConfig.from_wire(f.read())
+
+    secure_backend = None
+    if config.secure.enabled:
+        from metisfl_tpu.secure import make_backend
+        kwargs = {}
+        if config.secure.scheme == "masking":
+            num_parties = config.secure.num_parties or len(config.learners)
+            if num_parties <= 0:
+                parser.error(
+                    "masking secure aggregation needs secure.num_parties "
+                    "(the driver fills it in) or a configured learner list")
+            kwargs["num_parties"] = num_parties
+        secure_backend = make_backend(config.secure, role="controller",
+                                      **kwargs)
+
+    controller = Controller(
+        config,
+        lambda record: RpcLearnerProxy(record, ssl=config.ssl),
+        secure_backend=secure_backend)
+    if args.resume:
+        if not config.checkpoint.dir:
+            parser.error("--resume requires config.checkpoint.dir")
+        if not controller.restore_checkpoint():
+            logging.getLogger("metisfl_tpu.controller").warning(
+                "--resume: no checkpoint found under %r — starting FRESH "
+                "at round 0", config.checkpoint.dir)
+    server = ControllerServer(controller, host=args.host,
+                              port=args.port or config.controller_port,
+                              ssl=config.ssl)
+    port = server.start()
+    print(f"METISFL_TPU_CONTROLLER_READY port={port}", flush=True)
+
+    signal.signal(signal.SIGTERM, lambda *_: server.stop())
+    signal.signal(signal.SIGINT, lambda *_: server.stop())
+    server.wait_for_shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

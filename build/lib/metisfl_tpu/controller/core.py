@@ -1,0 +1,926 @@
+"""Federation controller core.
+
+The orchestration state machine: learner registry, task lifecycle, model
+store, aggregation driver, round-metadata lineage. Capability equivalent of
+the reference's C++ ``Controller``/``ControllerDefaultImpl``
+(reference metisfl/controller/core/controller.cc: AddLearner :98-168,
+RemoveLearner :170-199, LearnerCompletedTask :201-259, ScheduleTasks
+:428-518, UpdateLearnersTaskTemplates :520-569, ComputeCommunityModel
+:795-950), redesigned:
+
+- Models are flat ``{name: np.ndarray}`` dicts controller-side (no byte-blob
+  per-variable arithmetic); aggregation is one jit-compiled XLA computation.
+- Concurrency: RPC threads only enqueue; a single-worker scheduling executor
+  owns all round logic, so a learner's completion ack never blocks on
+  aggregation (the reference pushes ScheduleTasks onto a thread pool for the
+  same reason, controller.cc:246-255) and state needs one lock, not two.
+- Transport is pluggable (:class:`LearnerProxy`): in-process calls for tests
+  and pod-mode, gRPC for cross-host federations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import resource
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from metisfl_tpu.aggregation import make_aggregation_rule
+from metisfl_tpu.aggregation.secure import SecureAgg
+from metisfl_tpu.comm.codec import dumps as codec_dumps
+from metisfl_tpu.comm.codec import loads as codec_loads
+from metisfl_tpu.comm.messages import (
+    EvalResult,
+    EvalTask,
+    JoinReply,
+    JoinRequest,
+    TaskResult,
+    TrainParams,
+    TrainTask,
+)
+from metisfl_tpu.config import FederationConfig
+from metisfl_tpu.scaling import make_scaler
+from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
+from metisfl_tpu.selection import make_selector
+from metisfl_tpu.store import EvictionPolicy, make_store
+from metisfl_tpu.tensor.pytree import ModelBlob
+from metisfl_tpu.tensor.spec import quantify
+
+logger = logging.getLogger("metisfl_tpu.controller")
+
+
+class LearnerProxy(Protocol):
+    """Controller → learner transport for one registered learner."""
+
+    def run_task(self, task: TrainTask) -> None:
+        """Fire-and-forget local-training dispatch."""
+        ...
+
+    def evaluate(self, task: EvalTask, callback: Callable[[EvalResult], None]) -> None:
+        """Non-blocking evaluation; ``callback`` runs on completion."""
+        ...
+
+    def shutdown(self) -> None:
+        ...
+
+
+@dataclass
+class LearnerRecord:
+    learner_id: str
+    auth_token: str
+    hostname: str = "localhost"
+    port: int = 0
+    num_train_examples: int = 0
+    num_val_examples: int = 0
+    num_test_examples: int = 0
+    # latest task execution metadata (feeds scalers + semi-sync recompute)
+    completed_batches: int = 0
+    ms_per_step: float = 0.0
+    # consecutive failed train dispatches (liveness; reset on completion)
+    dispatch_failures: int = 0
+    # per-learner train overrides (semi-sync step budgets)
+    local_steps_override: int = 0
+    proxy: Optional[LearnerProxy] = None
+
+
+@dataclass
+class RoundMetadata:
+    """Per-round runtime trace — the reference's FederatedTaskRuntimeMetadata
+    (metis.proto:342-365) rebuilt as a plain record."""
+
+    global_iteration: int = 0
+    started_at: float = 0.0
+    completed_at: float = 0.0
+    train_submitted_at: Dict[str, float] = field(default_factory=dict)
+    train_received_at: Dict[str, float] = field(default_factory=dict)
+    eval_submitted_at: Dict[str, float] = field(default_factory=dict)
+    eval_received_at: Dict[str, float] = field(default_factory=dict)
+    selected_learners: List[str] = field(default_factory=list)
+    aggregation_block_sizes: List[int] = field(default_factory=list)
+    aggregation_block_duration_ms: List[float] = field(default_factory=list)
+    aggregation_duration_ms: float = 0.0
+    model_insertion_duration_ms: Dict[str, float] = field(default_factory=dict)
+    model_size: Dict[str, int] = field(default_factory=dict)
+    peak_rss_kb: int = 0
+    # non-fatal round errors (e.g. partial-cohort secure aggregation after a
+    # deadline) — surfaced in lineage instead of vanishing into a log line
+    errors: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Controller:
+    """See module docstring. Lifecycle: ``start()`` → learners ``join()`` →
+    rounds run event-driven off ``task_completed()`` → ``shutdown()``."""
+
+    def __init__(self, config: FederationConfig,
+                 proxy_factory: Callable[[LearnerRecord], LearnerProxy],
+                 secure_backend=None):
+        self.config = config
+        self._proxy_factory = proxy_factory
+        self._lock = threading.RLock()
+        self._learners: Dict[str, LearnerRecord] = {}
+        self._tokens: Dict[str, str] = {}
+
+        agg = config.aggregation
+        if config.secure.enabled:
+            if secure_backend is None:
+                raise ValueError("secure aggregation enabled but no backend given")
+            self._aggregator = SecureAgg(secure_backend)
+        else:
+            self._aggregator = make_aggregation_rule(agg.rule)
+        self._scaler = make_scaler(agg.scaler)
+        self._selector = make_selector("scheduled_cardinality")
+        if config.protocol == "semi_synchronous":
+            self._scheduler = make_scheduler(
+                "semi_synchronous", lambda_=config.semi_sync_lambda,
+                recompute_every_round=config.semi_sync_recompute_every_round)
+        else:
+            self._scheduler = make_scheduler(config.protocol)
+
+        store_cfg = config.model_store
+        lineage = store_cfg.lineage_length or self._aggregator.required_lineage
+        lineage = max(lineage, self._aggregator.required_lineage)
+        store_kwargs = {"lineage_length": lineage}
+        if store_cfg.store in ("disk", "cached_disk"):
+            store_kwargs["root"] = store_cfg.root or "/tmp/metisfl_tpu_store"
+        if store_cfg.store == "cached_disk":
+            store_kwargs["cache_bytes"] = store_cfg.cache_mb << 20
+        self._store = make_store(store_cfg.store, **store_kwargs)
+
+        # community model state
+        self._community_flat: Optional[Dict[str, np.ndarray]] = None
+        self._community_blob: Optional[bytes] = None
+        self._community_opaque = None      # secure path
+        self.global_iteration = 0
+
+        # lineage / statistics
+        self.round_metadata: List[RoundMetadata] = []
+        self.community_evaluations: List[Dict[str, Any]] = []
+        self._current_meta = RoundMetadata(global_iteration=0)
+
+        # single-worker pool serializes all scheduling/aggregation work
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ctrl-sched")
+        self._shutdown = threading.Event()
+        self._tasks_in_flight: Dict[str, str] = {}  # task_id -> learner_id
+        # straggler-deadline state: each dispatch bumps the serial so a
+        # deadline timer from a completed round never fires on the next one
+        self._round_serial = 0
+        self._deadline_timer: Optional[threading.Timer] = None
+        self._expired_tasks: Dict[str, None] = {}  # ordered set of task_ids
+        # consecutive aggregation failures (reset on success): distinguishes
+        # transient partial-cohort failures from a deterministically broken
+        # federation, which must halt instead of retraining forever
+        self._agg_failures = 0
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        pass  # transport servers are owned by the service layer
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+        self._pool.shutdown(wait=True)
+        self._store.shutdown()
+
+    # ------------------------------------------------------------------ #
+    # membership (RPC thread)
+    # ------------------------------------------------------------------ #
+
+    def join(self, request: JoinRequest) -> JoinReply:
+        """Register (or re-register) a learner; schedules its initial task.
+
+        Mirrors AddLearner (controller.cc:98-168) + the rejoin path the
+        reference drives through ALREADY_EXISTS (grpc_controller_client.py:96-107).
+        """
+        with self._lock:
+            if (request.previous_id
+                    and request.previous_id in self._learners
+                    and self._tokens.get(request.previous_id) == request.auth_token):
+                record = self._learners[request.previous_id]
+                record.hostname, record.port = request.hostname, request.port
+                record.proxy = self._proxy_factory(record)
+                record.dispatch_failures = 0  # fresh endpoint, assume live
+                logger.info("learner %s rejoined", record.learner_id)
+                # Re-dispatch the current community model so a crash-restarted
+                # learner rejoins the in-flight round instead of idling until
+                # the next dispatch (the reference leaves the sync round
+                # stalled after a crash — SURVEY.md §5.3).
+                if not self._shutdown.is_set():
+                    self._pool.submit(self._guard, self._schedule_initial,
+                                      record.learner_id)
+                return JoinReply(learner_id=record.learner_id,
+                                 auth_token=record.auth_token, rejoined=True)
+            learner_id = f"L{len(self._tokens)}_{request.hostname}_{request.port}"
+            token = uuid.uuid4().hex
+            record = LearnerRecord(
+                learner_id=learner_id, auth_token=token,
+                hostname=request.hostname, port=request.port,
+                num_train_examples=request.num_train_examples,
+                num_val_examples=request.num_val_examples,
+                num_test_examples=request.num_test_examples,
+            )
+            record.proxy = self._proxy_factory(record)
+            self._learners[learner_id] = record
+            self._tokens[learner_id] = token
+        logger.info("learner %s joined (%d train examples)",
+                    learner_id, request.num_train_examples)
+        # Control handoff exactly like controller.cc:163-164: initial task is
+        # scheduled off the join path.
+        if not self._shutdown.is_set():
+            self._pool.submit(self._guard, self._schedule_initial, learner_id)
+        return JoinReply(learner_id=learner_id, auth_token=token)
+
+    def leave(self, learner_id: str, auth_token: str) -> bool:
+        """RemoveLearner (controller.cc:170-199): drop registry + models."""
+        with self._lock:
+            record = self._learners.get(learner_id)
+            if record is None or record.auth_token != auth_token:
+                return False
+            del self._learners[learner_id]
+        self._store.erase([learner_id])
+        logger.info("learner %s left", learner_id)
+        # Re-evaluate the round barrier: if the departed learner was the last
+        # pending one, no completion event would ever release the round.
+        if not self._shutdown.is_set():
+            self._pool.submit(self._guard, self._handle_membership_change)
+        return True
+
+    def active_learners(self) -> List[str]:
+        with self._lock:
+            return list(self._learners.keys())
+
+    def learner_endpoints(self) -> List[Dict[str, Any]]:
+        """Registered endpoints with the ports learners reported on join."""
+        with self._lock:
+            return [
+                {"learner_id": r.learner_id, "hostname": r.hostname,
+                 "port": r.port}
+                for r in self._learners.values()
+            ]
+
+    # ------------------------------------------------------------------ #
+    # community model management (RPC thread)
+    # ------------------------------------------------------------------ #
+
+    def set_community_model(self, blob_bytes: bytes) -> None:
+        """ReplaceCommunityModel (controller.cc:85-96): seed or overwrite."""
+        blob = ModelBlob.from_bytes(blob_bytes)
+        with self._lock:
+            self._community_blob = bytes(blob_bytes)
+            if blob.tensors:
+                self._community_flat = dict(blob.tensors)
+            if blob.opaque:
+                self._community_opaque = dict(blob.opaque)
+
+    def community_model_bytes(self) -> Optional[bytes]:
+        with self._lock:
+            return self._community_blob
+
+    # ------------------------------------------------------------------ #
+    # task completion (RPC thread → scheduling executor)
+    # ------------------------------------------------------------------ #
+
+    def task_completed(self, result: TaskResult) -> bool:
+        """MarkTaskCompleted (controller.cc:201-259). Returns ack; all heavy
+        work happens on the scheduling executor."""
+        if self._shutdown.is_set():
+            return False
+        with self._lock:
+            record = self._learners.get(result.learner_id)
+            if record is None:
+                logger.warning("completion from unknown learner %s",
+                               result.learner_id)
+                return False
+            # Validate the (learner_id, auth_token) composite key before
+            # accepting a model (the reference's ValidateLearner on
+            # MarkTaskCompleted, controller.cc:205, controller.proto:146-148)
+            # — without it any client could poison the community model.
+            if record.auth_token != result.auth_token:
+                logger.warning("completion from %s with bad auth token",
+                               result.learner_id)
+                return False
+        self._pool.submit(self._guard, self._handle_completed, result)
+        return True
+
+    # ------------------------------------------------------------------ #
+    # scheduling executor internals
+    # ------------------------------------------------------------------ #
+
+    # consecutive aggregation failures tolerated before halting re-dispatch
+    _MAX_AGG_FAILURES = 10
+
+    def _guard(self, fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception:  # pragma: no cover - logged, never kills the pool
+            logger.exception("controller executor task failed")
+
+    def _schedule_initial(self, learner_id: str) -> None:
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            record = self._learners.get(learner_id)
+        if record is None:
+            return
+        self._dispatch_train([learner_id], restart_deadline=False)
+
+    def _handle_completed(self, result: TaskResult) -> None:
+        start = time.time()
+        with self._lock:
+            record = self._learners.get(result.learner_id)
+            if record is None:
+                return
+            record.completed_batches = result.completed_batches
+            record.dispatch_failures = 0  # provably reachable
+            if result.processing_ms_per_step > 0:
+                record.ms_per_step = result.processing_ms_per_step
+            self._tasks_in_flight.pop(result.task_id, None)
+            # A completion for a task the deadline already expired: keep the
+            # model (fresh data for later rounds) but do not advance the
+            # current round's barrier — and keep its timings out of the
+            # current round's metadata (it belongs to an abandoned round).
+            stale = result.task_id in self._expired_tasks
+            self._expired_tasks.pop(result.task_id, None)
+            if not stale:
+                self._current_meta.train_received_at[result.learner_id] = start
+
+        model = self._parse_result_model(result)
+        self._store.insert(result.learner_id, model)
+        if not stale:
+            with self._lock:
+                self._current_meta.model_insertion_duration_ms[result.learner_id] = (
+                    (time.time() - start) * 1e3)
+        if stale:
+            logger.info("late completion from %s for expired task %s stored "
+                        "but not scheduled", result.learner_id, result.task_id)
+            return
+
+        to_schedule = self._scheduler.schedule_next(
+            result.learner_id, self.active_learners())
+        if not to_schedule:
+            return
+        self._complete_round(to_schedule)
+
+    def _handle_membership_change(self) -> None:
+        active = self.active_learners()
+        if not active or self._shutdown.is_set():
+            return
+        cohort = self._scheduler.handle_leave(active)
+        if cohort:
+            self._complete_round(cohort)
+            return
+        if self._scheduler.round_stalled(active):
+            # every dispatched learner departed before the round could
+            # complete: abandon it and dispatch a fresh sample so the
+            # surviving learners keep making progress
+            logger.info("round abandoned (dispatched cohort left); re-dispatching")
+            self._scheduler.reset()
+            self._dispatch_train(self._sample_cohort())
+
+    # -- straggler deadline ----------------------------------------------
+
+    def _arm_round_deadline(self, restart: bool = True) -> None:
+        """Start (or restart) the per-round straggler timer after a dispatch.
+        Only sync/semi-sync rounds have a barrier a straggler can stall.
+
+        ``restart=False`` (join/rejoin single-learner dispatches) only arms
+        when no timer is live — otherwise a crash-looping learner rejoining
+        inside the deadline window would keep postponing it forever, and a
+        mid-round join would silently extend the in-flight round's deadline.
+        """
+        deadline = self.config.round_deadline_secs
+        if deadline <= 0 or self._scheduler.name == "asynchronous":
+            return
+        with self._lock:
+            if (not restart and self._deadline_timer is not None
+                    and self._deadline_timer.is_alive()):
+                return
+            self._round_serial += 1
+            serial = self._round_serial
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
+
+            def _fire():
+                if self._shutdown.is_set():
+                    return
+                try:
+                    self._pool.submit(self._guard, self._handle_deadline, serial)
+                except RuntimeError:  # pool already shut down
+                    pass
+
+            timer = threading.Timer(deadline, _fire)
+            timer.daemon = True
+            self._deadline_timer = timer
+            timer.start()
+
+    def _handle_deadline(self, serial: int) -> None:
+        """Round deadline expired: drop unreported learners from the barrier
+        and proceed with whoever reported (or re-dispatch if nobody did)."""
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            if serial != self._round_serial:
+                return  # round already completed; stale timer
+            pending = dict(self._tasks_in_flight)
+            self._expired_tasks.update(dict.fromkeys(pending))
+            while len(self._expired_tasks) > 512:
+                self._expired_tasks.pop(next(iter(self._expired_tasks)))
+            self._tasks_in_flight.clear()
+        cohort = self._scheduler.expire_pending(self.active_learners())
+        dropped = sorted(set(pending.values()))
+        if cohort:
+            logger.warning(
+                "round deadline (%.1fs) expired; aggregating %d reporter(s), "
+                "dropping stragglers %s", self.config.round_deadline_secs,
+                len(cohort), dropped)
+            # partial-cohort aggregation can legitimately fail (masking
+            # secure-agg needs every party); _complete_round records the
+            # error and re-dispatches a fresh full cohort itself
+            self._complete_round(cohort)
+        else:
+            logger.warning(
+                "round deadline (%.1fs) expired with no reporters (%s); "
+                "re-dispatching", self.config.round_deadline_secs, dropped)
+            self._dispatch_train(self._sample_cohort())
+
+    def _parse_result_model(self, result: TaskResult):
+        blob = ModelBlob.from_bytes(result.model)
+        if self.config.secure.enabled:
+            return result.model if blob.opaque else dict(blob.tensors)
+        return dict(blob.tensors)
+
+    def _complete_round(self, cohort: Sequence[str]) -> None:
+        """One ScheduleTasks pass (controller.cc:428-518): select, aggregate,
+        record metadata, evaluate, re-dispatch.
+
+        Aggregation failure must never strand the federation: the error is
+        recorded in round metadata and the round re-dispatches — async
+        re-dispatches the reporters (so they are not left idle forever
+        waiting for a completion ack that aborted), sync abandons the round
+        and re-dispatches a fresh full cohort (mask streams are keyed on the
+        round counter, which did not advance, so secure retries are clean).
+        """
+        selected = self._selector.select(cohort, self.active_learners())
+        try:
+            self._compute_community_model(selected)
+            self._agg_failures = 0
+        except Exception as exc:
+            self._agg_failures += 1
+            with self._lock:
+                self._current_meta.errors.append(f"aggregation failed: {exc!r}")
+            if self._agg_failures >= self._MAX_AGG_FAILURES:
+                # deterministic breakage (version skew, corrupt payloads):
+                # retraining forever would never terminate — halt dispatch
+                # and leave the error trail; the driver's wall-clock cutoff
+                # (or an operator) takes it from here
+                logger.error(
+                    "aggregation failed %d consecutive times (%r); halting "
+                    "re-dispatch", self._agg_failures, exc)
+                return
+            logger.warning("aggregation failed (%r); re-dispatching", exc)
+            if self._shutdown.is_set():
+                return
+            if self._scheduler.name == "asynchronous":
+                active = self.active_learners()
+                self._dispatch_train([lid for lid in cohort if lid in active])
+            else:
+                self._scheduler.reset()
+                self._dispatch_train(self._sample_cohort())
+            return
+        self._send_eval_tasks()
+        with self._lock:
+            self.global_iteration += 1
+            self._current_meta.completed_at = time.time()
+            self._current_meta.peak_rss_kb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+            self.round_metadata.append(self._current_meta)
+            self._current_meta = RoundMetadata(
+                global_iteration=self.global_iteration)
+        ckpt = self.config.checkpoint
+        if ckpt.dir and self.global_iteration % max(1, ckpt.every_n_rounds) == 0:
+            try:
+                self.save_checkpoint()
+            except Exception:
+                logger.exception("checkpoint save failed")
+        self._maybe_recompute_semisync()
+        if self._shutdown.is_set():
+            return
+        if self._scheduler.name == "asynchronous":
+            # async: re-dispatch only the reporting learner(s)
+            active = self.active_learners()
+            next_ids = [lid for lid in cohort if lid in active]
+        else:
+            next_ids = self._sample_cohort()
+        self._dispatch_train(next_ids)
+
+    def _sample_cohort(self) -> List[str]:
+        """Sample next round's participants from reachable active learners
+        (ControllerParams.participation_ratio). The scheduler barriers on the
+        dispatched sample, so ratio < 1 cannot stall a synchronous round.
+
+        Learners with ``max_dispatch_failures`` consecutive failed dispatches
+        are skipped until they complete a task or rejoin — a dead endpoint
+        must not keep re-entering sync barriers (SURVEY.md §5.3)."""
+        ratio = self.config.aggregation.participation_ratio
+        limit = self.config.max_dispatch_failures
+        with self._lock:
+            pool = [lid for lid, r in self._learners.items()
+                    if limit <= 0 or r.dispatch_failures < limit]
+            if not pool:
+                # every learner looks dead: keep trying rather than halting
+                pool = list(self._learners.keys())
+        if ratio >= 1.0 or not pool:
+            return pool
+        k = max(1, int(round(ratio * len(pool))))
+        return random.sample(pool, k)
+
+    def _maybe_recompute_semisync(self) -> None:
+        if not isinstance(self._scheduler, SemiSynchronousScheduler):
+            return
+        batch = self.config.train.batch_size
+        with self._lock:
+            timings = {
+                lid: {
+                    "ms_per_step": r.ms_per_step,
+                    "steps_per_epoch": max(1.0, r.num_train_examples / max(1, batch)),
+                }
+                for lid, r in self._learners.items()
+            }
+        overrides = self._scheduler.recompute_steps(timings)
+        if not overrides:
+            return
+        with self._lock:
+            for lid, steps in overrides.items():
+                if lid in self._learners:
+                    self._learners[lid].local_steps_override = steps
+        logger.info("semi-sync step budgets: %s", overrides)
+
+    # -- aggregation ------------------------------------------------------
+
+    def _compute_community_model(self, selected: Sequence[str]) -> None:
+        """ComputeCommunityModel (controller.cc:795-950), stride-blocked."""
+        t0 = time.time()
+        lineage_k = self._aggregator.required_lineage
+        stride = self.config.aggregation.stride_length or len(selected) or 1
+        scales = self._scaler(self._scaling_metadata(selected))
+        # FedStride state resets between rounds (federated_stride.cc:52-68);
+        # FedRec carries state across rounds; FedAvg resets in its own branch.
+        if self._aggregator.name == "fedstride":
+            self._aggregator.reset()
+
+        community = None
+        meta_blocks: List[int] = []
+        meta_durations: List[float] = []
+        ids = [lid for lid in selected if lid in scales]
+        if self.config.secure.enabled:
+            # Secure: every party's payload must enter one combine call
+            # (masking sums must cancel across ALL parties), so blocks only
+            # bound store-select batching here.
+            pairs = []
+            for i in range(0, len(ids), stride):
+                block = ids[i : i + stride]
+                tb = time.time()
+                picked = self._store.select(block, k=lineage_k)
+                for lid in block:
+                    if lid in picked:
+                        pairs.append((picked[lid], scales[lid]))
+                meta_blocks.append(len(block))
+                meta_durations.append((time.time() - tb) * 1e3)
+            if not pairs:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+            community = self._aggregator.aggregate(self._parse_secure(pairs))
+        elif self._aggregator.name == "fedavg":
+            # FedAvg is a fold: accumulate block-by-block so only one stride
+            # block of models is ever resident (the point of the reference's
+            # stride loop, controller.cc:842-936).
+            self._aggregator.reset()
+            accumulated = 0
+            for i in range(0, len(ids), stride):
+                block = ids[i : i + stride]
+                tb = time.time()
+                picked = self._store.select(block, k=lineage_k)
+                pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
+                if pairs:
+                    self._aggregator.accumulate(pairs)
+                    accumulated += len(pairs)
+                meta_blocks.append(len(block))
+                meta_durations.append((time.time() - tb) * 1e3)
+            if not accumulated:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+            community = self._aggregator.result()
+            self._aggregator.reset()
+        else:
+            # rolling rules (fedstride / fedrec): incremental block updates
+            for i in range(0, len(ids), stride):
+                block = ids[i : i + stride]
+                tb = time.time()
+                picked = self._store.select(block, k=lineage_k)
+                pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
+                present = [lid for lid in block if lid in picked]
+                if pairs:
+                    community = self._aggregator.aggregate(
+                        pairs, learner_ids=present)
+                meta_blocks.append(len(block))
+                meta_durations.append((time.time() - tb) * 1e3)
+            if community is None:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+
+        blob = self._community_to_blob(community)
+        with self._lock:
+            if self.config.secure.enabled:
+                self._community_opaque = community
+            else:
+                self._community_flat = community
+            self._community_blob = blob
+            meta = self._current_meta
+            meta.selected_learners = list(selected)
+            meta.aggregation_block_sizes = meta_blocks
+            meta.aggregation_block_duration_ms = meta_durations
+            meta.aggregation_duration_ms = (time.time() - t0) * 1e3
+            if not self.config.secure.enabled:
+                sizes = {"values": 0, "non_zeros": 0, "zeros": 0, "bytes": 0}
+                for arr in community.values():
+                    q = quantify(np.asarray(arr))
+                    for key in sizes:
+                        sizes[key] += q[key]
+                meta.model_size = sizes
+
+    def _parse_secure(self, pairs):
+        parsed = []
+        for lineage, scale in pairs:
+            models = []
+            for item in lineage:
+                if isinstance(item, (bytes, bytearray)):
+                    blob = ModelBlob.from_bytes(item)
+                    models.append(dict(blob.opaque))
+                else:
+                    models.append(item)
+            parsed.append((models, scale))
+        return parsed
+
+    def _community_to_blob(self, community) -> bytes:
+        if self.config.secure.enabled:
+            return ModelBlob(opaque=dict(community)).to_bytes()
+        named = [(name, np.asarray(arr)) for name, arr in community.items()]
+        return ModelBlob(tensors=named).to_bytes()
+
+    def _scaling_metadata(self, selected: Sequence[str]) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                lid: {
+                    "num_train_examples": self._learners[lid].num_train_examples,
+                    "completed_batches": self._learners[lid].completed_batches,
+                }
+                for lid in selected
+                if lid in self._learners
+            }
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _dispatch_train(self, learner_ids: Sequence[str],
+                        restart_deadline: bool = True) -> None:
+        """SendRunTasks (controller.cc:696-759)."""
+        with self._lock:
+            blob = self._community_blob
+        if blob is None:
+            logger.warning("no community model yet; cannot dispatch train tasks")
+            return
+        # The dispatched set is the synchronous round barrier (participation
+        # sampling means it can be a strict subset of the active learners).
+        self._scheduler.notify_dispatched(list(learner_ids))
+        with self._lock:
+            if not self._current_meta.started_at:
+                # first dispatch of this round == round start
+                # (reference controller.cc:406-418)
+                self._current_meta.started_at = time.time()
+        for lid in learner_ids:
+            with self._lock:
+                record = self._learners.get(lid)
+                if record is None:
+                    continue
+                params = dataclasses.replace(self.config.train)
+                if record.local_steps_override:
+                    params.local_steps = record.local_steps_override
+                task = TrainTask(
+                    task_id=uuid.uuid4().hex,
+                    learner_id=lid,
+                    round_id=self.global_iteration,
+                    global_iteration=self.global_iteration,
+                    model=blob,
+                    params=params,
+                )
+                self._tasks_in_flight[task.task_id] = lid
+                self._current_meta.train_submitted_at[lid] = time.time()
+                proxy = record.proxy
+            try:
+                if hasattr(proxy, "run_task_with_callback"):
+                    # async transports surface failures via callback
+                    proxy.run_task_with_callback(
+                        task, lambda exc, lid=lid:
+                        self._note_dispatch_failure(lid, exc))
+                else:
+                    proxy.run_task(task)
+            except Exception as exc:
+                # Failed dispatches are logged and counted (the reference
+                # only logs and keeps scheduling them, controller.cc:783-786);
+                # async protocols recover, sync rounds rely on the round
+                # deadline / membership changes, and _sample_cohort skips
+                # learners past the consecutive-failure limit.
+                logger.exception("train dispatch to %s failed", lid)
+                self._note_dispatch_failure(lid, exc)
+        self._arm_round_deadline(restart=restart_deadline)
+
+    def _note_dispatch_failure(self, learner_id: str, exc: Exception) -> None:
+        with self._lock:
+            record = self._learners.get(learner_id)
+            if record is None:
+                return
+            record.dispatch_failures += 1
+            count = record.dispatch_failures
+        limit = self.config.max_dispatch_failures
+        if limit > 0 and count == limit:
+            logger.warning(
+                "learner %s unreachable after %d failed dispatches (%r); "
+                "excluded from cohort sampling until it reports or rejoins",
+                learner_id, count, exc)
+
+    def _send_eval_tasks(self) -> None:
+        """SendEvaluationTasks (controller.cc:571-647) + digest callback."""
+        cfg = self.config.eval
+        if cfg.every_n_rounds <= 0:
+            return
+        if (self.global_iteration + 1) % cfg.every_n_rounds != 0:
+            return
+        with self._lock:
+            blob = self._community_blob
+            learners = list(self._learners.values())
+            iteration = self.global_iteration
+            # bind eval timestamps to the SUBMITTING round's metadata — the
+            # digest callback may fire after _complete_round swapped
+            # _current_meta, and the received_at must land in the same round
+            # record as its submitted_at (the reference keeps this lineage
+            # clean, controller.cc:582-586, :673-675)
+            meta = self._current_meta
+        if blob is None:
+            return
+        entry: Dict[str, Any] = {"global_iteration": iteration, "evaluations": {}}
+        with self._lock:
+            self.community_evaluations.append(entry)
+        for record in learners:
+            task = EvalTask(
+                task_id=uuid.uuid4().hex,
+                learner_id=record.learner_id,
+                round_id=iteration,
+                model=blob,
+                batch_size=cfg.batch_size,
+                datasets=list(cfg.datasets),
+                metrics=list(cfg.metrics),
+            )
+            with self._lock:
+                meta.eval_submitted_at[record.learner_id] = time.time()
+
+            def _digest(result: EvalResult, lid=record.learner_id,
+                        entry=entry, meta=meta):
+                with self._lock:
+                    entry["evaluations"][lid] = result.evaluations
+                    meta.eval_received_at[lid] = time.time()
+
+            try:
+                record.proxy.evaluate(task, _digest)
+            except Exception:
+                logger.exception("eval dispatch to %s failed", record.learner_id)
+
+    # ------------------------------------------------------------------ #
+    # checkpoint / resume
+    # ------------------------------------------------------------------ #
+
+    _CKPT_NAME = "controller_ckpt.bin"
+
+    def save_checkpoint(self, path: Optional[str] = None) -> str:
+        """Persist community model + round counter + lineage metadata.
+
+        Closes the reference's resume gap (SURVEY.md §5.4: resume there is
+        manual re-seeding via ReplaceCommunityModel, controller.cc:85-96 —
+        the round counter and metadata lineage are lost)."""
+        if path is None:
+            path = os.path.join(self.config.checkpoint.dir, self._CKPT_NAME)
+        with self._lock:
+            state = {
+                "global_iteration": self.global_iteration,
+                "community_blob": self._community_blob or b"",
+                "round_metadata": [m.to_dict() for m in self.round_metadata],
+                "community_evaluations": self._snapshot_evaluations(),
+            }
+            # Rolling rules (FedRec) carry cross-round state; persist the
+            # contribution scales so resume can rebuild wc_scaled/z from the
+            # store's lineage (aggregation/rolling.py rehydrate).
+            if hasattr(self._aggregator, "export_scales"):
+                state["agg_scales"] = self._aggregator.export_scales()
+        buf = codec_dumps(state)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # unique temp per writer: concurrent saves (per-round auto-checkpoint
+        # racing an operator-initiated one) must not share a staging file
+        import tempfile as _tempfile
+        fd, tmp = _tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                    prefix=".ckpt_", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(buf)
+            os.replace(tmp, path)  # atomic: a crash never leaves a torn file
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return path
+
+    def restore_checkpoint(self, path: Optional[str] = None) -> bool:
+        """Restore from ``save_checkpoint`` output; returns False when no
+        checkpoint exists (fresh start)."""
+        if path is None:
+            path = self.config.checkpoint.dir
+        if os.path.isdir(path):
+            path = os.path.join(path, self._CKPT_NAME)
+        if not os.path.exists(path):
+            return False
+        with open(path, "rb") as f:
+            state = codec_loads(f.read())
+        blob = state.get("community_blob") or None
+        with self._lock:
+            self.global_iteration = int(state["global_iteration"])
+            self.round_metadata = [
+                RoundMetadata(**m) for m in state.get("round_metadata", [])]
+            self.community_evaluations = list(
+                state.get("community_evaluations", []))
+            self._current_meta = RoundMetadata(
+                global_iteration=self.global_iteration)
+        if blob:
+            self.set_community_model(blob)
+        agg_scales = state.get("agg_scales")
+        if agg_scales and hasattr(self._aggregator, "rehydrate"):
+            # FedRec restart-correctness: without this, the rolling sum would
+            # silently rebuild from scratch and stragglers' prior
+            # contributions would double-count on their next report.
+            restored = self._aggregator.rehydrate(self._store, agg_scales)
+            logger.info("rehydrated %d/%d rolling contributions from store",
+                        restored, len(agg_scales))
+        logger.info("restored checkpoint %s at round %d",
+                    path, self.global_iteration)
+        return True
+
+    # ------------------------------------------------------------------ #
+    # statistics (driver)
+    # ------------------------------------------------------------------ #
+
+    def _snapshot_evaluations(self, tail: int = 0) -> List[dict]:
+        """Copy evaluation entries deep enough to detach the mutable
+        ``evaluations`` dict, which eval-digest callbacks keep inserting into
+        under the lock — a caller serializing a shallow copy outside the lock
+        would race those inserts. Call with ``self._lock`` held."""
+        entries = (self.community_evaluations[-tail:] if tail > 0
+                   else self.community_evaluations)
+        return [{**e, "evaluations": dict(e["evaluations"])}
+                for e in entries]
+
+    def get_statistics(self) -> dict:
+        with self._lock:
+            return {
+                "global_iteration": self.global_iteration,
+                "learners": sorted(self._learners.keys()),
+                "round_metadata": [m.to_dict() for m in self.round_metadata],
+                "community_evaluations": self._snapshot_evaluations(),
+            }
+
+    def get_runtime_metadata(self, tail: int = 0) -> List[dict]:
+        """Round-metadata lineage, optionally only the last ``tail`` rounds
+        (the reference's granular lineage getters, controller.proto:27-44 —
+        a 10k-round federation must not ship its whole history per poll)."""
+        with self._lock:
+            metas = (self.round_metadata[-tail:] if tail > 0
+                     else list(self.round_metadata))
+            return [m.to_dict() for m in metas]
+
+    def get_evaluation_lineage(self, tail: int = 0) -> List[dict]:
+        """Community-model evaluation lineage, optionally tail-bounded
+        (reference GetCommunityModelEvaluationLineage, controller.proto:27)."""
+        with self._lock:
+            return self._snapshot_evaluations(tail)

@@ -1,0 +1,215 @@
+"""Controller gRPC service + RPC-backed learner proxy.
+
+RPC surface of the reference's ``ControllerServicer``
+(reference metisfl/controller/core/controller_servicer.cc:110-382,
+metisfl/proto/controller.proto:8-49): join/leave federation, mark task
+completed, replace/get community model, statistics lineage, health, shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.comm.messages import (
+    EvalResult,
+    EvalTask,
+    JoinReply,
+    JoinRequest,
+    TaskResult,
+    TrainTask,
+)
+from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
+from metisfl_tpu.controller.core import Controller, LearnerRecord
+
+logger = logging.getLogger("metisfl_tpu.controller.service")
+
+CONTROLLER_SERVICE = "metisfl_tpu.Controller"
+LEARNER_SERVICE = "metisfl_tpu.Learner"
+
+
+class RpcLearnerProxy:
+    """Controller → remote learner over gRPC (async dispatch, mirroring the
+    reference's CompletionQueue fan-out, controller.cc:713-759)."""
+
+    def __init__(self, record: LearnerRecord, ssl=None):
+        self._client = RpcClient(record.hostname, record.port, LEARNER_SERVICE,
+                                 ssl=ssl)
+
+    def run_task(self, task: TrainTask) -> None:
+        self._client.call_async("RunTask", task.to_wire())
+
+    def run_task_with_callback(self, task: TrainTask, on_error) -> None:
+        """Dispatch + failure notification: feeds the controller's learner
+        liveness tracking (consecutive failed dispatches)."""
+        # RunTask acks immediately (non-blocking learner dispatch):
+        # wait_ready=False surfaces UNAVAILABLE from a dead endpoint at once
+        # (liveness counts in seconds, not 60 s deadlines), and the timeout
+        # bounds a connected-but-unresponsive peer.
+        self._client.call_async("RunTask", task.to_wire(),
+                                error_callback=on_error, timeout=60.0,
+                                wait_ready=False)
+
+    def evaluate(self, task: EvalTask, callback: Callable[[EvalResult], None]) -> None:
+        self._client.call_async(
+            "EvaluateModel", task.to_wire(),
+            callback=lambda raw: callback(EvalResult.from_wire(raw)))
+
+    def shutdown(self) -> None:
+        try:
+            self._client.call_async("ShutDown", b"")
+        finally:
+            pass
+
+
+class ControllerServer:
+    """Host a :class:`Controller` behind gRPC."""
+
+    def __init__(self, controller: Controller, host: str = "0.0.0.0",
+                 port: int = 50051, ssl=None):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+
+        self.controller = controller
+        self._server = RpcServer(host, port, ssl=ssl)
+        # standard grpc.health.v1 alongside the custom status RPC
+        # (reference controller_servicer.cc:7-9,32-33)
+        self._health_servicer = HealthServicer()
+        self._health_servicer.set_status(CONTROLLER_SERVICE, SERVING)
+        self._server.add_service(self._health_servicer.service())
+        self._server.add_service(BytesService(CONTROLLER_SERVICE, {
+            "JoinFederation": self._join,
+            "LeaveFederation": self._leave,
+            "MarkTaskCompleted": self._mark_completed,
+            "ReplaceCommunityModel": self._replace_model,
+            "GetCommunityModel": self._get_model,
+            "GetStatistics": self._get_statistics,
+            "GetRuntimeMetadata": self._get_runtime_metadata,
+            "GetEvaluationLineage": self._get_evaluation_lineage,
+            "ListLearners": self._list_learners,
+            "GetHealthStatus": self._health,
+            "ShutDown": self._shutdown_rpc,
+        }))
+        self._shutdown_event = threading.Event()
+        self.port: Optional[int] = None
+
+    # -- handlers (RPC threads) -------------------------------------------
+    def _join(self, raw: bytes) -> bytes:
+        return self.controller.join(JoinRequest.from_wire(raw)).to_wire()
+
+    def _leave(self, raw: bytes) -> bytes:
+        req = loads(raw)
+        ok = self.controller.leave(req["learner_id"], req["auth_token"])
+        return dumps({"ok": ok})
+
+    def _mark_completed(self, raw: bytes) -> bytes:
+        ok = self.controller.task_completed(TaskResult.from_wire(raw))
+        return dumps({"ok": ok})
+
+    def _replace_model(self, raw: bytes) -> bytes:
+        self.controller.set_community_model(raw)
+        return dumps({"ok": True})
+
+    def _get_model(self, raw: bytes) -> bytes:
+        return self.controller.community_model_bytes() or b""
+
+    def _get_statistics(self, raw: bytes) -> bytes:
+        return dumps(self.controller.get_statistics())
+
+    def _get_runtime_metadata(self, raw: bytes) -> bytes:
+        tail = int(loads(raw).get("tail", 0)) if raw else 0
+        return dumps({"global_iteration": self.controller.global_iteration,
+                      "round_metadata":
+                      self.controller.get_runtime_metadata(tail)})
+
+    def _get_evaluation_lineage(self, raw: bytes) -> bytes:
+        tail = int(loads(raw).get("tail", 0)) if raw else 0
+        return dumps({"community_evaluations":
+                      self.controller.get_evaluation_lineage(tail)})
+
+    def _list_learners(self, raw: bytes) -> bytes:
+        return dumps({"learners": self.controller.learner_endpoints()})
+
+    def _health(self, raw: bytes) -> bytes:
+        return dumps({"status": "SERVING",
+                      "learners": self.controller.active_learners()})
+
+    def _shutdown_rpc(self, raw: bytes) -> bytes:
+        # ack first, then tear down off-thread (servicer :364-375 pattern)
+        threading.Thread(target=self.stop, daemon=True).start()
+        return dumps({"ok": True})
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        self.port = self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._shutdown_event.is_set():
+            return
+        from metisfl_tpu.comm.health import NOT_SERVING
+
+        self._health_servicer.set_all(NOT_SERVING)
+        self._shutdown_event.set()
+        self.controller.shutdown()
+        self._server.stop()
+
+    def wait_for_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown_event.wait(timeout)
+
+
+class ControllerClient:
+    """Learner/driver → controller client (reference
+    grpc_controller_client.py:11-297)."""
+
+    def __init__(self, host: str, port: int, ssl=None):
+        self._client = RpcClient(host, port, CONTROLLER_SERVICE, ssl=ssl)
+
+    def join(self, request: JoinRequest) -> JoinReply:
+        return JoinReply.from_wire(self._client.call("JoinFederation",
+                                                     request.to_wire()))
+
+    def leave(self, learner_id: str, auth_token: str) -> bool:
+        raw = self._client.call("LeaveFederation", dumps(
+            {"learner_id": learner_id, "auth_token": auth_token}))
+        return bool(loads(raw)["ok"])
+
+    def task_completed(self, result: TaskResult) -> bool:
+        raw = self._client.call("MarkTaskCompleted", result.to_wire())
+        return bool(loads(raw)["ok"])
+
+    def replace_community_model(self, blob: bytes) -> bool:
+        return bool(loads(self._client.call("ReplaceCommunityModel", blob))["ok"])
+
+    def get_community_model(self) -> bytes:
+        return self._client.call("GetCommunityModel", b"")
+
+    def get_statistics(self) -> dict:
+        return loads(self._client.call("GetStatistics", b""))
+
+    def get_runtime_metadata(self, tail: int = 0) -> dict:
+        """{'global_iteration', 'round_metadata': last ``tail`` rounds}
+        (0 = full lineage)."""
+        raw = self._client.call("GetRuntimeMetadata", dumps({"tail": tail}))
+        return loads(raw)
+
+    def get_evaluation_lineage(self, tail: int = 0) -> list:
+        """Last ``tail`` evaluation entries (0 = full lineage)."""
+        raw = self._client.call("GetEvaluationLineage", dumps({"tail": tail}))
+        return loads(raw)["community_evaluations"]
+
+    def list_learners(self) -> list:
+        """Registered learner endpoints [{learner_id, hostname, port}] — the
+        ports learners actually bound (JoinRequest.port), for shutdown and
+        monitoring (replaces any port-arithmetic assumptions driver-side)."""
+        return loads(self._client.call("ListLearners", b""))["learners"]
+
+    def health(self, timeout: float = 5.0) -> dict:
+        return loads(self._client.call("GetHealthStatus", b"", timeout=timeout))
+
+    def shutdown_controller(self) -> bool:
+        return bool(loads(self._client.call("ShutDown", b""))["ok"])
+
+    def close(self) -> None:
+        self._client.close()

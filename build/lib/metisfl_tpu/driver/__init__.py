@@ -1,0 +1,4 @@
+from metisfl_tpu.driver.inprocess import InProcessFederation
+from metisfl_tpu.driver.session import DriverSession, LocalLauncher, SSHLauncher
+
+__all__ = ["InProcessFederation", "DriverSession", "LocalLauncher", "SSHLauncher"]

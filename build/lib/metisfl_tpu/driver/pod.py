@@ -1,0 +1,168 @@
+"""Pod-mode federation driver: one FederationConfig, ICI transport.
+
+The same :class:`FederationConfig` that drives a multi-process gRPC
+federation (``DriverSession``) or an in-process one (``InProcessFederation``)
+runs here with the pod transport: all learners co-reside on one device mesh
+and every round is a single XLA call (``parallel/podfed.py``). The driver
+keeps the controller's *policy* surface — scaler weights, termination
+criteria, eval cadence, round-metadata lineage — while the *mechanism*
+(weight shipping + aggregation) collapses into the ``psum`` over ICI. This is
+the integration point SURVEY.md §2.3 calls the "ICI fast path" (replacing
+reference controller.cc:795-950's byte-blob aggregation loop).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from metisfl_tpu.config import FederationConfig
+from metisfl_tpu.models.dataset import ArrayDataset
+from metisfl_tpu.scaling import make_scaler
+from metisfl_tpu.tensor.pytree import pack_model
+
+
+class PodFederationDriver:
+    """Run a config-defined federation on a pod mesh.
+
+    Requirements (validated): synchronous protocol, ``fedavg`` rule, secure
+    aggregation disabled (weights never leave the device, so there is nothing
+    to hide from a controller), ``local_steps`` > 0 or derivable (every
+    learner runs the same scan length inside the single XLA program).
+    """
+
+    def __init__(
+        self,
+        config: FederationConfig,
+        module,
+        train_datasets: Sequence[ArrayDataset],
+        test_dataset: Optional[ArrayDataset] = None,
+        mesh=None,
+        loss="softmax_cross_entropy",
+        rng_seed: int = 0,
+    ):
+        if config.protocol != "synchronous":
+            raise ValueError(
+                "pod transport runs learners in lockstep inside one XLA "
+                "program; protocol must be 'synchronous'")
+        if config.aggregation.rule != "fedavg":
+            raise ValueError("pod transport aggregates via weighted psum "
+                             "(fedavg); rolling rules need the host path")
+        if config.secure.enabled:
+            raise ValueError("pod transport keeps weights on-device; secure "
+                             "aggregation applies to the host path")
+        self.config = config
+        self.datasets = list(train_datasets)
+        self.test_dataset = test_dataset
+        self.num_learners = len(self.datasets)
+
+        tp = config.train
+        if tp.local_steps > 0:
+            self.local_steps = tp.local_steps
+        else:
+            steps_per_epoch = min(
+                max(1, len(ds) // max(1, tp.batch_size)) for ds in self.datasets)
+            self.local_steps = max(1, int(round(tp.local_epochs * steps_per_epoch)))
+
+        sample = self.datasets[0].x[:2]
+        from metisfl_tpu.parallel.podfed import PodFederation
+        self.pod = PodFederation(
+            module, sample, self.num_learners, train_params=tp,
+            loss=loss, mesh=mesh, rng_seed=rng_seed)
+        self._scaler = make_scaler(config.aggregation.scaler)
+        self.round_metadata: List[Dict[str, Any]] = []
+        self.community_evaluations: List[Dict[str, Any]] = []
+        self._rng = np.random.default_rng(rng_seed)
+
+    # ------------------------------------------------------------------ #
+
+    def _scales(self) -> np.ndarray:
+        metadata = {
+            str(i): {"num_train_examples": len(ds),
+                     "completed_batches": self.local_steps}
+            for i, ds in enumerate(self.datasets)
+        }
+        weights = self._scaler(metadata)
+        return np.asarray([weights[str(i)] for i in range(self.num_learners)],
+                          np.float32)
+
+    def _draw_round_batches(self, round_idx: int):
+        """(L, K, B, ...) stacked per-learner batches — index cycling keeps
+        shapes uniform for any dataset size."""
+        K, B = self.local_steps, self.config.train.batch_size
+        xs, ys = [], []
+        for ds in self.datasets:
+            n = len(ds)
+            perm = np.concatenate([
+                np.random.default_rng((ds.seed, round_idx, rep)).permutation(n)
+                for rep in range(int(np.ceil(K * B / n)))])[: K * B]
+            xs.append(ds.x[perm].reshape(K, B, *ds.x.shape[1:]))
+            ys.append(ds.y[perm].reshape(K, B, *ds.y.shape[1:]))
+        return np.stack(xs), np.stack(ys)
+
+    # ------------------------------------------------------------------ #
+
+    def run_round(self) -> Dict[str, Any]:
+        round_idx = self.pod.global_iteration
+        t0 = time.time()
+        x, y = self._draw_round_batches(round_idx)
+        out = self.pod.run_round(x, y, self._scales())
+        meta = {
+            "global_iteration": round_idx,
+            "started_at": t0,
+            "completed_at": time.time(),
+            "selected_learners": [str(i) for i in range(self.num_learners)],
+            "aggregation_block_sizes": [self.num_learners],
+            "aggregation_block_duration_ms": [out["round_duration_ms"]],
+            # pod mode: aggregation is fused into the round program; the
+            # round duration IS the train+aggregate wall-clock
+            "aggregation_duration_ms": out["round_duration_ms"],
+            "mean_loss": out["mean_loss"],
+        }
+        self.round_metadata.append(meta)
+
+        cfg = self.config.eval
+        if (cfg.every_n_rounds > 0 and self.test_dataset is not None
+                and (round_idx + 1) % cfg.every_n_rounds == 0):
+            metrics = self.pod.evaluate(self.test_dataset.x,
+                                        self.test_dataset.y, cfg.batch_size)
+            self.community_evaluations.append({
+                "global_iteration": round_idx,
+                "evaluations": {"community": {"test": metrics}},
+            })
+        return out
+
+    def run(self) -> dict:
+        """Round loop with the config's termination criteria (the driver
+        monitor loop, reference driver_session.py:423-480)."""
+        term = self.config.termination
+        started = time.time()
+        while True:
+            if 0 < term.federation_rounds <= self.pod.global_iteration:
+                break
+            if term.execution_cutoff_mins > 0 and (
+                    time.time() - started > term.execution_cutoff_mins * 60):
+                break
+            if term.metric_cutoff_score > 0 and self.community_evaluations:
+                latest = self.community_evaluations[-1]["evaluations"][
+                    "community"]["test"]
+                if latest.get(term.metric_name, 0.0) >= term.metric_cutoff_score:
+                    break
+            self.run_round()
+        return self.get_statistics()
+
+    # ------------------------------------------------------------------ #
+
+    def get_statistics(self) -> dict:
+        """Same schema as ``Controller.get_statistics``."""
+        return {
+            "global_iteration": self.pod.global_iteration,
+            "learners": [str(i) for i in range(self.num_learners)],
+            "round_metadata": list(self.round_metadata),
+            "community_evaluations": list(self.community_evaluations),
+        }
+
+    def community_model_bytes(self) -> bytes:
+        return pack_model(self.pod.community_variables())

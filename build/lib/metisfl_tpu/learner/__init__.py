@@ -1,0 +1,3 @@
+from metisfl_tpu.learner.learner import Learner
+
+__all__ = ["Learner"]

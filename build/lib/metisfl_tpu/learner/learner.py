@@ -1,0 +1,266 @@
+"""Learner runtime: executes train/eval tasks against local data.
+
+Capability equivalent of the reference's learner process
+(reference metisfl/learner/learner.py:21-417, learner_servicer.py:14-139):
+join/leave the federation, run training tasks non-blocking with
+cancel-on-new-task, run evaluations, ship results back. Redesigned:
+
+- The reference isolates every task in a fresh "spawn" subprocess (1-worker
+  pebble pools, learner.py:77-89) because TF/Torch leak state; a JAX learner
+  keeps one process and one compiled-step cache — task isolation is the
+  functional purity of jit, and weights move by value through the wire
+  contract.
+- Training runs on a single worker thread; a new train task cancels the
+  running one between steps (the reference cancels the subprocess future,
+  learner_servicer.py:84-110).
+- Secure aggregation: when an HE backend is configured the learner encrypts
+  outgoing weights and decrypts incoming community models (the controller
+  never sees plaintext), mirroring model_ops.py:24-60 / ckks hookpoints.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Protocol
+
+import numpy as np
+
+from metisfl_tpu.comm.messages import (
+    EvalResult,
+    EvalTask,
+    InferResult,
+    InferTask,
+    JoinReply,
+    JoinRequest,
+    TaskResult,
+    TrainTask,
+)
+from metisfl_tpu.models.dataset import ArrayDataset
+from metisfl_tpu.models.ops import FlaxModelOps
+from metisfl_tpu.tensor.pytree import (
+    ModelBlob,
+    named_tensors_to_pytree,
+    pytree_to_named_tensors,
+)
+
+logger = logging.getLogger("metisfl_tpu.learner")
+
+
+class ControllerProxy(Protocol):
+    """Learner → controller transport."""
+
+    def join(self, request: JoinRequest) -> JoinReply: ...
+    def leave(self, learner_id: str, auth_token: str) -> bool: ...
+    def task_completed(self, result: TaskResult) -> bool: ...
+
+
+class Learner:
+    def __init__(
+        self,
+        model_ops: FlaxModelOps,
+        train_dataset: ArrayDataset,
+        controller: ControllerProxy,
+        val_dataset: Optional[ArrayDataset] = None,
+        test_dataset: Optional[ArrayDataset] = None,
+        hostname: str = "localhost",
+        port: int = 0,
+        secure_backend=None,
+    ):
+        self.model_ops = model_ops
+        self.datasets: Dict[str, Optional[ArrayDataset]] = {
+            "train": train_dataset,
+            "valid": val_dataset,
+            "test": test_dataset,
+        }
+        self.controller = controller
+        self.hostname = hostname
+        self.port = port
+        self.secure_backend = secure_backend
+
+        self.learner_id: str = ""
+        self.auth_token: str = ""
+        self._executor = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="learner-train")
+        self._cancel = threading.Event()
+        self._task_lock = threading.Lock()
+        self._current_future = None
+        self._shutdown = threading.Event()
+        # reference treedef for wire ↔ pytree (captured at construction)
+        self._treedef_like = model_ops.get_variables()
+
+    # ------------------------------------------------------------------ #
+    # membership
+    # ------------------------------------------------------------------ #
+
+    def join_federation(self, previous_id: str = "", auth_token: str = "") -> JoinReply:
+        reply = self.controller.join(JoinRequest(
+            hostname=self.hostname,
+            port=self.port,
+            num_train_examples=len(self.datasets["train"]),
+            num_val_examples=len(self.datasets["valid"] or []),
+            num_test_examples=len(self.datasets["test"] or []),
+            previous_id=previous_id,
+            auth_token=auth_token,
+        ))
+        self.learner_id = reply.learner_id
+        self.auth_token = reply.auth_token
+        return reply
+
+    def leave_federation(self) -> bool:
+        if not self.learner_id:
+            return False
+        return self.controller.leave(self.learner_id, self.auth_token)
+
+    # ------------------------------------------------------------------ #
+    # model wire I/O (+ optional HE)
+    # ------------------------------------------------------------------ #
+
+    def _load_model(self, blob_bytes: bytes):
+        """Decode (and decrypt) a model blob → variables pytree."""
+        blob = ModelBlob.from_bytes(blob_bytes)
+        if blob.opaque:
+            if self.secure_backend is None:
+                raise RuntimeError("received encrypted model without a backend")
+            named = []
+            for name, (payload, spec) in blob.opaque.items():
+                flat = self.secure_backend.decrypt(payload, spec.size)
+                from metisfl_tpu.tensor.spec import np_dtype_of
+                named.append((name, np.asarray(flat, np_dtype_of(spec.dtype))
+                              .reshape(spec.shape)))
+        else:
+            named = blob.tensors
+        return named_tensors_to_pytree(named, self._treedef_like)
+
+    def _dump_model(self) -> bytes:
+        named = pytree_to_named_tensors(self.model_ops.get_variables())
+        if self.secure_backend is not None:
+            from metisfl_tpu.tensor.spec import TensorSpec, wire_dtype_of, TensorKind
+            opaque = {}
+            for name, arr in named:
+                payload = self.secure_backend.encrypt(
+                    np.asarray(arr, np.float64).ravel())
+                spec = TensorSpec(arr.shape, wire_dtype_of(arr.dtype),
+                                  TensorKind.CIPHERTEXT)
+                opaque[name] = (payload, spec)
+            return ModelBlob(opaque=opaque).to_bytes()
+        return ModelBlob(tensors=named).to_bytes()
+
+    # ------------------------------------------------------------------ #
+    # task execution
+    # ------------------------------------------------------------------ #
+
+    def run_task(self, task: TrainTask) -> None:
+        """Non-blocking: cancels any running training, schedules this one."""
+        if self._shutdown.is_set():
+            return
+        with self._task_lock:
+            if self._current_future is not None and not self._current_future.done():
+                self._cancel.set()
+            self._current_future = self._executor.submit(
+                self._train_and_report, task)
+
+    def _train_and_report(self, task: TrainTask) -> None:
+        self._cancel.clear()
+        try:
+            params = task.params
+            if params.profile_dir:
+                # per-learner trace subdir: same-host learners start traces
+                # within the same second and jax.profiler session dirs are
+                # timestamped + hostname-named, so a shared dir would clobber
+                import dataclasses as _dc
+                import os as _os
+                params = _dc.replace(
+                    params, profile_dir=_os.path.join(
+                        params.profile_dir,
+                        self.learner_id or f"port_{self.port}"))
+            self.model_ops.set_variables(self._load_model(task.model))
+            out = self.model_ops.train(self.datasets["train"], params,
+                                       cancel_event=self._cancel)
+            # round-scoped mask derivation (pairwise-masking secure agg)
+            if self.secure_backend is not None and hasattr(
+                    self.secure_backend, "begin_round"):
+                self.secure_backend.begin_round(task.round_id)
+            if self._cancel.is_set():
+                logger.info("%s: task %s cancelled", self.learner_id, task.task_id)
+                return
+            result = TaskResult(
+                task_id=task.task_id,
+                learner_id=self.learner_id,
+                auth_token=self.auth_token,
+                round_id=task.round_id,
+                model=self._dump_model(),
+                num_train_examples=len(self.datasets["train"]),
+                completed_steps=out.completed_steps,
+                completed_epochs=out.completed_epochs,
+                completed_batches=out.completed_batches,
+                processing_ms_per_step=out.ms_per_step,
+                train_metrics=out.train_metrics,
+                epoch_metrics=out.epoch_metrics,
+            )
+            self.controller.task_completed(result)
+        except Exception:
+            logger.exception("%s: training task %s failed",
+                             self.learner_id, task.task_id)
+
+    def evaluate(self, task: EvalTask) -> EvalResult:
+        """Blocking community-model evaluation over requested datasets."""
+        t0 = time.time()
+        # Evaluate on an explicit variables tree so a concurrently running
+        # training task never races on the engine's model slot.
+        variables = self._load_model(task.model)
+        evaluations: Dict[str, Dict[str, float]] = {}
+        for name in task.datasets:
+            ds = self.datasets.get(name)
+            if ds is None or len(ds) == 0:
+                continue
+            evaluations[name] = self.model_ops.evaluate(
+                ds, task.batch_size, task.metrics, variables=variables)
+        return EvalResult(
+            task_id=task.task_id,
+            learner_id=self.learner_id,
+            round_id=task.round_id,
+            evaluations=evaluations,
+            duration_ms=(time.time() - t0) * 1e3,
+        )
+
+    def infer(self, task: InferTask) -> InferResult:
+        """Blocking inference on a shipped model (the reference learner's
+        third task type, learner.py:311-330): predictions over explicit
+        inputs or a named local split."""
+        t0 = time.time()
+        variables = self._load_model(task.model) if task.model else None
+        if task.inputs:
+            blob = ModelBlob.from_bytes(task.inputs)
+            tensors = dict(blob.tensors)
+            if "x" not in tensors:
+                raise ValueError("InferTask.inputs must pack an 'x' tensor")
+            x = tensors["x"]
+        else:
+            name = task.dataset or "test"
+            ds = self.datasets.get(name)
+            if ds is None or len(ds) == 0:
+                raise ValueError(
+                    f"inference requested on dataset {name!r} but this "
+                    "learner has no such split (available: "
+                    f"{[k for k, v in self.datasets.items() if v]})")
+            x = ds.x
+        if task.max_examples > 0:
+            x = x[: task.max_examples]
+        preds = self.model_ops.infer(x, task.batch_size, variables=variables)
+        return InferResult(
+            task_id=task.task_id,
+            learner_id=self.learner_id,
+            round_id=task.round_id,
+            predictions=ModelBlob(
+                tensors=[("predictions", np.asarray(preds))]).to_bytes(),
+            num_examples=int(len(x)),
+            duration_ms=(time.time() - t0) * 1e3,
+        )
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._cancel.set()
+        self._executor.shutdown(wait=True)

@@ -1,0 +1,85 @@
+"""Learner gRPC service.
+
+RPC surface of the reference's ``LearnerServicer``
+(reference metisfl/learner/learner_servicer.py:14-139, learner.proto:9-24):
+RunTask (non-blocking), EvaluateModel (blocking), health, shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from metisfl_tpu.comm.codec import dumps
+from metisfl_tpu.comm.messages import EvalTask, InferTask, TrainTask
+from metisfl_tpu.comm.rpc import BytesService, RpcServer
+from metisfl_tpu.controller.service import LEARNER_SERVICE, ControllerClient
+from metisfl_tpu.learner.learner import Learner
+
+logger = logging.getLogger("metisfl_tpu.learner.service")
+
+
+class LearnerServer:
+    def __init__(self, learner: Learner, host: str = "0.0.0.0", port: int = 0,
+                 ssl=None):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+
+        self.learner = learner
+        self._server = RpcServer(host, port, ssl=ssl)
+        self._health_servicer = HealthServicer()
+        self._health_servicer.set_status(LEARNER_SERVICE, SERVING)
+        self._server.add_service(self._health_servicer.service())
+        self._server.add_service(BytesService(LEARNER_SERVICE, {
+            "RunTask": self._run_task,
+            "EvaluateModel": self._evaluate,
+            "RunInference": self._infer,
+            "GetHealthStatus": self._health,
+            "ShutDown": self._shutdown_rpc,
+        }))
+        self._shutdown_event = threading.Event()
+        self._tasks_received = 0
+        self.port: Optional[int] = None
+
+    def _run_task(self, raw: bytes) -> bytes:
+        self._tasks_received += 1
+        self.learner.run_task(TrainTask.from_wire(raw))
+        return dumps({"ok": True})
+
+    def _evaluate(self, raw: bytes) -> bytes:
+        return self.learner.evaluate(EvalTask.from_wire(raw)).to_wire()
+
+    def _infer(self, raw: bytes) -> bytes:
+        return self.learner.infer(InferTask.from_wire(raw)).to_wire()
+
+    def _health(self, raw: bytes) -> bytes:
+        return dumps({"status": "SERVING", "tasks_received": self._tasks_received})
+
+    def _shutdown_rpc(self, raw: bytes) -> bytes:
+        logger.info("learner ShutDown RPC received")
+        threading.Thread(target=self.stop, daemon=True).start()
+        return dumps({"ok": True})
+
+    def start(self) -> int:
+        self.port = self._server.start()
+        self.learner.port = self.port
+        return self.port
+
+    def stop(self, leave: bool = True) -> None:
+        if self._shutdown_event.is_set():
+            return
+        from metisfl_tpu.comm.health import NOT_SERVING
+
+        self._health_servicer.set_all(NOT_SERVING)
+        logger.info("learner server stopping (leave=%s)", leave)
+        self._shutdown_event.set()
+        try:
+            if leave:
+                self.learner.leave_federation()
+        except Exception:  # controller may already be gone
+            logger.warning("leave_federation during shutdown failed")
+        self.learner.shutdown()
+        self._server.stop()
+
+    def wait_for_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown_event.wait(timeout)

@@ -1,0 +1,21 @@
+"""Model engine + zoo.
+
+``ModelOps`` is the engine-agnostic execution contract of the reference
+(reference metisfl/models/model_ops.py:18-144, keras_model_ops.py:15-283,
+pytorch_model_ops.py:23-172) rebuilt on Flax/optax: params get/set through
+the wire contract, local training as exactly-N jit-compiled optimizer steps
+(the reference's epochs+StepCounter emulation is lossy — SURVEY.md §7 "hard
+parts"), evaluation as a jit forward pass.
+"""
+
+from metisfl_tpu.models.ops import FlaxModelOps, TrainOutput
+from metisfl_tpu.models.dataset import ArrayDataset
+from metisfl_tpu.models.optimizers import make_optimizer, fedprox
+
+__all__ = [
+    "FlaxModelOps",
+    "TrainOutput",
+    "ArrayDataset",
+    "make_optimizer",
+    "fedprox",
+]

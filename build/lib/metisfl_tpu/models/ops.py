@@ -1,0 +1,470 @@
+"""FlaxModelOps — the learner's jit-compiled execution engine.
+
+Replaces the reference's per-engine ModelOps (keras_model_ops.py:117-225,
+pytorch_model_ops.py:23-172) with one JAX engine:
+
+- local training runs **exactly N optimizer steps** as a cached jit-compiled
+  step function (the reference converts steps→epochs and stops early with a
+  ``StepCounter`` callback, keras_model_ops.py:131-138 — lossy; here N is N);
+- FedProx is a proximal term added to the loss (∇ matches the reference's
+  ``fed_prox.py`` update exactly);
+- BatchNorm-style mutable state (``batch_stats``) is part of the federated
+  model: it ships and aggregates with the weights;
+- step wall-clock is measured post-compilation so the semi-sync scheduler
+  sees steady-state timings (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.models.dataset import ArrayDataset
+from metisfl_tpu.models.optimizers import make_optimizer
+
+Pytree = Any
+
+logger = logging.getLogger("metisfl_tpu.models")
+
+
+@dataclass
+class TrainOutput:
+    variables: Pytree
+    completed_steps: int
+    completed_batches: int
+    completed_epochs: float
+    ms_per_step: float
+    train_metrics: Dict[str, float]
+    epoch_metrics: List[Dict[str, float]] = field(default_factory=list)
+
+
+def softmax_cross_entropy_loss(logits, y):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def mse_loss(preds, y):
+    return jnp.mean(jnp.square(preds - y))
+
+
+_LOSSES = {
+    "softmax_cross_entropy": softmax_cross_entropy_loss,
+    "mse": mse_loss,
+}
+
+
+def _accuracy(logits, y):
+    return jnp.mean(jnp.argmax(logits, axis=-1) == y)
+
+
+def _top5_accuracy(logits, y):
+    k = min(5, logits.shape[-1])
+    _, top = jax.lax.top_k(logits, k)
+    return jnp.mean(jnp.any(top == y[..., None], axis=-1))
+
+
+def _mse_metric(preds, y):
+    return jnp.mean(jnp.square(preds.squeeze() - y))
+
+
+def _mae_metric(preds, y):
+    return jnp.mean(jnp.abs(preds.squeeze() - y))
+
+
+# Evaluation metric registry: arbitrary per-task metric lists, matching the
+# reference's free-form metric names (metis.proto:162-169) but typed and
+# jit-compiled. Each metric maps (model outputs, labels) → scalar.
+METRICS: Dict[str, Callable] = {
+    "accuracy": _accuracy,
+    "top5_accuracy": _top5_accuracy,
+    "mse": _mse_metric,
+    "mae": _mae_metric,
+}
+
+
+def register_metric(name: str, fn: Callable) -> None:
+    """Register a custom eval metric ``fn(outputs, labels) -> scalar``."""
+    METRICS[name] = fn
+
+
+class FlaxModelOps:
+    """Train/eval engine around one Flax module instance.
+
+    ``module.apply`` convention: zoo modules accept an optional ``train``
+    kwarg (dropout/batchnorm mode); plain modules without it work too.
+    """
+
+    def __init__(
+        self,
+        module,
+        sample_input: np.ndarray,
+        loss: str | Callable = "softmax_cross_entropy",
+        rng_seed: int = 0,
+        variables: Optional[Pytree] = None,
+        mesh=None,
+        partition_rules=None,
+        trainable_regex: str = "",
+    ):
+        """``mesh`` + ``partition_rules`` enable in-learner sharded training
+        (TP/FSDP via pjit — the Llama-LoRA ladder config; SURVEY.md §2.3):
+        params are placed per the rules, batches are sharded over the data
+        axes, and XLA inserts the collectives. ``trainable_regex`` freezes
+        every param NOT matching it (LoRA fine-tuning: ``"lora_"``)."""
+        self.module = module
+        self._loss_name = loss if isinstance(loss, str) else getattr(loss, "__name__", "custom")
+        self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self.mesh = mesh
+        self.partition_rules = list(partition_rules or [])
+        self._trainable_regex = trainable_regex
+        if variables is not None:
+            self.variables = variables
+        else:
+            init_kwargs = {}
+            if self._accepts_train_kwarg():
+                init_kwargs["train"] = False
+            self.variables = module.init(
+                {"params": self._rng, "dropout": jax.random.fold_in(self._rng, 1)},
+                jnp.asarray(sample_input), **init_kwargs)
+        self._has_batch_stats = "batch_stats" in self.variables
+        if self.mesh is not None:
+            self.variables = self._shard(self.variables)
+        self._step_cache: Dict[tuple, Callable] = {}
+        self._eval_cache: Dict[Tuple[str, ...], Callable] = {}
+
+    # -- sharded placement -------------------------------------------------
+    def _shard(self, variables: Pytree) -> Pytree:
+        from metisfl_tpu.parallel.sharding import tree_shardings
+        shardings = tree_shardings(variables, self.mesh, self.partition_rules)
+        # device_put handles host numpy directly, transferring each device
+        # only its shard — no full-model staging on one device first
+        return jax.device_put(variables, shardings)
+
+    def _data_axis_size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in ("dp", "fsdp")
+                            if a in self.mesh.shape]))
+
+    def _shard_batch(self, arr):
+        """Shard the leading (batch) dimension over the mesh's data axes."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        data_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.shape)
+        n = self._data_axis_size()
+        if n > 1 and arr.shape[0] % n:
+            raise ValueError(
+                f"batch of {arr.shape[0]} examples is not divisible by the "
+                f"mesh data axes {data_axes} (size {n}); pick a batch_size "
+                f"that is a multiple of {n} and shards with >= batch_size "
+                "examples")
+        spec = PartitionSpec(data_axes if data_axes else None)
+        return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
+
+    # -- module introspection ---------------------------------------------
+    def _accepts_train_kwarg(self) -> bool:
+        try:
+            sig = inspect.signature(self.module.__call__)
+            return "train" in sig.parameters
+        except (TypeError, ValueError):  # pragma: no cover
+            return False
+
+    def _apply(self, variables, x, train: bool, rngs=None,
+               collect_intermediates: bool = False):
+        kwargs = {}
+        if self._accepts_train_kwarg():
+            kwargs["train"] = train
+        mutable = []
+        if train and self._has_batch_stats:
+            mutable.append("batch_stats")
+        if collect_intermediates:
+            # sown auxiliary losses (e.g. the MoE router's load-balance term)
+            mutable.append("intermediates")
+        return self.module.apply(variables, x, rngs=rngs,
+                                 mutable=mutable or False, **kwargs)
+
+    # -- weights I/O -------------------------------------------------------
+    def get_variables(self) -> Pytree:
+        return jax.device_get(self.variables)
+
+    def set_variables(self, variables: Pytree) -> None:
+        if self.mesh is not None:
+            self.variables = self._shard(variables)
+        else:
+            self.variables = jax.tree.map(jnp.asarray, variables)
+
+    # -- training ----------------------------------------------------------
+    def _make_step(self, params_cfg: TrainParams):
+        key = (
+            params_cfg.optimizer,
+            float(params_cfg.learning_rate),
+            tuple(sorted((params_cfg.optimizer_kwargs or {}).items())),
+            float(params_cfg.proximal_mu),
+            float(params_cfg.moe_aux_weight),
+            self._loss_name,
+        )
+        if key in self._step_cache:
+            return self._step_cache[key]
+
+        tx = make_optimizer(params_cfg.optimizer, params_cfg.learning_rate,
+                            params_cfg.optimizer_kwargs)
+        if self._trainable_regex:
+            import re as _re
+
+            from metisfl_tpu.tensor.pytree import _key_to_name
+
+            regex = self._trainable_regex
+
+            def _labels(params):
+                flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+                labels = ["train" if _re.search(regex, _key_to_name(p))
+                          else "freeze" for p, _ in flat]
+                if "train" not in labels:
+                    raise ValueError(
+                        f"trainable_regex {regex!r} matches no params — "
+                        "training would silently be a no-op (did you forget "
+                        "lora_rank > 0?)")
+                return jax.tree_util.tree_unflatten(treedef, labels)
+
+            # multi_transform + set_to_zero actually freezes; optax.masked
+            # would pass the raw gradients through for unmasked leaves
+            tx = optax.multi_transform(
+                {"train": tx, "freeze": optax.set_to_zero()}, _labels)
+        mu = float(params_cfg.proximal_mu)
+        has_bs = self._has_batch_stats
+        loss_fn = self.loss_fn
+
+        aux_weight = float(params_cfg.moe_aux_weight)
+
+        def loss_and_aux(params, batch_stats, global_params, x, y, rng):
+            variables = {"params": params}
+            if has_bs:
+                variables["batch_stats"] = batch_stats
+            logits, mutated = self._apply(variables, x, train=True,
+                                          rngs={"dropout": rng},
+                                          collect_intermediates=True)
+            new_bs = mutated.get("batch_stats", batch_stats)
+            loss = loss_fn(logits, y)
+            # sown auxiliary losses enter the objective (Switch MoE
+            # load-balancing — without this term the router can collapse
+            # onto one expert and capacity-drop most tokens)
+            if aux_weight > 0.0:
+                aux_terms = [
+                    leaf for path, leaf in
+                    jax.tree_util.tree_flatten_with_path(
+                        mutated.get("intermediates", {}))[0]
+                    if "aux_loss" in jax.tree_util.keystr(path)
+                ]
+                if aux_terms:
+                    loss = loss + aux_weight * sum(aux_terms)
+            if mu > 0.0:
+                prox = sum(
+                    jnp.sum(jnp.square(p - p0))
+                    for p, p0 in zip(jax.tree.leaves(params),
+                                     jax.tree.leaves(global_params))
+                )
+                loss = loss + 0.5 * mu * prox
+            return loss, (logits, new_bs)
+
+        def step(params, batch_stats, opt_state, global_params, x, y, rng):
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                loss_and_aux, has_aux=True)(params, batch_stats, global_params,
+                                            x, y, rng)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            acc = _accuracy(logits, y)
+            return params, new_bs, opt_state, loss, acc
+
+        compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._step_cache[key] = (compiled, tx)
+        return self._step_cache[key]
+
+    def train(self, dataset: ArrayDataset, params_cfg: TrainParams,
+              cancel_event=None) -> TrainOutput:
+        steps_per_epoch = max(1, len(dataset) // max(1, params_cfg.batch_size))
+        if params_cfg.local_steps > 0:
+            total_steps = params_cfg.local_steps
+        else:
+            total_steps = max(1, int(math.ceil(
+                params_cfg.local_epochs * steps_per_epoch)))
+
+        compiled, tx = self._make_step(params_cfg)
+        params = self.variables["params"]
+        batch_stats = self.variables.get("batch_stats", {})
+        # FedProx anchors to a non-donated copy of the round-start params;
+        # without FedProx an empty tree avoids aliasing the donated params.
+        global_params = (jax.tree.map(jnp.copy, params)
+                         if params_cfg.proximal_mu > 0 else {})
+        opt_state = tx.init(params)
+
+        losses: List[float] = []
+        accs: List[float] = []
+        epoch_metrics: List[Dict[str, float]] = []
+        epoch_losses: List[Any] = []
+        step_times: List[float] = []
+        completed = 0
+        rng = self._rng
+
+        place = self._shard_batch if self.mesh is not None else jnp.asarray
+        stream = dataset.infinite_batches(params_cfg.batch_size)
+        # jax.profiler trace of steady-state steps (SURVEY.md §5.1): start
+        # AFTER the compile step so the trace shows the hot loop, not tracing
+        profile_from = 1 if total_steps > 1 else 0
+        profile_until = profile_from + max(1, params_cfg.profile_steps)
+        profiling = False
+        for step_idx in range(total_steps):
+            if cancel_event is not None and cancel_event.is_set():
+                break
+            if (params_cfg.profile_dir and not profiling
+                    and step_idx == profile_from):
+                jax.profiler.start_trace(params_cfg.profile_dir)
+                profiling = True
+            x, y = next(stream)
+            rng = jax.random.fold_in(rng, step_idx)
+            t0 = time.perf_counter()
+            params, batch_stats, opt_state, loss, acc = compiled(
+                params, batch_stats, opt_state, global_params,
+                place(x), place(y), rng)
+            if step_idx > 0 or total_steps == 1:
+                # skip the compile step for steady-state timing
+                jax.block_until_ready(loss)
+                step_times.append(time.perf_counter() - t0)
+            if profiling and step_idx + 1 >= profile_until:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                profiling = False
+            completed += 1
+            epoch_losses.append((loss, acc))
+            if (step_idx + 1) % steps_per_epoch == 0 or step_idx == total_steps - 1:
+                ls = [float(l) for l, _ in epoch_losses]
+                as_ = [float(a) for _, a in epoch_losses]
+                epoch_metrics.append({"loss": float(np.mean(ls)),
+                                      "accuracy": float(np.mean(as_))})
+                losses.extend(ls)
+                accs.extend(as_)
+                epoch_losses = []
+
+        if profiling:
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+
+        if epoch_losses:
+            losses.extend(float(l) for l, _ in epoch_losses)
+            accs.extend(float(a) for _, a in epoch_losses)
+
+        new_vars = {"params": params}
+        if self._has_batch_stats:
+            new_vars["batch_stats"] = batch_stats
+        self.variables = new_vars
+        self._rng = rng
+
+        ms_per_step = float(np.median(step_times) * 1e3) if step_times else 0.0
+        return TrainOutput(
+            variables=self.get_variables(),
+            completed_steps=completed,
+            completed_batches=completed,
+            completed_epochs=completed / steps_per_epoch,
+            ms_per_step=ms_per_step,
+            train_metrics={
+                "loss": float(np.mean(losses)) if losses else float("nan"),
+                "accuracy": float(np.mean(accs)) if accs else float("nan"),
+            },
+            epoch_metrics=epoch_metrics,
+        )
+
+    # -- inference ---------------------------------------------------------
+    def infer(self, x: np.ndarray, batch_size: int = 256,
+              variables: Optional[Pytree] = None) -> np.ndarray:
+        """Batched forward pass → stacked model outputs (logits/predictions).
+
+        The reference's third ModelOps task type (model_ops.py ``infer``,
+        learner.py:311-330); here one cached jit forward reused across calls.
+        Passing ``variables`` runs inference on an explicit model without
+        touching the engine's training slot.
+        """
+        if not hasattr(self, "_infer_compiled"):
+            self._infer_compiled = jax.jit(
+                lambda v, xb: self._apply(v, xb, train=False))
+        if variables is None:
+            variables = self.variables
+        elif self.mesh is not None:
+            variables = self._shard(variables)
+        else:
+            variables = jax.tree.map(jnp.asarray, variables)
+        outs = []
+        for start in range(0, len(x), batch_size):
+            batch = jnp.asarray(x[start : start + batch_size])
+            outs.append(np.asarray(self._infer_compiled(variables, batch)))
+        if not outs:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(outs, axis=0)
+
+    # -- evaluation --------------------------------------------------------
+    def _make_eval(self, metric_names: Tuple[str, ...]):
+        cached = self._eval_cache.get(metric_names)
+        if cached is not None:
+            return cached
+        loss_fn = self.loss_fn
+        unknown = [m for m in metric_names if m not in METRICS]
+        if unknown:
+            raise ValueError(
+                f"unknown eval metrics {unknown}; registered: {sorted(METRICS)}"
+                " (add custom ones via metisfl_tpu.models.ops.register_metric)")
+        fns = [(name, METRICS[name]) for name in metric_names]
+
+        def eval_step(variables, x, y):
+            logits = self._apply(variables, x, train=False)
+            vals = {"loss": loss_fn(logits, y)}
+            for name, fn in fns:
+                vals[name] = fn(logits, y)
+            return vals
+
+        compiled = jax.jit(eval_step)
+        self._eval_cache[metric_names] = compiled
+        return compiled
+
+    def evaluate(self, dataset: ArrayDataset, batch_size: int = 256,
+                 metrics: Optional[List[str]] = None,
+                 variables: Optional[Pytree] = None) -> Dict[str, float]:
+        """Evaluate ``variables`` (default: the engine's current model).
+
+        ``metrics`` selects from the METRICS registry (loss is always
+        reported; unregistered names are skipped with a warning, matching the
+        reference's tolerance of free-form metric lists, metis.proto:162-169
+        — eval runs on fire-and-forget threads, so raising here would make
+        evaluations silently vanish). Passing variables explicitly lets an
+        eval run concurrently with training without racing on the engine's
+        model slot.
+        """
+        requested = [m for m in (metrics or ["accuracy"]) if m != "loss"]
+        unknown = [m for m in requested if m not in METRICS]
+        if unknown:
+            logger.warning("skipping unregistered eval metrics %s "
+                           "(registered: %s)", unknown, sorted(METRICS))
+        names = tuple(m for m in requested if m in METRICS)
+        eval_step = self._make_eval(names)
+        if variables is None:
+            variables = self.variables
+        elif self.mesh is not None:
+            # keep eval on the same sharded layout as training (an
+            # unsharded placement would stage the full model on one device)
+            variables = self._shard(variables)
+        else:
+            variables = jax.tree.map(jnp.asarray, variables)
+        totals = {name: 0.0 for name in ("loss",) + names}
+        count = 0
+        for x, y in dataset.batches(batch_size, shuffle=False):
+            n = x.shape[0]
+            vals = eval_step(variables, jnp.asarray(x), jnp.asarray(y))
+            for name, v in vals.items():
+                totals[name] += float(v) * n
+            count += n
+        if count == 0:
+            return {}
+        return {name: total / count for name, total in totals.items()}

@@ -1,0 +1,68 @@
+"""Optimizer construction + FedProx.
+
+``make_optimizer`` replaces the reference's per-engine optimizer plumbing
+(reference keras_model_ops.py:245-283 ``construct_optimizer``); FedProx is
+the reference's custom Keras optimizer (keras/optimizers/fed_prox.py:10-103)
+re-expressed as an optax gradient transformation: ``g ← g + μ·(w − w_global)``
+applied before the base optimizer, which is the same proximal update without
+a bespoke optimizer class.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import optax
+
+
+def fedprox(mu: float, global_params) -> optax.GradientTransformation:
+    """Proximal-term gradient transform: pulls weights toward the community
+    model shipped at round start (``vstar`` in the reference)."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fedprox requires params to be passed to update")
+        updates = jax.tree.map(
+            lambda g, p, p0: g + mu * (p - p0), updates, params, global_params
+        )
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+_OPTIMIZERS = {
+    "sgd": lambda lr, kw: optax.sgd(lr, momentum=kw.get("momentum", 0.0),
+                                    nesterov=kw.get("nesterov", False)),
+    "adam": lambda lr, kw: optax.adam(lr, b1=kw.get("b1", 0.9),
+                                      b2=kw.get("b2", 0.999),
+                                      eps=kw.get("eps", 1e-8)),
+    "adamw": lambda lr, kw: optax.adamw(lr, b1=kw.get("b1", 0.9),
+                                        b2=kw.get("b2", 0.999),
+                                        weight_decay=kw.get("weight_decay", 1e-4)),
+    "rmsprop": lambda lr, kw: optax.rmsprop(lr, decay=kw.get("decay", 0.9),
+                                            momentum=kw.get("momentum", 0.0)),
+    "adagrad": lambda lr, kw: optax.adagrad(lr),
+}
+
+
+def make_optimizer(name: str, learning_rate: float,
+                   optimizer_kwargs: Optional[Dict[str, Any]] = None,
+                   proximal_mu: float = 0.0,
+                   global_params=None) -> optax.GradientTransformation:
+    kw = optimizer_kwargs or {}
+    try:
+        base = _OPTIMIZERS[name.lower()](learning_rate, kw)
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {name!r}; have {sorted(_OPTIMIZERS)}"
+        ) from None
+    if proximal_mu > 0.0:
+        if global_params is None:
+            raise ValueError("fedprox (proximal_mu > 0) needs global_params")
+        return optax.chain(fedprox(proximal_mu, global_params), base)
+    return base

@@ -1,0 +1,71 @@
+"""Small CNNs (reference examples/keras/models/fashion_mnist_cnn.py,
+cifar10_cnn.py): the minimum end-to-end federation workloads."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FashionMnistCNN(nn.Module):
+    """2-conv CNN for 28×28×1 inputs — the reference's flagship example
+    (examples/keras/fashionmnist.py)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class Cifar10CNN(nn.Module):
+    """3-block VGG-style CNN for 32×32×3 inputs."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for width in (32, 64, 128):
+            x = nn.relu(nn.Conv(width, (3, 3))(x))
+            x = nn.relu(nn.Conv(width, (3, 3))(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class BrainAge3DCNN(nn.Module):
+    """Volumetric 3D-CNN regressor — the reference's neuroimaging workload
+    family (reference examples/keras/models/brainage_cnns.py: stacked
+    Conv3D/MaxPool3D blocks regressing age from MRI volumes), scaled by
+    ``widths`` (the reference ships 5-block variants; the default here is a
+    CI-sized 3-block model — same topology, smaller volumes).
+
+    Input: (B, D, H, W) or (B, D, H, W, 1) float volumes. Output: (B,)
+    regression values (train with ``FlaxModelOps(..., loss="mse")``; the
+    squeezed shape matches the (B,)-shaped labels — a (B, 1) output would
+    broadcast against them inside the mse loss).
+    """
+
+    widths: tuple = (8, 16, 32)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 4:
+            x = x[..., None]
+        for width in self.widths:
+            x = nn.relu(nn.Conv(width, (3, 3, 3))(x))
+            x = nn.max_pool(x, (2, 2, 2), strides=(2, 2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(1)(x)[..., 0]

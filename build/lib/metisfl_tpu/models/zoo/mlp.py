@@ -1,0 +1,37 @@
+"""MLPs (reference examples/pytorch/models/mlp.py:18-87,
+examples/keras/models/housing_mlp.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Plain classifier/regressor MLP with configurable hidden widths."""
+
+    features: Sequence[int] = (64, 64)
+    num_outputs: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for width in self.features:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(self.num_outputs)(x)
+
+
+class HousingMLP(nn.Module):
+    """Regression MLP (scalar output), used by the scalability harness
+    (reference examples/keras/scalability_testing.py parameterizes layer
+    sizes the same way)."""
+
+    features: Sequence[int] = (32, 32)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for width in self.features:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(1)(x)[..., 0]

@@ -1,0 +1,59 @@
+"""ResNet-20 for CIFAR-scale inputs (BASELINE.md ladder config #2;
+the reference's zoo tops out at a VGG-style CIFAR CNN,
+reference examples/keras/models/cifar10_vgg.py — ResNet-20 is the standard
+federated CIFAR workload this rebuild adds).
+
+BatchNorm state lives in ``batch_stats`` and is part of the federated model:
+it ships and aggregates with the weights (FlaxModelOps handles the mutable
+collection).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    width: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9)
+        residual = x
+        y = nn.Conv(self.width, (3, 3), strides=(self.strides,) * 2,
+                    use_bias=False)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.width, (3, 3), use_bias=False)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.width, (1, 1),
+                               strides=(self.strides,) * 2,
+                               use_bias=False)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet20(nn.Module):
+    """3 stages × 3 basic blocks (He et al. CIFAR variant), ~0.27M params."""
+
+    num_classes: int = 10
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9)
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        x = nn.relu(norm()(x))
+        for stage, width in enumerate((self.width, 2 * self.width,
+                                       4 * self.width)):
+            for block in range(3):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(width, strides)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,29 @@
+"""Recurrent text classifier (reference examples/keras/models/imdb_lstm.py:
+embedding → LSTM → dense head, the reference zoo's largest text workload).
+
+TPU note: the recurrence is a ``lax.scan`` over the sequence (flax
+``nn.RNN`` + ``OptimizedLSTMCell``) — static shapes, one compiled step
+reused per position. Transformers (zoo/transformer.py) are the TPU-native
+choice for new text configs; this exists for reference-workload parity.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class LSTMClassifier(nn.Module):
+    """Embedding + single-layer LSTM + dense head on the final hidden
+    state."""
+
+    vocab_size: int = 8192
+    num_classes: int = 2
+    embed_dim: int = 64
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embed")(tokens)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden), name="lstm")(x)
+        # final hidden state carries the sequence summary
+        return nn.Dense(self.num_classes, name="head")(x[:, -1, :])

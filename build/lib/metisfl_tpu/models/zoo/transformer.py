@@ -1,0 +1,416 @@
+"""Transformer family: ViT-lite, BERT-lite, Llama-lite (+LoRA).
+
+The BASELINE.md scale ladder (ViT-B/16 semi-sync, BERT async + secure,
+Llama-3-8B-LoRA with in-learner sharding) needs transformer workloads the
+reference never had (its zoo tops out at an IMDB LSTM,
+reference examples/keras/models/imdb_lstm.py). Designed TPU-first:
+
+- attention projections are single 2D matmuls (MXU-friendly, and the TP
+  partition rules in :data:`TRANSFORMER_RULES` shard them over ``tp``:
+  column-parallel qkv/gate/up, row-parallel out/down — XLA inserts the
+  all-reduce over ICI);
+- static shapes everywhere; causal masking via a static bool mask;
+- LoRA adapters (:class:`LoRADense`) add low-rank deltas whose params match
+  ``lora_`` so an optimizer mask can freeze the base model
+  (``FlaxModelOps(trainable_regex="lora_")``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# TP partition rules (first match wins; see parallel/sharding.py).
+# Megatron-style: column-parallel into the head/hidden dimension,
+# row-parallel back out, embeddings sharded over vocab rows. LoRA wraps the
+# base kernel under ``<name>/base/kernel``, hence the optional segment.
+# MoE expert stacks shard their leading expert axis over ``ep`` (expert
+# parallelism) and their hidden axis over ``tp`` — XLA inserts the
+# dispatch/combine all-to-alls between token- and expert-sharded layouts.
+TRANSFORMER_RULES = [
+    (r"experts_w1", P("ep", None, "tp")),
+    (r"experts_w2", P("ep", "tp", None)),
+    (r"(wq|wk|wv|gate|up|fc1)(/base)?/kernel", P(None, "tp")),
+    (r"(wo|down|fc2)(/base)?/kernel", P("tp", None)),
+    (r"lora_b", P(None, "tp")),
+    (r"embed/embedding", P("tp", None)),
+    (r"lm_head/kernel", P(None, "tp")),
+]
+
+
+class LoRADense(nn.Module):
+    """Dense with an optional low-rank adapter: y = xW + scale·(xA)B.
+
+    ``lora_a``/``lora_b`` params match the ``lora_`` trainable-mask regex;
+    the base kernel stays frozen under LoRA fine-tuning."""
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    use_bias: bool = True
+    # computation dtype (mixed precision: fp32 params, e.g. bf16 compute —
+    # the MXU-native mode); None keeps full fp32
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, use_bias=self.use_bias,
+                     dtype=self.dtype, name="base")(x)
+        if self.rank > 0:
+            a = self.param("lora_a", nn.initializers.normal(0.02),
+                           (x.shape[-1], self.rank))
+            b = self.param("lora_b", nn.initializers.zeros,
+                           (self.rank, self.features))
+            if self.dtype is not None:
+                a, b = a.astype(self.dtype), b.astype(self.dtype)
+            y = y + (x @ a) @ b * (self.alpha / self.rank)
+        return y
+
+
+def _rotary(x, positions):
+    """Rotary position embedding over the last (head) dimension."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (10000 ** (np.arange(0, half) / half))
+    angles = positions[..., None] * freqs  # (..., L, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1)
+
+
+class Attention(nn.Module):
+    """Multi-head attention with 2D projection kernels (TP-shardable).
+
+    ``sp_mesh`` switches the score/softmax/value stage to ring attention
+    over the mesh's ``sp`` axis (sequence parallelism — exact attention
+    with O(L/sp) per-device memory; see parallel/ringattn.py). Rotary runs
+    on the logically-global arrays before the shard_map island, so
+    positions stay global. Attention-weight dropout is a no-op on the ring
+    path (the (L, L) matrix never exists to drop from).
+    """
+
+    dim: int
+    heads: int
+    causal: bool = False
+    rotary: bool = False
+    dropout: float = 0.0
+    lora_rank: int = 0
+    sp_mesh: object = None
+    sp_axis: str = "sp"
+    use_flash: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, L, _ = x.shape
+        head_dim = self.dim // self.heads
+        if self.dropout > 0.0 and (self.use_flash or self.sp_mesh is not None):
+            # neither kernelized path materializes the (L, L) weight matrix,
+            # so attention-weight dropout cannot be applied there
+            raise ValueError(
+                "attention dropout > 0 is only supported on the dense "
+                "attention path; set dropout=0 or disable use_flash/sp_mesh")
+
+        def proj(name, rank=0):
+            return LoRADense(self.dim, rank=rank, use_bias=False,
+                             dtype=self.dtype, name=name)
+
+        # LoRA on q/v only (standard practice)
+        q = proj("wq", self.lora_rank)(x)
+        k = proj("wk")(x)
+        v = proj("wv", self.lora_rank)(x)
+        q = q.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        if self.rotary:
+            positions = jnp.arange(L, dtype=jnp.float32)
+            dt = q.dtype
+            q = _rotary(q, positions).astype(dt)
+            k = _rotary(k, positions).astype(dt)
+        if self.sp_mesh is not None:
+            from metisfl_tpu.parallel.ringattn import make_ring_attention
+            out = make_ring_attention(self.sp_mesh, self.sp_axis,
+                                      causal=self.causal)(q, k, v)
+        elif self.use_flash:
+            from metisfl_tpu.ops import flash_attention
+            out = flash_attention(q, k, v, self.causal)
+        else:
+            # softmax in fp32 regardless of compute dtype (bf16 exp/normalize
+            # loses too much precision), then back to the compute dtype so
+            # the PV matmul stays on the MXU's native path
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) * float(1.0 / np.sqrt(head_dim))
+            if self.causal:
+                mask = jnp.tril(jnp.ones((L, L), bool))
+                scores = jnp.where(mask, scores,
+                                   jnp.finfo(scores.dtype).min)
+            weights = nn.softmax(scores, axis=-1).astype(v.dtype)
+            weights = nn.Dropout(self.dropout,
+                                 deterministic=not train)(weights)
+            out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, self.dim)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                        name="wo")(out)
+
+
+class SwiGLU(nn.Module):
+    """Llama-style gated MLP (gate/up column-parallel, down row-parallel)."""
+
+    dim: int
+    hidden: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        gate = nn.Dense(self.hidden, use_bias=False, dtype=self.dtype,
+                        name="gate")(x)
+        up = nn.Dense(self.hidden, use_bias=False, dtype=self.dtype,
+                      name="up")(x)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                        name="down")(nn.silu(gate) * up)
+
+
+class MoEMLP(nn.Module):
+    """Switch-style top-1 mixture-of-experts FFN (expert parallelism).
+
+    Expert weights are stacked on a leading expert axis (``experts_w1`` /
+    ``experts_w2``) that :data:`TRANSFORMER_RULES` shards over ``ep``.
+    Dispatch and combine are one-hot einsums over a fixed per-expert
+    capacity — static shapes, MXU-shaped (E, C, D) @ (E, D, H) batched
+    matmuls, and when token shardings (dp) and expert shardings (ep) differ
+    XLA inserts the all-to-alls over ICI. Routing follows the Switch
+    transformer: top-1 expert, tokens beyond an expert's capacity are
+    dropped (residual connections carry them through), and the standard
+    load-balance auxiliary loss is sown under
+    ``intermediates/moe_aux_loss``.
+    """
+
+    dim: int
+    hidden: int
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, D = x.shape
+        T = B * L
+        E = self.num_experts
+        tokens = x.reshape(T, D)
+        # routing in fp32: tiny matmul, precision-sensitive softmax
+        logits = nn.Dense(E, use_bias=False, name="router")(
+            tokens.astype(jnp.float32))
+        probs = nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)                  # (T,)
+        gate = jnp.max(probs, axis=-1)                           # (T,)
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (T, E)
+
+        # load-balance aux loss (Switch eq. 4): E * Σ_e fraction_e * prob_e
+        density = onehot.mean(axis=0)
+        router_prob = probs.mean(axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 E * jnp.sum(density * router_prob))
+
+        capacity = int(np.ceil(T / E * self.capacity_factor))
+        # position of each token within its expert's capacity buffer
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (T, E)
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        pos_cap = jax.nn.one_hot(
+            (pos * keep).sum(-1).astype(jnp.int32), capacity,
+            dtype=jnp.float32)                                   # (T, C)
+        dispatch = keep[:, :, None] * pos_cap[:, None, :]        # (T, E, C)
+
+        dt = self.dtype or tokens.dtype
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt),
+                               tokens.astype(dt))                # (E, C, D)
+        w1 = self.param("experts_w1",
+                        nn.initializers.normal(1.0 / np.sqrt(D)),
+                        (E, D, self.hidden))
+        w2 = self.param("experts_w2",
+                        nn.initializers.normal(1.0 / np.sqrt(self.hidden)),
+                        (E, self.hidden, D))
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1.astype(dt)))
+        out = jnp.einsum("ech,ehd->ecd", h, w2.astype(dt))       # (E, C, D)
+        combine = dispatch * gate[:, None, None]                 # (T, E, C)
+        mixed = jnp.einsum("tec,ecd->td", combine.astype(dt), out)
+        return mixed.reshape(B, L, D)
+
+
+class GeluMLP(nn.Module):
+    dim: int
+    hidden: int
+    dropout: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.gelu(nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.dim, dtype=self.dtype, name="fc2")(x)
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN encoder block (ViT/BERT style)."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    use_flash: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x + Attention(self.dim, self.heads, dropout=self.dropout,
+                          use_flash=self.use_flash, dtype=self.dtype,
+                          name="attn")(
+            nn.LayerNorm(dtype=self.dtype)(x), train=train)
+        x = x + GeluMLP(self.dim, self.mlp_ratio * self.dim, self.dropout,
+                        dtype=self.dtype, name="mlp")(
+            nn.LayerNorm(dtype=self.dtype)(x), train=train)
+        return x
+
+
+class DecoderBlock(nn.Module):
+    """Pre-RMSNorm causal block (Llama style) with rotary + SwiGLU."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    lora_rank: int = 0
+    sp_mesh: object = None
+    use_flash: bool = False
+    # > 0 replaces the SwiGLU FFN with a Switch MoE of this many experts
+    moe_experts: int = 0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
+                          lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
+                          use_flash=self.use_flash, dtype=self.dtype,
+                          name="attn")(
+            nn.RMSNorm(dtype=self.dtype)(x), train=train)
+        if self.moe_experts > 0:
+            ffn = MoEMLP(self.dim, self.mlp_ratio * self.dim,
+                         num_experts=self.moe_experts, dtype=self.dtype,
+                         name="moe")
+        else:
+            ffn = SwiGLU(self.dim, self.mlp_ratio * self.dim,
+                         dtype=self.dtype, name="mlp")
+        x = x + ffn(nn.RMSNorm(dtype=self.dtype)(x))
+        return x
+
+
+class ViTLite(nn.Module):
+    """Patch-embedding vision transformer classifier (ViT ladder config;
+    default sizes give a fast CI-scale model — scale dim/depth/heads up for
+    the ViT-B/16 configuration: dim=768, depth=12, heads=12, patch=16)."""
+
+    num_classes: int = 10
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    patch: int = 4
+    dropout: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(self.dim, (self.patch,) * 2, strides=(self.patch,) * 2,
+                    dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(x.shape[0], -1, self.dim)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.dim))
+        x = x + pos.astype(x.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(self.dim, self.heads, dropout=self.dropout,
+                             dtype=self.dtype, name=f"block_{i}")(
+                x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x).mean(axis=1)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+class BertLite(nn.Module):
+    """Bidirectional text-encoder classifier (BERT ladder config)."""
+
+    vocab_size: int = 8192
+    num_classes: int = 2
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    max_len: int = 512
+    dropout: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        L = tokens.shape[1]
+        if L > self.max_len:
+            raise ValueError(f"sequence length {L} exceeds max_len "
+                             f"{self.max_len}")
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.dim))
+        x = x + pos[:, :L].astype(x.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(self.dim, self.heads, dropout=self.dropout,
+                             dtype=self.dtype, name=f"block_{i}")(
+                x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x).mean(axis=1)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+class LlamaLite(nn.Module):
+    """Decoder-only causal LM (RMSNorm + rotary + SwiGLU), the Llama-LoRA
+    ladder shape. ``lora_rank > 0`` adds adapters on q/v; train with
+    ``FlaxModelOps(trainable_regex="lora_")`` to freeze the base."""
+
+    vocab_size: int = 8192
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    lora_rank: int = 0
+    # sequence parallelism: a Mesh with an "sp" axis routes every block's
+    # attention through the ring schedule (long-context configs)
+    sp_mesh: object = None
+    # single-chip pallas flash-attention kernel (ops/flash_attention.py)
+    use_flash: bool = False
+    # expert parallelism: > 0 gives every block a Switch MoE FFN of this
+    # many experts (weights shardable over the mesh's "ep" axis)
+    moe_experts: int = 0
+    # rematerialize each block's activations in the backward pass
+    # (jax.checkpoint): trades ~1/3 more FLOPs for O(depth) less activation
+    # HBM — the lever that fits bigger batches/sequences on one chip
+    remat: bool = False
+    # computation dtype; jnp.bfloat16 is the MXU-native mixed-precision mode
+    # (params stay fp32, activations/matmuls run bf16; loss/logits fp32)
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
+        block_cls = (nn.remat(DecoderBlock, static_argnums=(2,))
+                     if self.remat else DecoderBlock)
+        for i in range(self.depth):
+            x = block_cls(self.dim, self.heads,
+                          lora_rank=self.lora_rank,
+                          sp_mesh=self.sp_mesh,
+                          use_flash=self.use_flash,
+                          moe_experts=self.moe_experts,
+                          dtype=self.dtype,
+                          name=f"block_{i}")(x, train)
+        x = nn.RMSNorm(dtype=self.dtype)(x)
+        # logits in fp32: softmax-cross-entropy over a large vocab is
+        # precision-sensitive, and this final cast is cheap
+        return nn.Dense(self.vocab_size, use_bias=False,
+                        name="lm_head")(x.astype(jnp.float32))

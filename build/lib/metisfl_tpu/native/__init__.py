@@ -1,0 +1,138 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+The reference builds its native layer with Bazel + pybind11
+(reference WORKSPACE:1-120, controller/pybind/controller_pybind.cc:17-50);
+this rebuild compiles small C-ABI shared libraries with ``g++`` on first use
+(pybind11 is not available here — Python binds via ctypes) and caches each
+``.so`` next to its source, keyed by the sha256 of that source (mtimes are
+meaningless after a fresh clone; binaries are never committed). Concurrent
+builders (learner subprocesses) race safely: the compile goes to a unique
+temp file then ``os.replace``s into place atomically.
+
+Libraries:
+- ``ckks.cc``     — coefficient-packed RLWE CKKS (secure aggregation).
+- ``hostfold.cc`` — streaming weighted fold for host-path aggregation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_libs: dict = {}
+
+
+def _src_hash(src: str) -> str:
+    with open(src, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _needs_build(src: str, so: str) -> bool:
+    hash_path = so + ".srchash"
+    if not os.path.exists(so) or not os.path.exists(hash_path):
+        return True
+    try:
+        with open(hash_path) as f:
+            return f.read().strip() != _src_hash(src)
+    except OSError:
+        return True
+
+
+def _build(src: str, so: str) -> None:
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+           "-o", tmp, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so)
+        fd, tmp_hash = tempfile.mkstemp(dir=_DIR)
+        with os.fdopen(fd, "w") as f:
+            f.write(_src_hash(src))
+        os.replace(tmp_hash, so + ".srchash")
+    except subprocess.CalledProcessError as exc:
+        raise RuntimeError(
+            f"native build of {os.path.basename(src)} failed:\n"
+            f"{exc.stderr}") from exc
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load(name: str) -> ctypes.CDLL:
+    """Build (if stale) and dlopen ``<name>.cc`` → ``libmetisfl_<name>.so``.
+    Call with ``_lock`` held."""
+    src = os.path.join(_DIR, f"{name}.cc")
+    so = os.path.join(_DIR, f"libmetisfl_{name}.so")
+    if _needs_build(src, so):
+        _build(src, so)
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        # stale/foreign-platform binary (e.g. copied checkout):
+        # rebuild from source once and retry
+        _build(src, so)
+        return ctypes.CDLL(so)
+
+
+def load_ckks() -> ctypes.CDLL:
+    """The CKKS library with typed signatures."""
+    with _lock:
+        if "ckks" in _libs:
+            return _libs["ckks"]
+        lib = _load("ckks")
+        lib.ckks_n.restype = ctypes.c_long
+        lib.ckks_ciphertext_size.restype = ctypes.c_long
+        lib.ckks_ciphertext_size.argtypes = [ctypes.c_long]
+        lib.ckks_keygen.restype = ctypes.c_int
+        lib.ckks_keygen.argtypes = [ctypes.c_char_p]
+        lib.ckks_open.restype = ctypes.c_void_p
+        lib.ckks_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ckks_close.argtypes = [ctypes.c_void_p]
+        lib.ckks_has_secret.restype = ctypes.c_int
+        lib.ckks_has_secret.argtypes = [ctypes.c_void_p]
+        lib.ckks_encrypt.restype = ctypes.c_long
+        lib.ckks_encrypt.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+        lib.ckks_weighted_sum.restype = ctypes.c_long
+        lib.ckks_weighted_sum.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+        lib.ckks_decrypt.restype = ctypes.c_long
+        lib.ckks_decrypt.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long]
+        lib.ckks_selftest.restype = ctypes.c_int
+        _libs["ckks"] = lib
+        return lib
+
+
+def load_hostfold() -> ctypes.CDLL:
+    """The host-aggregation fold library with typed signatures."""
+    with _lock:
+        if "hostfold" in _libs:
+            return _libs["hostfold"]
+        lib = _load("hostfold")
+        lib.hostfold_f32.restype = None
+        lib.hostfold_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long, ctypes.c_long, ctypes.c_int]
+        lib.hostfold_f64.restype = None
+        lib.hostfold_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long, ctypes.c_long, ctypes.c_int]
+        lib.hostfold_selftest.restype = ctypes.c_int
+        _libs["hostfold"] = lib
+        return lib

@@ -1,0 +1,37 @@
+"""Parallelism: meshes, shardings, collectives, pod-mode federation.
+
+The reference has no device parallelism at all — its only scale axes are
+learner count and aggregation stride (SURVEY.md §2.3). This package is the
+TPU-native upgrade path:
+
+- :mod:`mesh`        — named device meshes (fed/dp/fsdp/tp/sp/ep axes).
+- :mod:`sharding`    — partition rules for param pytrees.
+- :mod:`collectives` — jit-compiled federated averaging as ``psum`` over ICI.
+- :mod:`podfed`      — N learners co-resident on one pod slice: weights never
+  leave the device; the controller reduces to bookkeeping (the BASELINE.json
+  north star).
+- :mod:`pipeline`    — GPipe microbatch schedule over the ``pp`` axis.
+"""
+
+from metisfl_tpu.parallel.mesh import MeshConfig, build_mesh
+from metisfl_tpu.parallel.collectives import federated_mean_psum, make_pod_aggregator
+from metisfl_tpu.parallel.pipeline import (
+    make_pipeline,
+    pipeline_apply,
+    stack_stage_params,
+)
+from metisfl_tpu.parallel.podfed import PodFederation
+from metisfl_tpu.parallel.ringattn import make_ring_attention, ring_attention
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "federated_mean_psum",
+    "make_pod_aggregator",
+    "PodFederation",
+    "ring_attention",
+    "make_ring_attention",
+    "pipeline_apply",
+    "make_pipeline",
+    "stack_stage_params",
+]

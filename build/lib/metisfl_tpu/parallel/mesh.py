@@ -1,0 +1,66 @@
+"""Named device meshes.
+
+Axis vocabulary (used across the framework):
+
+- ``fed``  — federation axis: one index per co-resident learner (pod mode).
+- ``dp``   — data parallel within one learner.
+- ``fsdp`` — fully-sharded data parallel (parameter sharding over the data
+  axis).
+- ``tp``   — tensor (model) parallelism.
+- ``sp``   — sequence/context parallelism (ring attention).
+- ``ep``   — expert parallelism (MoE).
+
+A federation mesh is ``(fed, <inner axes...>)``: learner *i* owns the
+``fed=i`` slice and runs its local training sharded over the inner axes;
+cross-learner aggregation is a ``psum`` over ``fed`` that rides ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    axis_names: Tuple[str, ...] = ("dp",)
+    axis_sizes: Tuple[int, ...] = (0,)   # 0 → absorb remaining devices
+
+    def __post_init__(self):
+        if len(self.axis_names) != len(self.axis_sizes):
+            raise ValueError("axis_names and axis_sizes must have equal rank")
+        if sum(1 for s in self.axis_sizes if s == 0) > 1:
+            raise ValueError("at most one axis size may be 0 (auto)")
+
+    def resolve(self, num_devices: int) -> Tuple[int, ...]:
+        fixed = math.prod(s for s in self.axis_sizes if s > 0)
+        if num_devices % max(1, fixed):
+            raise ValueError(
+                f"{num_devices} devices not divisible by fixed axes {self.axis_sizes}")
+        auto = num_devices // fixed if 0 in self.axis_sizes else None
+        sizes = tuple(auto if s == 0 else s for s in self.axis_sizes)
+        if math.prod(sizes) != num_devices:
+            raise ValueError(
+                f"mesh {dict(zip(self.axis_names, sizes))} does not use all "
+                f"{num_devices} devices")
+        return sizes
+
+
+def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.resolve(len(devices))
+    array = np.asarray(devices).reshape(sizes)
+    return Mesh(array, config.axis_names)
+
+
+def federation_mesh(num_learners: int, inner_axes: Sequence[str] = (),
+                    inner_sizes: Sequence[int] = (),
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh ``(fed=num_learners, *inner)`` over the available devices."""
+    config = MeshConfig(("fed", *inner_axes), (num_learners, *inner_sizes))
+    return build_mesh(config, devices)

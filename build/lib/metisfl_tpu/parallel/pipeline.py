@@ -1,0 +1,122 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); this is the
+TPU-native primitive for models deeper than one device's HBM: stages live on
+consecutive devices along the ``pp`` mesh axis, activations hop stage→stage
+over ICI via ``ppermute``, and ``lax.scan`` drives the microbatch schedule —
+one compiled program, no data-dependent Python control flow. With M
+microbatches over S stages the bubble fraction is (S-1)/(M+S-1), the
+classic GPipe trade.
+
+Design notes (TPU-first):
+- the whole schedule is ONE ``shard_map``ped scan: XLA overlaps each tick's
+  stage compute with the activation ``ppermute`` of the previous tick;
+- stage parameters are stacked on a leading stage axis and sharded over
+  ``pp`` — each device holds exactly its stage's weights;
+- inter-stage activations must share one shape/dtype (the pipeline
+  contract); embed/head asymmetries fold into the first/last stage fns.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metisfl_tpu.parallel.collectives import to_varying
+
+Pytree = Any
+
+
+def stack_stage_params(stage_params: Sequence[Pytree]) -> Pytree:
+    """[per-stage pytree] → one pytree with a leading stage axis (shard it
+    over ``pp``). All stages must share a tree structure and leaf shapes —
+    use equal-width stages (e.g. equal blocks of a transformer)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Pytree, jax.Array], jax.Array],
+    stacked_params: Pytree,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+):
+    """Run ``stage_fn`` S times in pipeline over the ``axis`` mesh axis.
+
+    ``stacked_params``: leading stage axis of size S = mesh.shape[axis].
+    ``x``: (B, ...) global batch, B divisible by ``num_microbatches``.
+    Returns (B, ...) outputs (replicated), equal to applying the stages
+    sequentially: ``stage_fn(p[S-1], ... stage_fn(p[0], x))``.
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked stage axis is {leaf.shape[0]} but the {axis!r} "
+                f"mesh axis has {S} devices — one stage per device (a "
+                "multiple would silently drop stages)")
+        break
+    micro = x.reshape(M, B // M, *x.shape[1:])
+
+    def ranked(params, micro):
+        # per-device view: params carry a leading stage axis of size 1
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        # the microbatch stream arrives replicated (unvarying over pp); the
+        # schedule's carries ARE device-varying — mark everything varying up
+        # front so the scan carry types stay fixed (jax vma semantics)
+        micro = to_varying(micro, (axis,))
+        state0 = jnp.zeros_like(micro[0])
+        out0 = jnp.zeros_like(micro)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 feeds itself from the microbatch stream; later stages
+            # consume the activation ppermuted in on the previous tick
+            feed = micro[jnp.minimum(t, M - 1)]
+            mine = jnp.where(idx == 0, feed, state)
+            out = stage_fn(params, mine)
+            # collect on the last stage once the pipeline is full
+            slot = jnp.clip(t - (S - 1), 0, M - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, out, slot, axis=0)
+            outputs = jnp.where(t >= S - 1, updated, outputs)
+            # hand my activation to the next stage (ring permute; the
+            # wrap-around edge S-1→0 carries garbage that stage 0 ignores)
+            state = jax.lax.ppermute(
+                out, axis, perm=[(i, (i + 1) % S) for i in range(S)])
+            return (state, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; psum broadcasts them
+        outputs = jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis)
+
+    spec_params = jax.tree.map(lambda _: P(axis), stacked_params)
+    fn = jax.shard_map(
+        ranked, mesh=mesh,
+        in_specs=(spec_params, P()),
+        out_specs=P(),
+    )
+    out = fn(stacked_params, micro)
+    return out.reshape(B, *x.shape[1:])
+
+
+def make_pipeline(stage_fn: Callable, mesh: Mesh, num_microbatches: int,
+                  axis: str = "pp") -> Callable:
+    """jit-compiled ``(stacked_params, x) → y`` pipeline executor."""
+    @functools.partial(jax.jit, static_argnums=())
+    def run(stacked_params, x):
+        return pipeline_apply(stage_fn, stacked_params, x, mesh,
+                              num_microbatches, axis)
+    return run

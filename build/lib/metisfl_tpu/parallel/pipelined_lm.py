@@ -1,0 +1,89 @@
+"""Model-level pipeline parallelism: LlamaLite's decoder stack over ``pp``.
+
+Bridges the zoo transformer to :mod:`metisfl_tpu.parallel.pipeline`: the
+depth-D block stack is cut into S equal stages (one per device along the
+``pp`` mesh axis), each stage applying D/S decoder blocks; embedding, final
+norm, and the LM head run replicated outside the pipeline (they are a small
+fraction of the FLOPs — the per-block compute is what doesn't fit one
+device). Stage parameters are the ORIGINAL LlamaLite parameters restacked,
+so a checkpoint trained either way loads into both layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metisfl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+Pytree = Any
+
+
+def split_lm_params(variables: Pytree, num_stages: int) -> Tuple[Pytree, Pytree]:
+    """LlamaLite variables → (non-block params, stage-stacked block params).
+
+    Blocks ``block_i`` are grouped into ``num_stages`` contiguous stages;
+    within a stage the per-block trees are stacked on a second leading axis
+    so one ``stage_fn`` scan applies them in order.
+    """
+    params = variables["params"]
+    block_names = sorted((k for k in params if k.startswith("block_")),
+                        key=lambda k: int(k.split("_")[1]))
+    depth = len(block_names)
+    if depth % num_stages:
+        raise ValueError(f"depth {depth} not divisible by {num_stages} stages")
+    per_stage = depth // num_stages
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    stages = []
+    for s in range(num_stages):
+        blocks = [params[block_names[s * per_stage + j]]
+                  for j in range(per_stage)]
+        stages.append(jax.tree.map(lambda *xs: jnp.stack(xs), *blocks))
+    return rest, stack_stage_params(stages)
+
+
+def pipelined_lm_apply(module, variables: Pytree, tokens, mesh,
+                       num_microbatches: int, axis: str = "pp"):
+    """Forward pass of a zoo ``LlamaLite`` with its block stack pipelined.
+
+    Equals ``module.apply(variables, tokens)`` exactly (same parameters,
+    same math, any compute dtype) — verified in tests — while each device
+    only holds and runs its own stage's blocks. ``sp_mesh`` modules are
+    rejected (pp x sp composition is not implemented).
+    """
+    import flax.linen as nn
+
+    from metisfl_tpu.models.zoo.transformer import DecoderBlock
+
+    if module.sp_mesh is not None:
+        raise ValueError(
+            "pipelined_lm_apply does not support sp_mesh modules: the ring "
+            "schedule's sp axis would be silently dropped (plain full "
+            "attention per block). Pipeline with sp disabled, or use the "
+            "sp path alone (parallel/ringattn.py).")
+    rest, stacked = split_lm_params(variables, mesh.shape[axis])
+    block = DecoderBlock(module.dim, module.heads,
+                         lora_rank=module.lora_rank,
+                         use_flash=module.use_flash,
+                         moe_experts=module.moe_experts,
+                         dtype=module.dtype)
+
+    def stage_fn(stage_params, h):
+        def apply_one(h, block_params):
+            return block.apply({"params": block_params}, h), None
+        h, _ = jax.lax.scan(apply_one, h, stage_params)
+        return h
+
+    embed = rest["embed"]["embedding"]
+    x = jnp.take(embed, tokens, axis=0)
+    if module.dtype is not None:
+        x = x.astype(module.dtype)
+    x = pipeline_apply(stage_fn, stacked, x, mesh, num_microbatches, axis)
+    # final norm + fp32 head, via the SAME flax modules LlamaLite.__call__
+    # uses (re-implementing the math inline would silently drift on any
+    # flax default change)
+    x = nn.RMSNorm(dtype=module.dtype).apply(
+        {"params": rest["RMSNorm_0"]}, x)
+    return x.astype(jnp.float32) @ rest["lm_head"]["kernel"]

@@ -1,0 +1,267 @@
+"""Pod-mode federation: the whole round as one SPMD program.
+
+When all learners co-reside on one TPU pod slice, a federation round —
+N learners × K local optimizer steps, then weighted FedAvg — compiles to a
+SINGLE jit-compiled XLA program shard_mapped over the ``fed`` mesh axis:
+
+- learner *i*'s params/data live on mesh slice ``fed=i``;
+- local training is a ``lax.scan`` of SGD steps (MXU-friendly, no host);
+- aggregation is a weighted ``psum`` over ``fed`` riding ICI;
+- the community model comes out replicated: next round starts immediately.
+
+This is the TPU-native answer to the reference's proto-gRPC weight shipping
+(BASELINE.json north star: ≤2 s aggregation/round @ 64 learners) — the
+controller shrinks to round bookkeeping around one XLA call. An inner ``dp``
+mesh axis composes: each learner's local batch shards over ``dp`` and its
+gradients all-reduce over ``dp`` inside every local step (classic DP within
+the federated round).
+
+Semantics match the host path (`FlaxModelOps.train` + `FedAvg`): fresh
+optimizer state per round (local SGD starts from the community model),
+dropout rngs folded per learner and step, BatchNorm ``batch_stats``
+aggregated with the weights.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.models.ops import _LOSSES, _accuracy
+from metisfl_tpu.models.optimizers import make_optimizer
+from metisfl_tpu.parallel.collectives import to_varying
+from metisfl_tpu.parallel.mesh import federation_mesh
+
+
+class PodFederation:
+    """N co-resident learners on one mesh; rounds are single XLA calls.
+
+    ``mesh`` may carry an inner ``dp`` axis (e.g. ``federation_mesh(4,
+    inner_axes=("dp",), inner_sizes=(2,))``): each learner's batch dimension
+    shards over ``dp`` and gradients all-reduce over it per local step.
+    """
+
+    def __init__(
+        self,
+        module,
+        sample_input: np.ndarray,
+        num_learners: int,
+        train_params: Optional[TrainParams] = None,
+        loss: str | Callable = "softmax_cross_entropy",
+        mesh: Optional[Mesh] = None,
+        rng_seed: int = 0,
+    ):
+        self.module = module
+        self.num_learners = num_learners
+        self.train_params = train_params or TrainParams()
+        self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
+        self.mesh = mesh or federation_mesh(num_learners)
+        if self.mesh.shape["fed"] != num_learners:
+            raise ValueError(
+                f"mesh fed axis {self.mesh.shape['fed']} != {num_learners}")
+        self._has_dp = "dp" in self.mesh.axis_names
+        # x: (L, K, B, ...) — learner axis over fed, batch axis over dp;
+        # single source of truth for both the shard_map in_specs and the
+        # run_round device_put placements
+        self._data_spec = (P("fed", None, "dp") if self._has_dp
+                           else P("fed"))
+        rng = jax.random.PRNGKey(rng_seed)
+        variables = module.init({"params": rng,
+                                 "dropout": jax.random.fold_in(rng, 1)},
+                                jnp.asarray(sample_input))
+        self.params = jax.device_put(
+            variables["params"], NamedSharding(self.mesh, P()))
+        self.batch_stats = jax.device_put(
+            variables["batch_stats"], NamedSharding(self.mesh, P())
+        ) if "batch_stats" in variables else None
+        self._tx = make_optimizer(self.train_params.optimizer,
+                                  self.train_params.learning_rate,
+                                  self.train_params.optimizer_kwargs)
+        self._round_fn = self._build_round()
+        self._eval_fn = None
+        self.global_iteration = 0
+
+    # ------------------------------------------------------------------ #
+
+    def _apply(self, variables, x, train: bool, rngs=None):
+        kwargs = {}
+        try:
+            import inspect
+            if "train" in inspect.signature(self.module.__call__).parameters:
+                kwargs["train"] = train
+        except (TypeError, ValueError):  # pragma: no cover
+            pass
+        mutable = ["batch_stats"] if (train and self.batch_stats is not None) \
+            else False
+        return self.module.apply(variables, x, rngs=rngs, mutable=mutable,
+                                 **kwargs)
+
+    def _build_round(self):
+        tx = self._tx
+        loss_fn = self.loss_fn
+        mesh = self.mesh
+        has_dp = self._has_dp
+        has_bs = self.batch_stats is not None
+
+        def local_train(params, batch_stats, x_steps, y_steps, rng):
+            """K local steps via lax.scan. x_steps: (K, B_local, ...)"""
+            opt_state = tx.init(params)
+
+            def step(carry, batch):
+                params, batch_stats, opt_state, rng = carry
+                x, y = batch
+                rng, dropout_rng = jax.random.split(rng)
+
+                def loss_of(p, bs):
+                    variables = {"params": p}
+                    if has_bs:
+                        variables["batch_stats"] = bs
+                    out = self._apply(variables, x, train=True,
+                                      rngs={"dropout": dropout_rng})
+                    if has_bs:
+                        logits, mutated = out
+                        new_bs = mutated["batch_stats"]
+                    else:
+                        logits, new_bs = out, bs
+                    return loss_fn(logits, y), new_bs
+
+                (loss, new_bs), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, batch_stats)
+                if has_dp:
+                    # true data parallelism inside the learner: the batch is
+                    # sharded over dp, so grads/loss all-reduce over dp
+                    # (batch_stats stay per-replica during the scan, like
+                    # standard DP BatchNorm; they sync at round end)
+                    grads = jax.lax.pmean(grads, "dp")
+                    loss = jax.lax.pmean(loss, "dp")
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, new_bs, opt_state, rng), loss
+
+            (params, batch_stats, _, _), losses = jax.lax.scan(
+                step, (params, batch_stats, opt_state, rng),
+                (x_steps, y_steps))
+            return params, batch_stats, losses
+
+        data_spec = self._data_spec
+        axis_names = tuple(mesh.axis_names)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(), P(), data_spec, data_spec, P("fed"), P("fed")),
+            out_specs=(P(), P(), P("fed")),
+        )
+        def fed_round(community, batch_stats, x, y, scales, seeds):
+            # Cast the replicated community model to device-varying BEFORE
+            # local training: jax.grad w.r.t. an unvarying input inside
+            # shard_map would psum the per-learner gradients across the whole
+            # mesh (see parallel.collectives.to_varying).
+            community = to_varying(community, axis_names)
+            batch_stats = to_varying(batch_stats, axis_names)
+            # this shard sees its own learner's data: leading axis 1
+            rng = jax.random.PRNGKey(seeds[0])
+            trained, new_bs, losses = local_train(
+                community, batch_stats, x[0], y[0], rng)
+            scale = scales[0]
+            community = jax.tree.map(
+                lambda t: jax.lax.psum(t * scale, "fed"), trained)
+            new_bs = jax.tree.map(
+                lambda t: jax.lax.psum(t * scale, "fed"), new_bs)
+            if has_dp:
+                # dp replicas hold identical trained params (grads pmean'd
+                # per step); the pmean is a numeric no-op that reduces the
+                # dp-varying type so the output is replicated
+                community = jax.tree.map(
+                    lambda t: jax.lax.pmean(t, "dp"), community)
+                new_bs = jax.tree.map(
+                    lambda t: jax.lax.pmean(t, "dp"), new_bs)
+            return community, new_bs, losses[None]
+
+        return jax.jit(fed_round, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ #
+
+    def run_round(self, x_batches: np.ndarray, y_batches: np.ndarray,
+                  scales: Optional[np.ndarray] = None
+                  ) -> Dict[str, Any]:
+        """One federation round.
+
+        ``x_batches``: (L, K, B, ...) per-learner K batches; ``scales``:
+        (L,) normalized weights (default uniform).
+        """
+        L = self.num_learners
+        if x_batches.shape[0] != L:
+            raise ValueError(f"expected leading learner axis {L}, "
+                             f"got {x_batches.shape[0]}")
+        if scales is None:
+            scales = np.full((L,), 1.0 / L, np.float32)
+        scales = np.asarray(scales, np.float32)
+        seeds = np.arange(L, dtype=np.uint32) + np.uint32(
+            1 + self.global_iteration * L)
+        x_sharded = jax.device_put(
+            jnp.asarray(x_batches), NamedSharding(self.mesh, self._data_spec))
+        y_sharded = jax.device_put(
+            jnp.asarray(y_batches), NamedSharding(self.mesh, self._data_spec))
+        s_sharded = jax.device_put(
+            jnp.asarray(scales), NamedSharding(self.mesh, P("fed")))
+        seeds_sharded = jax.device_put(
+            jnp.asarray(seeds), NamedSharding(self.mesh, P("fed")))
+        t0 = time.perf_counter()
+        bs = self.batch_stats if self.batch_stats is not None else {}
+        self.params, new_bs, losses = self._round_fn(
+            self.params, bs, x_sharded, y_sharded, s_sharded, seeds_sharded)
+        if self.batch_stats is not None:
+            self.batch_stats = new_bs
+        losses = np.asarray(losses)
+        duration_ms = (time.perf_counter() - t0) * 1e3
+        self.global_iteration += 1
+        return {"per_learner_losses": losses,
+                "mean_loss": float(np.mean(losses)),
+                "round_duration_ms": duration_ms}
+
+    # ------------------------------------------------------------------ #
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray,
+                 batch_size: int = 256) -> Dict[str, float]:
+        """Evaluate the community model (replicated, so this is one jit call
+        per batch on the full mesh)."""
+        if self._eval_fn is None:
+            loss_fn = self.loss_fn
+
+            def eval_step(params, batch_stats, x, y):
+                variables = {"params": params}
+                if self.batch_stats is not None:
+                    variables["batch_stats"] = batch_stats
+                logits = self._apply(variables, x, train=False)
+                return loss_fn(logits, y), _accuracy(logits, y)
+
+            self._eval_fn = jax.jit(eval_step)
+        bs = self.batch_stats if self.batch_stats is not None else {}
+        total_loss = total_acc = count = 0
+        for i in range(0, len(x), batch_size):
+            xb, yb = x[i:i + batch_size], y[i:i + batch_size]
+            loss, acc = self._eval_fn(self.params, bs, jnp.asarray(xb),
+                                      jnp.asarray(yb))
+            total_loss += float(loss) * len(xb)
+            total_acc += float(acc) * len(xb)
+            count += len(xb)
+        if not count:
+            return {}
+        return {"loss": total_loss / count, "accuracy": total_acc / count}
+
+    def community_params(self):
+        return jax.device_get(self.params)
+
+    def community_variables(self):
+        out = {"params": jax.device_get(self.params)}
+        if self.batch_stats is not None:
+            out["batch_stats"] = jax.device_get(self.batch_stats)
+        return out

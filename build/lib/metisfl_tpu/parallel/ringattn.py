@@ -1,0 +1,121 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context training support the reference entirely lacks (SURVEY.md §5.7:
+no sequence-parallel story; its zoo tops out at an LSTM). Design follows
+blockwise ring attention (Liu et al.): the sequence dimension is sharded
+over ``sp``; each device holds one Q chunk and rotates the K/V chunks around
+the ring with ``ppermute`` (one hop per step — the transfer rides ICI and
+overlaps with the local block matmul), accumulating exact softmax statistics
+online (flash-attention style m/l/o carry). The result is mathematically
+EXACT attention over the full sequence with per-device memory O(L/sp) —
+attention never materializes an (L, L) matrix on any chip.
+
+Differentiable: the backward pass flows through ``lax.scan`` + ``ppermute``
+reverse collectives automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """Exact attention over the ring. Call INSIDE ``shard_map``.
+
+    Args: ``q``/``k``/``v`` of shape (B, H, Lc, D) — the LOCAL sequence
+    chunk; the global sequence length is ``Lc * axis_size(sp)`` and chunk
+    ``i`` holds positions ``[i*Lc, (i+1)*Lc)``.
+    """
+    n = _axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, Lc, D = q.shape
+    scale = float(1.0 / np.sqrt(D))  # python float: weak type, no f64 promotion
+    q_pos = my_idx * Lc + jnp.arange(Lc)                    # global q positions
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # after i forward rotations we hold the block produced by (my - i)
+        owner = (my_idx - i) % n
+        k_pos = owner * Lc + jnp.arange(Lc)
+
+        def attend(args):
+            o, m, l = args
+            # scores + online statistics in fp32 regardless of the compute
+            # dtype — bf16 exp/normalize across ring steps compounds; the
+            # score/PV matmuls still run MXU-native on the input dtype
+            s = jax.lax.dot_general(
+                q, k_blk, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]     # (Lc, Lc)
+                s = jnp.where(mask, s, _NEG)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            if causal:
+                # rows whose whole block is masked would otherwise get
+                # exp(NEG - NEG) = 1 contributions
+                p = jnp.where(mask, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + p.sum(axis=-1)
+            o_new = o * corr[..., None] + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk,
+                (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
+            return o_new, m_new, l_new
+
+        if causal:
+            # blocks strictly in the future are entirely masked: skip their
+            # matmuls (halves the causal ring's FLOPs; the K/V rotation
+            # below still runs so the ring stays in step)
+            o, m, l = jax.lax.cond(owner > my_idx,
+                                   lambda args: args, attend, (o, m, l))
+        else:
+            o, m, l = attend((o, m, l))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_next, v_next), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((B, H, Lc), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Lc), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
+                        causal: bool = False):
+    """shard_map-wrapped ring attention over GLOBAL (B, H, L, D) arrays with
+    the L dimension sharded over ``axis_name``. Usable directly under jit —
+    GSPMD handles the surrounding program, the shard_map island runs the
+    ring schedule."""
+    spec = P(None, None, axis_name, None)
+    return jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Plain full attention (the correctness oracle for the ring path)."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * float(1.0 / np.sqrt(D))
+    if causal:
+        L = q.shape[2]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask, s, _NEG)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)

@@ -1,0 +1,77 @@
+"""Partition rules: map param pytrees to ``PartitionSpec``s.
+
+Rule list semantics (t5x/maxtext convention, regex on the '/'-joined param
+path): first match wins; unmatched params replicate. ``fsdp`` sharding is
+applied to the largest axis not already taken by ``tp``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metisfl_tpu.tensor.pytree import _key_to_name
+
+# (regex on param path, PartitionSpec) — first match wins
+Rules = Sequence[Tuple[str, P]]
+
+
+def spec_for(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def tree_partition_specs(tree, rules: Rules):
+    """Pytree of PartitionSpecs matching ``tree``'s structure."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    specs = [spec_for(_key_to_name(p), rules) for p, _ in flat[0]]
+    return jax.tree_util.tree_unflatten(flat[1], specs)
+
+
+def tree_shardings(tree, mesh: Mesh, rules: Rules):
+    """Pytree of NamedShardings. Specs referencing axes absent from the mesh
+    degrade to replication on those axes (so one rule set serves any mesh)."""
+    def _clean(spec: P) -> P:
+        names = set(mesh.axis_names)
+
+        def keep(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(e for e in entry if e in names)
+                return kept if kept else None
+            return entry if entry in names else None
+
+        return P(*(keep(e) for e in spec))
+
+    specs = tree_partition_specs(tree, rules)
+    return jax.tree.map(lambda s: NamedSharding(mesh, _clean(s)),
+                        specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def validate_sharding(tree, mesh: Mesh, rules: Rules) -> list:
+    """Return a list of (path, dim, axis, size, dim_size) violations where a
+    sharded dimension is not divisible by the mesh axes assigned to it."""
+    violations = []
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        name = _key_to_name(path)
+        spec = spec_for(name, rules)
+        shape = np.shape(leaf)
+        for dim, entry in enumerate(spec):
+            if entry is None or dim >= len(shape):
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            size = 1
+            for axis in axes:
+                if axis in mesh.shape:
+                    size *= mesh.shape[axis]
+            if size > 1 and shape[dim] % size:
+                violations.append((name, dim, tuple(axes), size, shape[dim]))
+    return violations

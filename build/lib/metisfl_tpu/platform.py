@@ -36,17 +36,15 @@ def maybe_init_distributed() -> bool:
             f"(got NUM_PROCESSES={os.environ.get('METISFL_JAX_NUM_PROCESSES')!r}, "
             f"PROCESS_ID={os.environ.get('METISFL_JAX_PROCESS_ID')!r})"
         ) from exc
-    if num != 1 or pid != 0:
+    if pid != 0:
         # Every rank must execute the SAME jit programs for the slice's
-        # collectives to rendezvous; a follower-rank task-broadcast loop is
-        # not implemented yet, so a >1-process world cannot work — follower
-        # ranks would either register as spurious learners (hanging the
-        # first collective) or exit and leave rank 0's initialize() blocked
-        # waiting for them. Refuse the whole launch loudly instead.
+        # collectives to rendezvous; the learner's federation client runs
+        # on rank 0 only, and a follower-rank task-broadcast loop is not
+        # implemented yet. Refuse loudly — silently registering follower
+        # ranks as extra learners would hang the first collective.
         raise RuntimeError(
-            "multi-host learner worlds (METISFL_JAX_NUM_PROCESSES > 1) are "
-            "not supported yet — the follower-rank task broadcast is "
-            "unimplemented. Run one single-process learner per host slice.")
+            "multi-host learner follower ranks (METISFL_JAX_PROCESS_ID != 0)"
+            " are not supported yet: run the learner on rank 0 of its slice")
     import jax
 
     jax.distributed.initialize(coordinator_address=coordinator,

@@ -1,0 +1,55 @@
+"""Scaling functions: per-learner contribution weights.
+
+Equivalent of the reference's ``ScalingFunction`` strategies
+(reference metisfl/controller/scaling/batches_scaler.cc:6-48,
+participants_scaler.cc:6-47, train_dataset_size_scaler.cc:6-50). Each maps
+per-learner metadata to normalized weights that the aggregation rules
+consume; weights always sum to 1 over the participating set.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping
+
+# learner_id -> metadata dict with keys: num_train_examples, completed_batches
+Metadata = Mapping[str, Mapping[str, float]]
+
+
+def participants_scaler(metadata: Metadata) -> Dict[str, float]:
+    """Uniform 1/N weights."""
+    n = len(metadata)
+    if n == 0:
+        return {}
+    return {lid: 1.0 / n for lid in metadata}
+
+
+def train_dataset_size_scaler(metadata: Metadata) -> Dict[str, float]:
+    """Weights proportional to each learner's training-set size."""
+    sizes = {lid: float(m.get("num_train_examples", 0)) for lid, m in metadata.items()}
+    total = sum(sizes.values())
+    if total <= 0:
+        return participants_scaler(metadata)
+    return {lid: s / total for lid, s in sizes.items()}
+
+
+def batches_scaler(metadata: Metadata) -> Dict[str, float]:
+    """Weights proportional to completed batches in the last task."""
+    batches = {lid: float(m.get("completed_batches", 0)) for lid, m in metadata.items()}
+    total = sum(batches.values())
+    if total <= 0:
+        return participants_scaler(metadata)
+    return {lid: b / total for lid, b in batches.items()}
+
+
+SCALERS: Dict[str, Callable[[Metadata], Dict[str, float]]] = {
+    "participants": participants_scaler,
+    "train_dataset_size": train_dataset_size_scaler,
+    "batches": batches_scaler,
+}
+
+
+def make_scaler(name: str) -> Callable[[Metadata], Dict[str, float]]:
+    try:
+        return SCALERS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown scaler {name!r}; have {sorted(SCALERS)}") from None

@@ -1,0 +1,168 @@
+"""Round scheduling policies: synchronous, semi-synchronous, asynchronous.
+
+Equivalent of the reference's ``Scheduler`` strategies
+(reference metisfl/controller/scheduling/synchronous_scheduler.h:13-40,
+asynchronous_scheduler.h:12-20) plus the semi-synchronous per-learner step
+recomputation the reference keeps inside the controller
+(controller.cc:520-569). Pure in-memory policy objects — no I/O.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Set
+
+
+class SynchronousScheduler:
+    """Release the round cohort only when every dispatched learner reports.
+
+    The barrier is the set of learners the controller actually dispatched
+    train tasks to this round (``notify_dispatched``) — not all active
+    learners — so participation_ratio < 1 cannot deadlock a round on
+    learners that were never asked to train. When no dispatch was recorded
+    (e.g. the policy object is driven directly in tests) the barrier falls
+    back to all active learners, matching the reference's semantics
+    (synchronous_scheduler.h:13-40).
+    """
+
+    name = "synchronous"
+
+    def __init__(self):
+        self._completed: Set[str] = set()
+        self._dispatched: Set[str] = set()
+
+    def notify_dispatched(self, learner_ids: Sequence[str]) -> None:
+        self._dispatched.update(learner_ids)
+
+    def _barrier(self, active: Sequence[str]) -> List[str]:
+        # Only count learners that are still active (a learner leaving
+        # mid-round must not stall the federation forever).
+        if self._dispatched:
+            return [lid for lid in active if lid in self._dispatched]
+        return list(active)
+
+    def _release(self, active: Sequence[str]) -> List[str]:
+        cohort = [lid for lid in self._barrier(active) if lid in self._completed]
+        self._completed.clear()
+        self._dispatched.clear()
+        return cohort
+
+    def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
+        self._completed.add(learner_id)
+        if any(lid not in self._completed for lid in self._barrier(active)):
+            return []
+        return self._release(active)
+
+    def handle_leave(self, active: Sequence[str]) -> List[str]:
+        """Re-evaluate the barrier after membership shrinks: if the departed
+        learner was the last pending one, release the round now (no later
+        completion event would ever re-check)."""
+        if not self._completed:
+            return []
+        barrier = self._barrier(active)
+        # An empty barrier means every dispatched learner left — nothing to
+        # aggregate; keep state so round_stalled() reports it for re-dispatch.
+        if not barrier or any(lid not in self._completed for lid in barrier):
+            return []
+        return self._release(active)
+
+    def round_stalled(self, active: Sequence[str]) -> bool:
+        """True when a dispatched round can never complete because no
+        dispatched learner is still active — the caller should reset and
+        dispatch a fresh round to the surviving learners."""
+        return bool(self._dispatched) and not any(
+            lid in active for lid in self._dispatched)
+
+    def expire_pending(self, active: Sequence[str]) -> List[str]:
+        """Straggler deadline: drop dispatched-but-unreported learners from
+        the round barrier and release whoever did report (possibly nobody —
+        the caller then re-dispatches). Closes the stall the reference never
+        handles (SURVEY.md §5.3: failed/hung learners stall a sync round
+        forever, controller.cc:683-687)."""
+        return self._release(active)
+
+    def reset(self) -> None:
+        self._completed.clear()
+        self._dispatched.clear()
+
+
+class AsynchronousScheduler:
+    """Immediately reschedule the reporting learner (no round barrier)."""
+
+    name = "asynchronous"
+
+    def notify_dispatched(self, learner_ids: Sequence[str]) -> None:
+        pass
+
+    def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
+        return [learner_id]
+
+    def handle_leave(self, active: Sequence[str]) -> List[str]:
+        return []
+
+    def round_stalled(self, active: Sequence[str]) -> bool:
+        return False
+
+    def expire_pending(self, active: Sequence[str]) -> List[str]:
+        return []  # no barrier — a hung learner cannot stall anyone else
+
+    def reset(self) -> None:
+        pass
+
+
+class SemiSynchronousScheduler(SynchronousScheduler):
+    """Synchronous release + per-learner step budget matched to the slowest.
+
+    After each round, every learner's local-step count is recomputed so all
+    learners train for ``lambda_ × (slowest learner's epoch wall-clock)``:
+    ``steps_i = lambda_ · t_slowest_epoch / t_step_i``. Mirrors the
+    reference's ``UpdateLearnersTaskTemplates`` (controller.cc:529-567).
+    """
+
+    name = "semi_synchronous"
+
+    def __init__(self, lambda_: float = 1.0, recompute_every_round: bool = False):
+        super().__init__()
+        self.lambda_ = float(lambda_)
+        self.recompute_every_round = recompute_every_round
+        self._recomputed_once = False
+
+    def recompute_steps(
+        self,
+        timings: Dict[str, Dict[str, float]],
+    ) -> Dict[str, int]:
+        """``timings[lid] = {"ms_per_step": float, "steps_per_epoch": float}``
+        → per-learner local-step budgets for the next round."""
+        if self.recompute_every_round is False and self._recomputed_once:
+            return {}
+        usable = {
+            lid: t
+            for lid, t in timings.items()
+            if t.get("ms_per_step", 0) > 0 and t.get("steps_per_epoch", 0) > 0
+        }
+        if not usable:
+            return {}
+        slowest_epoch_ms = max(
+            t["ms_per_step"] * t["steps_per_epoch"] for t in usable.values()
+        )
+        budget_ms = self.lambda_ * slowest_epoch_ms
+        self._recomputed_once = True
+        return {
+            lid: max(1, int(budget_ms / t["ms_per_step"]))
+            for lid, t in usable.items()
+        }
+
+
+SCHEDULERS = {
+    "synchronous": SynchronousScheduler,
+    "semi_synchronous": SemiSynchronousScheduler,
+    "asynchronous": AsynchronousScheduler,
+}
+
+
+def make_scheduler(name: str, **kwargs):
+    try:
+        cls = SCHEDULERS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown scheduler {name!r}; have {sorted(SCHEDULERS)}") from None
+    return cls(**kwargs)

@@ -1,0 +1,36 @@
+"""Secure aggregation backends.
+
+The reference's only scheme is Palisade CKKS (reference
+metisfl/encryption/palisade/ckks_scheme.cc). This rebuild offers:
+
+- ``identity`` — no-op "encryption" for tests and plumbing validation.
+- ``masking`` — pairwise additive masking (practical secure aggregation à la
+  Bonawitz et al.): learner sums cancel, controller sees only masked blobs.
+- ``ckks`` — CKKS homomorphic encryption via the native C++ library
+  (:mod:`metisfl_tpu.native`), API-compatible with the reference's ``fhe``
+  pybind module (ckks_pybind.cc:72-92).
+"""
+
+from metisfl_tpu.secure.identity import IdentityBackend
+from metisfl_tpu.secure.masking import MaskingBackend
+
+
+def make_backend(config, role: str = "learner", **kwargs):
+    """Build a backend from a SecureAggConfig. ``role`` is 'controller' or
+    'learner' — the controller never receives decryption capability for
+    schemes that separate them (reference driver_session.py:129-140 ships
+    the private key only to learners)."""
+    scheme = config.scheme.lower()
+    if scheme == "identity":
+        return IdentityBackend()
+    if scheme == "masking":
+        return MaskingBackend(**kwargs)
+    if scheme == "ckks":
+        from metisfl_tpu.secure.ckks import CKKSBackend
+        return CKKSBackend(batch_size=config.batch_size,
+                           scaling_factor_bits=config.scaling_factor_bits,
+                           key_dir=config.key_dir, role=role, **kwargs)
+    raise ValueError(f"unknown secure scheme {config.scheme!r}")
+
+
+__all__ = ["IdentityBackend", "MaskingBackend", "make_backend"]

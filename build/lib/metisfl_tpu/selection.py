@@ -1,0 +1,31 @@
+"""Model selection: which stored learner models join an aggregation.
+
+Equivalent of the reference's ``Selector`` / ``ScheduledCardinality``
+(reference metisfl/controller/selection/scheduled_cardinality.h:14-33): with
+fewer than two scheduled learners the aggregation uses ALL active learners'
+latest models (so an async single-learner completion still averages against
+the rest of the federation); otherwise exactly the scheduled set.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class ScheduledCardinalitySelector:
+    name = "scheduled_cardinality"
+
+    def select(self, scheduled: Sequence[str], active: Sequence[str]) -> List[str]:
+        if len(scheduled) < 2:
+            return list(active)
+        return [lid for lid in scheduled if lid in set(active)]
+
+
+SELECTORS = {"scheduled_cardinality": ScheduledCardinalitySelector}
+
+
+def make_selector(name: str):
+    try:
+        return SELECTORS[name.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown selector {name!r}; have {sorted(SELECTORS)}") from None

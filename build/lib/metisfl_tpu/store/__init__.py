@@ -1,0 +1,40 @@
+"""Model lineage stores.
+
+Equivalent of the reference's ``ModelStore`` hierarchy
+(reference metisfl/controller/store/model_store.h:13-75,
+hash_map_model_store.cc:1-123, redis_model_store.cc:1-307): a per-learner
+cache of recent models with lineage-length eviction. The in-memory store is
+the default; the disk store gives Redis-like persistence across controller
+restarts without an external service.
+"""
+
+from metisfl_tpu.store.base import EvictionPolicy, ModelStore
+from metisfl_tpu.store.memory import InMemoryModelStore
+from metisfl_tpu.store.disk import DiskModelStore
+from metisfl_tpu.store.cached import CachedDiskStore
+
+STORES = {
+    "in_memory": InMemoryModelStore,
+    "disk": DiskModelStore,
+    # disk persistence + byte-bounded LRU memory cache (the reference's
+    # RedisModelStore role without an external service)
+    "cached_disk": CachedDiskStore,
+}
+
+
+def make_store(name: str, **kwargs) -> ModelStore:
+    try:
+        return STORES[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown store {name!r}; have {sorted(STORES)}") from None
+
+
+__all__ = [
+    "ModelStore",
+    "EvictionPolicy",
+    "InMemoryModelStore",
+    "DiskModelStore",
+    "CachedDiskStore",
+    "STORES",
+    "make_store",
+]

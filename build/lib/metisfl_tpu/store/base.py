@@ -1,0 +1,82 @@
+"""Model store interface + eviction semantics."""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class EvictionPolicy(enum.Enum):
+    """Lineage retention (reference model_store.h:13-75, model_store.cc:7-27).
+
+    ``NO_EVICTION`` keeps full history; ``LINEAGE_LENGTH`` keeps the k most
+    recent models per learner (k=1 is classic FedAvg; FedRec needs k≥2).
+    """
+
+    NO_EVICTION = "no_eviction"
+    LINEAGE_LENGTH = "lineage_length"
+
+
+class ModelStore:
+    """Per-learner lineage cache. Thread-safe; values are opaque to the store
+    (pytrees of host numpy arrays, or encrypted OpaqueModels)."""
+
+    def __init__(self, policy: EvictionPolicy = EvictionPolicy.LINEAGE_LENGTH,
+                 lineage_length: int = 1):
+        if policy is EvictionPolicy.LINEAGE_LENGTH and lineage_length < 1:
+            raise ValueError("lineage_length must be >= 1")
+        self.policy = policy
+        self.lineage_length = lineage_length
+        self._lock = threading.Lock()
+
+    # -- subclass storage hooks -------------------------------------------
+    def _append(self, learner_id: str, model: Any) -> None:
+        raise NotImplementedError
+
+    def _lineage(self, learner_id: str) -> List[Any]:
+        """Most-recent-FIRST list of stored models."""
+        raise NotImplementedError
+
+    def _erase(self, learner_id: str) -> None:
+        raise NotImplementedError
+
+    def _evict(self, learner_id: str) -> None:
+        raise NotImplementedError
+
+    def _learner_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def insert(self, learner_id: str, model: Any) -> None:
+        with self._lock:
+            self._append(learner_id, model)
+            if self.policy is EvictionPolicy.LINEAGE_LENGTH:
+                self._evict(learner_id)
+
+    def select(self, learner_ids: Sequence[str], k: int = 1) -> Dict[str, List[Any]]:
+        """Latest ≤k models per learner, most recent first. Learners with no
+        stored model are omitted (mirrors SelectModels, model_store.h)."""
+        out: Dict[str, List[Any]] = {}
+        with self._lock:
+            for lid in learner_ids:
+                lineage = self._lineage(lid)
+                if lineage:
+                    out[lid] = lineage[:k]
+        return out
+
+    def erase(self, learner_ids: Sequence[str]) -> None:
+        with self._lock:
+            for lid in learner_ids:
+                self._erase(lid)
+
+    def learner_ids(self) -> List[str]:
+        with self._lock:
+            return self._learner_ids()
+
+    def size(self, learner_id: str) -> int:
+        with self._lock:
+            return len(self._lineage(learner_id))
+
+    def shutdown(self) -> None:
+        pass

@@ -1,0 +1,99 @@
+"""Disk-backed model store.
+
+Persistence role of the reference's ``RedisModelStore``
+(reference metisfl/controller/store/redis_model_store.cc:1-307) without an
+external service: each model is one blob file under
+``<root>/<learner_id>/<seq>.blob``, so controller restarts can recover the
+latest lineage (the reference's Redis store persisted models but lost its
+lineage bookkeeping on restart — SURVEY.md §5.4; here the sequence numbers
+ARE the bookkeeping).
+
+Values must be serializable pytrees (stored via :func:`pack_model`) or raw
+``bytes`` (stored verbatim — e.g. encrypted blobs).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, List
+
+from metisfl_tpu.store.base import EvictionPolicy, ModelStore
+from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+# packed pytrees land as .blob; verbatim byte payloads (ciphertexts) as
+# .opaque — tagged at WRITE time so a corrupt .blob stays a loud parse
+# error instead of being silently misread as an opaque payload
+_BLOB_RE = re.compile(r"^(\d+)\.(blob|opaque)$")
+_SAFE_ID = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+class DiskModelStore(ModelStore):
+    def __init__(self, root: str, policy: EvictionPolicy = EvictionPolicy.LINEAGE_LENGTH,
+                 lineage_length: int = 1):
+        super().__init__(policy, lineage_length)
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, learner_id: str) -> str:
+        return os.path.join(self.root, _SAFE_ID.sub("_", learner_id))
+
+    def _entries(self, learner_id: str) -> List[tuple]:
+        """Sorted [(seq, filename)] of stored models for one learner."""
+        path = self._dir(learner_id)
+        if not os.path.isdir(path):
+            return []
+        entries = []
+        for name in os.listdir(path):
+            match = _BLOB_RE.match(name)
+            if match:
+                entries.append((int(match.group(1)), name))
+        return sorted(entries)
+
+    def _append(self, learner_id: str, model: Any) -> int:
+        """Store one model; returns the sequence number it was filed under
+        (subclasses key caches off it)."""
+        path = self._dir(learner_id)
+        os.makedirs(path, exist_ok=True)
+        entries = self._entries(learner_id)
+        seq = (entries[-1][0] + 1) if entries else 0
+        if isinstance(model, (bytes, bytearray)):
+            data, ext = bytes(model), "opaque"
+        else:
+            data, ext = pack_model(model), "blob"
+        tmp = os.path.join(path, f".{seq}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(path, f"{seq}.{ext}"))
+        return seq
+
+    def _read_entry(self, learner_id: str, filename: str) -> Any:
+        """Read + decode one stored model file."""
+        with open(os.path.join(self._dir(learner_id), filename), "rb") as f:
+            data = f.read()
+        if filename.endswith(".opaque"):
+            return data  # verbatim payload, by write-time contract
+        blob = ModelBlob.from_bytes(data)  # corruption raises loudly here
+        if blob.opaque and not blob.tensors:
+            return data  # encrypted ModelBlob: hand back raw bytes
+        return {name: arr for name, arr in blob.tensors}
+
+    def _lineage(self, learner_id: str) -> List[Any]:
+        return [self._read_entry(learner_id, name)
+                for _, name in reversed(self._entries(learner_id))]
+
+    def _erase(self, learner_id: str) -> None:
+        shutil.rmtree(self._dir(learner_id), ignore_errors=True)
+
+    def _evict(self, learner_id: str) -> None:
+        entries = self._entries(learner_id)
+        excess = len(entries) - self.lineage_length
+        if excess <= 0:
+            return
+        for _, name in entries[:excess]:
+            os.unlink(os.path.join(self._dir(learner_id), name))
+
+    def _learner_ids(self) -> List[str]:
+        return [d for d in os.listdir(self.root)
+                if os.path.isdir(os.path.join(self.root, d))]

@@ -1,0 +1,40 @@
+"""Wire contract: dtype-preserving tensor (de)serialization.
+
+Equivalent capability to the reference's ``model.proto`` TensorSpec +
+``proto_tensor_serde.h`` / ``proto_messages_factory.py`` (reference
+metisfl/proto/model.proto:14-60, metisfl/controller/common/proto_tensor_serde.h:13-50,
+metisfl/utils/proto_messages_factory.py:419-507), redesigned as a compact
+little-endian binary format shared by the Python and C++ runtimes.
+"""
+
+from metisfl_tpu.tensor.spec import (
+    DType,
+    TensorKind,
+    TensorSpec,
+    tensor_from_bytes,
+    tensor_to_bytes,
+    quantify,
+)
+from metisfl_tpu.tensor.pytree import (
+    NamedTensors,
+    pytree_to_named_tensors,
+    named_tensors_to_pytree,
+    pack_model,
+    unpack_model,
+    ModelBlob,
+)
+
+__all__ = [
+    "DType",
+    "TensorKind",
+    "TensorSpec",
+    "tensor_from_bytes",
+    "tensor_to_bytes",
+    "quantify",
+    "NamedTensors",
+    "pytree_to_named_tensors",
+    "named_tensors_to_pytree",
+    "pack_model",
+    "unpack_model",
+    "ModelBlob",
+]

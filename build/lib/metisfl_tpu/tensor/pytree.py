@@ -1,0 +1,155 @@
+"""Pytree ⇄ named-tensor model blobs.
+
+The federation wire contract moves *models* — ordered, named, flat tensors —
+while the JAX learner works on *pytrees* (Flax param dicts). This module is
+the bridge. It replaces the reference's ``Model``/``Model.Variable`` proto
+(reference metisfl/proto/model.proto:100-152) and the get/set weight paths in
+``ModelOps`` (metisfl/models/model_ops.py:24-110): names are derived from the
+pytree key path, so a blob round-trips through any transport back into the
+exact same tree structure.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from metisfl_tpu.tensor.spec import (
+    TensorKind,
+    TensorSpec,
+    opaque_tensor_to_bytes,
+    tensor_from_bytes,
+    tensor_to_bytes,
+)
+
+NamedTensors = List[Tuple[str, np.ndarray]]
+
+_MAGIC = b"MTFB"  # metisfl-tpu federated blob
+_BLOB_VERSION = 1
+
+
+def _escape(part: str) -> str:
+    # '/' joins path components; escape literal '/' (and the escape char) so
+    # {'a': {'b': x}} and {'a/b': y} can never collide.
+    return part.replace("%", "%25").replace("/", "%2F")
+
+
+def _key_to_name(path) -> str:
+    parts = []
+    for entry in path:
+        if isinstance(entry, jax.tree_util.DictKey):
+            parts.append(_escape(str(entry.key)))
+        elif isinstance(entry, jax.tree_util.SequenceKey):
+            parts.append(str(entry.idx))
+        elif isinstance(entry, jax.tree_util.GetAttrKey):
+            parts.append(_escape(str(entry.name)))
+        elif isinstance(entry, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(entry.key))
+        else:  # pragma: no cover - future key types
+            parts.append(_escape(str(entry)))
+    return "/".join(parts)
+
+
+def _check_unique(names) -> None:
+    if len(set(names)) != len(names):
+        seen, dupes = set(), set()
+        for n in names:
+            (dupes if n in seen else seen).add(n)
+        raise ValueError(f"duplicate tensor names in model: {sorted(dupes)[:5]}")
+
+
+def pytree_to_named_tensors(tree) -> NamedTensors:
+    """Flatten a pytree of arrays to ``[(name, np.ndarray), ...]`` (ordered)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    named = [(_key_to_name(path), np.asarray(leaf)) for path, leaf in flat]
+    _check_unique([n for n, _ in named])
+    return named
+
+
+def named_tensors_to_pytree(named: NamedTensors, treedef_like):
+    """Rebuild a pytree structured like ``treedef_like`` from named tensors."""
+    flat = jax.tree_util.tree_flatten_with_path(treedef_like)
+    paths = [_key_to_name(p) for p, _ in flat[0]]
+    _check_unique([n for n, _ in named])
+    by_name = dict(named)
+    missing = [p for p in paths if p not in by_name]
+    if missing:
+        raise KeyError(f"model blob is missing tensors: {missing[:5]}")
+    leaves = [by_name[p] for p in paths]
+    return jax.tree_util.tree_unflatten(flat[1], leaves)
+
+
+@dataclass
+class ModelBlob:
+    """A serializable model: ordered named tensors plus opaque entries.
+
+    ``tensors`` holds plaintext arrays; ``opaque`` holds ciphertext/masked
+    payloads keyed by the same names (a blob is either all-plaintext or
+    all-opaque in practice, but the container does not force it).
+    """
+
+    tensors: NamedTensors = field(default_factory=list)
+    opaque: Dict[str, tuple] = field(default_factory=dict)  # name -> (payload, spec)
+
+    @property
+    def names(self) -> List[str]:
+        seen = [n for n, _ in self.tensors]
+        seen.extend(self.opaque.keys())
+        return seen
+
+    @property
+    def num_parameters(self) -> int:
+        return sum(int(a.size) for _, a in self.tensors) + sum(
+            spec.size for _, spec in self.opaque.values()
+        )
+
+    def to_bytes(self) -> bytes:
+        chunks = [_MAGIC, struct.pack("<BI", _BLOB_VERSION, len(self.names))]
+        for name, arr in self.tensors:
+            nb = name.encode("utf-8")
+            chunks.append(struct.pack("<H", len(nb)))
+            chunks.append(nb)
+            chunks.append(tensor_to_bytes(arr))
+        for name, (payload, spec) in self.opaque.items():
+            nb = name.encode("utf-8")
+            chunks.append(struct.pack("<H", len(nb)))
+            chunks.append(nb)
+            chunks.append(opaque_tensor_to_bytes(spec, payload))
+        return b"".join(chunks)
+
+    @classmethod
+    def from_bytes(cls, buf, copy: bool = True) -> "ModelBlob":
+        view = memoryview(buf)
+        if bytes(view[:4]) != _MAGIC:
+            raise ValueError("not a metisfl-tpu model blob")
+        version, count = struct.unpack_from("<BI", view, 4)
+        if version != _BLOB_VERSION:
+            raise ValueError(f"unsupported blob version {version}")
+        offset = 9
+        blob = cls()
+        for _ in range(count):
+            (nlen,) = struct.unpack_from("<H", view, offset)
+            offset += 2
+            name = bytes(view[offset : offset + nlen]).decode("utf-8")
+            offset += nlen
+            value, spec, offset = tensor_from_bytes(view, offset, copy=copy)
+            if spec.kind is TensorKind.PLAINTEXT:
+                blob.tensors.append((name, value))
+            else:
+                blob.opaque[name] = (value, spec)
+        return blob
+
+
+def pack_model(params_tree) -> bytes:
+    """One-call pytree → wire bytes."""
+    return ModelBlob(tensors=pytree_to_named_tensors(params_tree)).to_bytes()
+
+
+def unpack_model(buf, treedef_like):
+    """One-call wire bytes → pytree shaped like ``treedef_like``."""
+    blob = ModelBlob.from_bytes(buf)
+    return named_tensors_to_pytree(blob.tensors, treedef_like)

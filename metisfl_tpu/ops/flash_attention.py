@@ -1,26 +1,29 @@
 """Pallas flash attention (TPU kernels, interpret-mode on CPU).
 
-Blockwise attention with online softmax in VMEM: the (L, L) score matrix
-never reaches HBM. Forward streams K/V blocks through VMEM accumulating
-flash-style m/l/o statistics and emits the per-row logsumexp; the backward
-is the FlashAttention-2 scheme — two pallas kernels (dQ, and dK/dV) that
-recompute probabilities blockwise from the saved logsumexp, so training
-memory is O(L·D) end to end (round 2's version fell back to a dense XLA
-VJP, which re-materialized the L² matrix for training). Causal mode skips
-fully-masked key blocks entirely — roughly half the FLOPs — which is what
-makes the kernel beat XLA's dense attention (the dense path cannot skip).
+Blockwise attention with online softmax: the (L, L) score matrix never
+reaches HBM. Forward and backward are Mosaic-native grid-accumulation
+kernels — the KV (resp. Q) block index is a sequential GRID dimension,
+running statistics live in VMEM scratch across grid steps, and causal
+skipping is ``pl.when`` predication of whole blocks. No dynamic loop trip
+counts anywhere (an earlier revision drove a ``fori_loop`` with a
+program-id-dependent bound; grid predication is the pattern the TPU
+toolchain is built for), and K/V stream through VMEM one block per step, so
+VMEM stays bounded at any sequence length.
 
-Score/value products hit the MXU as (BLK, D) matmuls with fp32
-accumulation. The reference framework has no custom kernels at all (its hot
-loop is byte-blob C++ arithmetic, SURVEY.md §2.1 C3); this is the
-TPU-native hot path for the transformer ladder.
+The backward is the FlashAttention-2 scheme: dQ accumulates over KV blocks,
+dK/dV accumulate over Q blocks, both recomputing probabilities from the
+forward's saved logsumexp — training memory is O(L·D) end to end. Causal
+mode skips fully-masked blocks in all three kernels (~half the FLOPs),
+which is what lets the kernel beat XLA's dense attention.
 
 Sequence lengths that do not divide the block size are zero-padded up to
-the next block boundary and masked inside the kernels (the padded rows are
+the next block boundary and masked inside the kernels (padded rows are
 sliced off on the way out), so any L works on both paths.
 
-Best on TPU with head_dim a multiple of 128 (lane width); block sizes are
-multiples of 8 (f32 sublanes).
+The reference framework has no custom kernels at all (its hot loop is
+byte-blob C++ arithmetic, SURVEY.md §2.1 C3); this is the TPU-native hot
+path for the transformer ladder. Best with head_dim a multiple of 128
+(lane width); block sizes are multiples of 8 (f32 sublanes).
 """
 
 from __future__ import annotations
@@ -32,139 +35,160 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
+_LANES = 128      # statistics SCRATCH: one value replicated across a vreg
+_STAT_LANES = 8   # lse/delta in HBM: minimal tile-legal lane replication
+# ((blk_q, 8) blocks satisfy Mosaic's tiling because the minor dim equals
+# the full array dim; 128-lane replication in HBM would put the VJP's lse
+# residual on par with Q itself at long sequence lengths)
 
 
-def _causal_nk(qi, blk_q, blk_k, nk):
-    """Number of key blocks a causal query block ever sees (skip the rest)."""
-    last = (qi + 1) * blk_q - 1          # last query position in this block
-    return jnp.minimum(last // blk_k + 1, nk)
+def _causal_overlap(qi, blk_q, kj, blk_k):
+    """True when key block kj has any unmasked column for query block qi."""
+    return kj * blk_k <= (qi + 1) * blk_q - 1
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int,
-                causal: bool, scale: float, kv_len: int):
+def _mask_for(qi, blk_q, kj, blk_k, kv_len, causal):
+    q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+    k_pos = kj * blk_k + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 1)
+    mask = k_pos < kv_len                       # tail-padding mask
+    if causal:
+        mask &= q_pos >= k_pos
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                causal: bool, scale: float, kv_len: int, nk: int):
     qi = pl.program_id(1)
-    q = q_ref[0] * scale                       # (BLK_Q, D)
-    blk_q, D = q.shape
-    Lp = k_ref.shape[1]
-    nk = Lp // blk_k
+    kj = pl.program_id(2)
+    blk_q, D = q_ref.shape[1], q_ref.shape[2]
+    blk_k = k_ref.shape[1]
 
-    def body(j, carry):
-        o, m, l = carry
-        k = k_ref[0, pl.dslice(j * blk_k, blk_k), :]      # (BLK_K, D)
-        v = v_ref[0, pl.dslice(j * blk_k, blk_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 0)
-        k_pos = j * blk_k + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 1)
-        mask = k_pos < kv_len                  # tail-padding mask
-        if causal:
-            mask &= q_pos >= k_pos
-        s = jnp.where(mask, s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        o_new = o * corr + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, _NEG, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
-    o0 = jnp.zeros((blk_q, D), jnp.float32)
-    m0 = jnp.full((blk_q, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((blk_q, 1), jnp.float32)
-    upper = _causal_nk(qi, blk_q, blk_k, nk) if causal else nk
-    o, m, l = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    run = _causal_overlap(qi, blk_q, kj, blk_k) if causal else True
 
-
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               blk_k: int, causal: bool, scale: float, kv_len: int):
-    """dQ = Σ_j dS_j @ K_j, with P recomputed from the saved logsumexp."""
-    qi = pl.program_id(1)
-    q = q_ref[0]                               # (BLK_Q, D)
-    do = do_ref[0]                             # storage dtype: MXU-native
-    lse = lse_ref[0, 0][:, None]               # (BLK_Q, 1)
-    delta = delta_ref[0, 0][:, None]
-    blk_q, D = q.shape
-    nk = k_ref.shape[1] // blk_k
-
-    def body(j, dq):
-        k = k_ref[0, pl.dslice(j * blk_k, blk_k), :]
-        v = v_ref[0, pl.dslice(j * blk_k, blk_k), :]
+    @pl.when(run)
+    def _attend():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 0)
-        k_pos = j * blk_k + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 1)
-        mask = k_pos < kv_len
-        if causal:
-            mask &= q_pos >= k_pos
+        mask = _mask_for(qi, blk_q, kj, blk_k, kv_len, causal)
+        s = jnp.where(mask, s, _NEG)
+        m_prev = m_s[...]                       # (blk_q, LANES), lanes equal
+        l_prev = l_s[...]
+        m_curr = jnp.max(s, axis=1)[:, None]    # (blk_q, 1)
+        m_next = jnp.maximum(m_prev, m_curr)    # (blk_q, LANES)
+        p = jnp.exp(s - m_next[:, :1])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_next)        # (blk_q, LANES)
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr
+        m_s[...] = m_next
+        l_s[...] = l_next
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        # acc holds the RUNNING NORMALIZED output (official TPU kernel
+        # recipe): rescale by l_prev·alpha/l_next, add p@v/l_next
+        acc_s[...] = acc_s[...] * (l_corr * l_inv)[:, :1] + jax.lax.dot(
+            p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32) * l_inv[:, :1]
+
+    @pl.when(kj == nk - 1)
+    def _store():
+        o_ref[0] = acc_s[...].astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_s[...], 1e-30)
+        lse_ref[0] = (m_s[...] + jnp.log(l_safe))[:, :_STAT_LANES]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_s, *, causal: bool, scale: float, kv_len: int, nk: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    blk_q = q_ref.shape[1]
+    blk_k = k_ref.shape[1]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    run = _causal_overlap(qi, blk_q, kj, blk_k) if causal else True
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]                 # (blk_q, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _mask_for(qi, blk_q, kj, blk_k, kv_len, causal)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot(ds.astype(k.dtype), k,
-                                preferred_element_type=jnp.float32)
+        dq_s[...] += jax.lax.dot(ds.astype(k.dtype), k,
+                                 preferred_element_type=jnp.float32)
 
-    upper = _causal_nk(qi, blk_q, blk_k, nk) if causal else nk
-    dq = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros((blk_q, D), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _store():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, blk_q: int, causal: bool, scale: float,
-                kv_len: int):
-    """dK/dV for one key block, streaming query blocks (FlashAttention-2)."""
-    ki = pl.program_id(1)
-    k = k_ref[0]                               # (BLK_K, D)
-    v = v_ref[0]
-    blk_k, D = k.shape
-    Lp = q_ref.shape[1]
-    nq = Lp // blk_q
+                dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float,
+                kv_len: int, nq: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    blk_k = k_ref.shape[1]
+    blk_q = q_ref.shape[1]
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(i * blk_q, blk_q), :]
-        do = do_ref[0, pl.dslice(i * blk_q, blk_q), :]
-        lse = lse_ref[0, 0, pl.dslice(i * blk_q, blk_q)][:, None]
-        delta = delta_ref[0, 0, pl.dslice(i * blk_q, blk_q)][:, None]
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    run = _causal_overlap(qi, blk_q, kj, blk_k) if causal else True
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        q_pos = i * blk_q + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 0)
-        k_pos = ki * blk_k + jax.lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 1)
-        mask = k_pos < kv_len
-        if causal:
-            mask &= q_pos >= k_pos
+        mask = _mask_for(qi, blk_q, kj, blk_k, kv_len, causal)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         # dV += P^T @ dO
-        dv = dv + jax.lax.dot_general(
+        dv_s[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         # dK += dS^T @ Q
-        dk = dk + jax.lax.dot_general(
+        dk_s[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    # causal: query blocks strictly above this key block's diagonal see none
-    lower = (ki * blk_k) // blk_q if causal else 0
-    zeros = jnp.zeros((blk_k, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, nq, body, (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _store():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _dense_attention(q, k, v, causal: bool):
@@ -182,12 +206,21 @@ def _pad_len(L: int, blk: int) -> int:
     return (L + blk - 1) // blk * blk
 
 
-def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
-                   interpret: bool):
-    B, H, L, D = q.shape
+def _resolve_blocks(L: int, blk_q: int, blk_k: int):
     blk_q = min(blk_q, _pad_len(L, 8))
     blk_k = min(blk_k, _pad_len(L, 8))
     Lp = max(_pad_len(L, blk_q), _pad_len(L, blk_k))
+    return blk_q, blk_k, Lp
+
+
+_SEQ_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
+                   interpret: bool):
+    B, H, L, D = q.shape
+    blk_q, blk_k, Lp = _resolve_blocks(L, blk_q, blk_k)
     scale = float(1.0 / np.sqrt(D))
     qf = q.reshape(B * H, L, D)
     kf = k.reshape(B * H, L, D)
@@ -195,26 +228,33 @@ def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
     if Lp != L:
         pad = ((0, 0), (0, Lp - L), (0, 0))
         qf, kf, vf = (jnp.pad(x, pad) for x in (qf, kf, vf))
-    kernel = functools.partial(_fwd_kernel, blk_k=blk_k, causal=causal,
-                               scale=scale, kv_len=L)
+    nk = Lp // blk_k
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               kv_len=L, nk=nk)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
-            # (B*H, 1, Lp): lanes along the sequence so (1, 1, blk_q)
-            # blocks satisfy the TPU (8, 128) tiling constraint
-            jax.ShapeDtypeStruct((B * H, 1, Lp), jnp.float32),
+            # logsumexp replicated across the lane dim (2D-tiled layout;
+            # callers slice [:, :, 0])
+            jax.ShapeDtypeStruct((B * H, Lp, _STAT_LANES), jnp.float32),
         ],
-        grid=(B * H, Lp // blk_q),
+        grid=(B * H, Lp // blk_q, nk),
         in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Lp, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Lp, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, blk_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, i, j: (b, i, 0)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),   # m
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),   # l
+            pltpu.VMEM((blk_q, D), jnp.float32),        # acc
+        ],
+        compiler_params=None if interpret else _SEQ_PARAMS,
         interpret=interpret,
     )(qf, kf, vf)
     return out[:, :L].reshape(B, H, L, D), lse
@@ -223,57 +263,65 @@ def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
 def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
                     blk_k: int, interpret: bool):
     B, H, L, D = q.shape
-    blk_q = min(blk_q, _pad_len(L, 8))
-    blk_k = min(blk_k, _pad_len(L, 8))
-    Lp = max(_pad_len(L, blk_q), _pad_len(L, blk_k))
+    blk_q, blk_k, Lp = _resolve_blocks(L, blk_q, blk_k)
     scale = float(1.0 / np.sqrt(D))
     flat = lambda x: x.reshape(B * H, L, D)
     qf, kf, vf, of, gf = map(flat, (q, k, v, out, g))
-    # delta_i = rowsum(dO_i * O_i) — tiny elementwise reduce; XLA fuses it
+    # delta_i = rowsum(dO_i * O_i), lane-replicated like lse
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)[:, None, :]
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, L, _STAT_LANES))
     if Lp != L:
         pad3 = ((0, 0), (0, Lp - L), (0, 0))
         qf, kf, vf, gf = (jnp.pad(x, pad3) for x in (qf, kf, vf, gf))
-        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, Lp - L)))
+        delta = jnp.pad(delta, pad3)
+    nq = Lp // blk_q
+    nk = Lp // blk_k
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, blk_k=blk_k, causal=causal,
-                          scale=scale, kv_len=L),
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          kv_len=L, nk=nk),
         out_shape=jax.ShapeDtypeStruct((B * H, Lp, D), q.dtype),
-        grid=(B * H, Lp // blk_q),
+        grid=(B * H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Lp, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Lp, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, blk_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, blk_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=None if interpret else _SEQ_PARAMS,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, blk_q=blk_q, causal=causal,
-                          scale=scale, kv_len=L),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          kv_len=L, nq=nq),
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Lp, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, Lp, D), v.dtype),
         ],
-        grid=(B * H, Lp // blk_k),
+        grid=(B * H, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, Lp, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, Lp, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, Lp), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, Lp), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, D), jnp.float32),
+            pltpu.VMEM((blk_k, D), jnp.float32),
+        ],
+        compiler_params=None if interpret else _SEQ_PARAMS,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
