@@ -133,7 +133,16 @@ def _take(view: memoryview, offset: int, length: int) -> tuple[memoryview, int]:
     return view[offset:end], end
 
 
-def _decode(view: memoryview, offset: int) -> tuple[Any, int]:
+# Nesting bound for the RECURSIVE decoder: crafted deep nesting (~2 bytes
+# per level) must raise a clean ValueError at the wire boundary, not blow
+# the interpreter stack with RecursionError. Far above any real message
+# (messages nest < 10 deep).
+_MAX_DEPTH = 100
+
+
+def _decode(view: memoryview, offset: int, depth: int = 0) -> tuple[Any, int]:
+    if depth > _MAX_DEPTH:
+        raise ValueError(f"codec: nesting exceeds {_MAX_DEPTH} levels")
     if offset >= len(view):
         raise ValueError("codec: truncated buffer (empty value)")
     tag = view[offset]
@@ -162,7 +171,7 @@ def _decode(view: memoryview, offset: int) -> tuple[Any, int]:
         length, offset = _read_varint(view, offset)
         items = []
         for _ in range(length):
-            item, offset = _decode(view, offset)
+            item, offset = _decode(view, offset, depth + 1)
             items.append(item)
         return items, offset
     if tag == _T_DICT:
@@ -172,11 +181,18 @@ def _decode(view: memoryview, offset: int) -> tuple[Any, int]:
             klen, offset = _read_varint(view, offset)
             raw, offset = _take(view, offset, klen)
             key = bytes(raw).decode("utf-8")
-            result[key], offset = _decode(view, offset)
+            result[key], offset = _decode(view, offset, depth + 1)
         return result, offset
     raise ValueError(f"codec: unknown tag 0x{tag:02x} at offset {offset - 1}")
 
 
 def loads(buf) -> Any:
-    value, _ = _decode(memoryview(buf), 0)
+    view = memoryview(buf)
+    value, offset = _decode(view, 0)
+    if offset != len(view):
+        # trailing bytes mean a framing error (truncated write spliced with
+        # the next frame, corrupt length prefix): decoding a prefix and
+        # silently discarding the rest would return a wrong value
+        raise ValueError(
+            f"codec: {len(view) - offset} trailing byte(s) after value")
     return value

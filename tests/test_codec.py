@@ -129,3 +129,39 @@ def test_codec_memoryview_itemsize():
 def test_codec_varint_overflow_rejected():
     with pytest.raises(ValueError):
         loads(b"\x03" + b"\xff" * 30 + b"\x01")
+
+
+def test_codec_random_garbage_never_crashes():
+    """The wire boundary sees attacker-controlled bytes: decoding garbage
+    must raise a clean ValueError (never hang, crash, or silently decode a
+    prefix). Anything that does decode must round-trip losslessly."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 3, 17, 256, 4096):
+        for _ in range(50):
+            blob = rng.bytes(n) if n else b""
+            try:
+                value = loads(blob)
+            except ValueError:
+                continue
+            assert loads(dumps(value)) == value
+
+
+def test_codec_rejects_trailing_bytes():
+    """A decoded value must consume the whole buffer — accepting trailing
+    junk would silently return wrong values on framing errors."""
+    with pytest.raises(ValueError, match="trailing"):
+        loads(dumps({"a": 1}) + b"\xde\xad")
+
+
+def test_codec_deep_nesting_bounded():
+    """Nesting is bounded: real messages round-trip, crafted ~2-bytes-per-
+    level nesting raises a clean ValueError instead of RecursionError."""
+    value = 1
+    for _ in range(60):
+        value = [value]
+    assert loads(dumps(value)) == value
+    bomb = b"\x07\x01" * 2000 + b"\x00"
+    with pytest.raises(ValueError, match="nesting"):
+        loads(bomb)
