@@ -104,9 +104,18 @@ class TrainParams(Message):
     moe_aux_weight: float = 0.01
     # jax.profiler trace capture (SURVEY.md §5.1): when set, each training
     # task traces ``profile_steps`` steady-state (post-compile) steps into
-    # this directory — TensorBoard/xprof-readable.
+    # this directory — TensorBoard/xprof-readable. With scan_chunk > 1 the
+    # trace covers exactly ONE steady-state fused chunk (scan_chunk steps),
+    # since steps inside a compiled scan cannot be traced individually; a
+    # run whose only chunk is the compiling one captures no trace rather
+    # than a compile-dominated one.
     profile_dir: str = ""
     profile_steps: int = 3
+    # Fuse this many optimizer steps into ONE jit-compiled lax.scan program.
+    # Cuts host→device dispatch to 1/scan_chunk of the per-step path — the
+    # difference is pure overhead on TPU (and dominant when the chip sits
+    # behind a network tunnel). Cancellation is checked between chunks.
+    scan_chunk: int = 1
 
 
 @dataclass

@@ -153,18 +153,20 @@ class FlaxModelOps:
         return int(np.prod([self.mesh.shape[a] for a in ("dp", "fsdp")
                             if a in self.mesh.shape]))
 
-    def _shard_batch(self, arr):
-        """Shard the leading (batch) dimension over the mesh's data axes."""
+    def _shard_batch(self, arr, batch_axis: int = 0):
+        """Shard the batch dimension (``batch_axis``) over the mesh's data
+        axes; a leading scan axis (batch_axis=1) stays replicated."""
         from jax.sharding import NamedSharding, PartitionSpec
         data_axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.shape)
         n = self._data_axis_size()
-        if n > 1 and arr.shape[0] % n:
+        if n > 1 and arr.shape[batch_axis] % n:
             raise ValueError(
-                f"batch of {arr.shape[0]} examples is not divisible by the "
-                f"mesh data axes {data_axes} (size {n}); pick a batch_size "
-                f"that is a multiple of {n} and shards with >= batch_size "
-                "examples")
-        spec = PartitionSpec(data_axes if data_axes else None)
+                f"batch of {arr.shape[batch_axis]} examples is not divisible "
+                f"by the mesh data axes {data_axes} (size {n}); pick a "
+                f"batch_size that is a multiple of {n} and shards with >= "
+                "batch_size examples")
+        spec = PartitionSpec(*([None] * batch_axis),
+                             data_axes if data_axes else None)
         return jax.device_put(jnp.asarray(arr), NamedSharding(self.mesh, spec))
 
     # -- module introspection ---------------------------------------------
@@ -200,8 +202,8 @@ class FlaxModelOps:
             self.variables = jax.tree.map(jnp.asarray, variables)
 
     # -- training ----------------------------------------------------------
-    def _make_step(self, params_cfg: TrainParams):
-        key = (
+    def _cfg_key(self, params_cfg: TrainParams) -> tuple:
+        return (
             params_cfg.optimizer,
             float(params_cfg.learning_rate),
             tuple(sorted((params_cfg.optimizer_kwargs or {}).items())),
@@ -209,6 +211,9 @@ class FlaxModelOps:
             float(params_cfg.moe_aux_weight),
             self._loss_name,
         )
+
+    def _make_step(self, params_cfg: TrainParams):
+        key = self._cfg_key(params_cfg)
         if key in self._step_cache:
             return self._step_cache[key]
 
@@ -282,6 +287,41 @@ class FlaxModelOps:
             return params, new_bs, opt_state, loss, acc
 
         compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._step_cache[key] = (compiled, tx, step)
+        return self._step_cache[key]
+
+    def _make_scan(self, params_cfg: TrainParams, chunk: int):
+        """``chunk`` optimizer steps as ONE compiled program: lax.scan over
+        stacked batches with the training state as carry. One dispatch and
+        one host sync per chunk instead of per step — on TPU the difference
+        is pure launch overhead (and dominant when the chip sits behind a
+        network tunnel). Same math as the per-step path: the scan body IS
+        the per-step function."""
+        key = self._cfg_key(params_cfg) + ("scan", chunk)
+        cached = self._step_cache.get(key)
+        if cached is not None:
+            return cached
+        _, tx, step = self._make_step(params_cfg)
+
+        def scan_steps(params, batch_stats, opt_state, global_params,
+                       rng0, step_ids, xs, ys):
+            # the rng rides the carry and folds with the global step index
+            # INSIDE the program — same chained fold_in sequence as the
+            # per-step path, but zero extra host dispatches per step
+            def body(carry, batch):
+                params, batch_stats, opt_state, rng = carry
+                x, y, step_id = batch
+                rng = jax.random.fold_in(rng, step_id)
+                params, batch_stats, opt_state, loss, acc = step(
+                    params, batch_stats, opt_state, global_params, x, y, rng)
+                return (params, batch_stats, opt_state, rng), (loss, acc)
+
+            (params, batch_stats, opt_state, rng), (losses, accs) = (
+                jax.lax.scan(body, (params, batch_stats, opt_state, rng0),
+                             (xs, ys, step_ids)))
+            return params, batch_stats, opt_state, rng, losses, accs
+
+        compiled = jax.jit(scan_steps, donate_argnums=(0, 1, 2))
         self._step_cache[key] = (compiled, tx)
         return self._step_cache[key]
 
@@ -294,7 +334,7 @@ class FlaxModelOps:
             total_steps = max(1, int(math.ceil(
                 params_cfg.local_epochs * steps_per_epoch)))
 
-        compiled, tx = self._make_step(params_cfg)
+        compiled, tx, _ = self._make_step(params_cfg)
         params = self.variables["params"]
         batch_stats = self.variables.get("batch_stats", {})
         # FedProx anchors to a non-donated copy of the round-start params;
@@ -311,37 +351,16 @@ class FlaxModelOps:
         completed = 0
         rng = self._rng
 
-        place = self._shard_batch if self.mesh is not None else jnp.asarray
+        place = (self._shard_batch if self.mesh is not None
+                 else lambda arr, batch_axis=0: jnp.asarray(arr))
         stream = dataset.infinite_batches(params_cfg.batch_size)
-        # jax.profiler trace of steady-state steps (SURVEY.md §5.1): start
-        # AFTER the compile step so the trace shows the hot loop, not tracing
-        profile_from = 1 if total_steps > 1 else 0
-        profile_until = profile_from + max(1, params_cfg.profile_steps)
-        profiling = False
-        for step_idx in range(total_steps):
-            if cancel_event is not None and cancel_event.is_set():
-                break
-            if (params_cfg.profile_dir and not profiling
-                    and step_idx == profile_from):
-                jax.profiler.start_trace(params_cfg.profile_dir)
-                profiling = True
-            x, y = next(stream)
-            rng = jax.random.fold_in(rng, step_idx)
-            t0 = time.perf_counter()
-            params, batch_stats, opt_state, loss, acc = compiled(
-                params, batch_stats, opt_state, global_params,
-                place(x), place(y), rng)
-            if step_idx > 0 or total_steps == 1:
-                # skip the compile step for steady-state timing
-                jax.block_until_ready(loss)
-                step_times.append(time.perf_counter() - t0)
-            if profiling and step_idx + 1 >= profile_until:
-                jax.block_until_ready(loss)
-                jax.profiler.stop_trace()
-                profiling = False
-            completed += 1
-            epoch_losses.append((loss, acc))
-            if (step_idx + 1) % steps_per_epoch == 0 or step_idx == total_steps - 1:
+        chunk = max(1, int(params_cfg.scan_chunk))
+
+        def _flush_epoch(force: bool = False) -> None:
+            nonlocal epoch_losses
+            if epoch_losses and (
+                    force or completed % steps_per_epoch == 0
+                    or completed == total_steps):
                 ls = [float(l) for l, _ in epoch_losses]
                 as_ = [float(a) for _, a in epoch_losses]
                 epoch_metrics.append({"loss": float(np.mean(ls)),
@@ -350,13 +369,95 @@ class FlaxModelOps:
                 accs.extend(as_)
                 epoch_losses = []
 
+        traced = False
+        fallback_time: Optional[float] = None
+        if chunk > 1 and total_steps >= chunk:
+            scan_compiled, _ = self._make_scan(params_cfg, chunk)
+            n_chunks = total_steps // chunk
+            profiling = False
+            for chunk_idx in range(n_chunks):
+                if cancel_event is not None and cancel_event.is_set():
+                    break
+                # second chunk = first steady-state program execution; a
+                # single-chunk run has no steady-state chunk to trace (the
+                # remainder loop below still traces when it runs)
+                if params_cfg.profile_dir and chunk_idx == 1:
+                    jax.profiler.start_trace(params_cfg.profile_dir)
+                    profiling = traced = True
+                xs, ys = [], []
+                for _ in range(chunk):
+                    x, y = next(stream)
+                    xs.append(x)
+                    ys.append(y)
+                xs = place(np.stack(xs), batch_axis=1)
+                ys = place(np.stack(ys), batch_axis=1)
+                step_ids = jnp.arange(completed, completed + chunk,
+                                      dtype=jnp.uint32)
+                t0 = time.perf_counter()
+                params, batch_stats, opt_state, rng, c_losses, c_accs = (
+                    scan_compiled(params, batch_stats, opt_state,
+                                  global_params, rng, step_ids, xs, ys))
+                c_losses = np.asarray(c_losses)
+                c_accs = np.asarray(c_accs)       # host sync, once per chunk
+                if chunk_idx > 0:
+                    step_times.extend([(time.perf_counter() - t0) / chunk]
+                                      * chunk)
+                elif n_chunks == 1:
+                    # compile-contaminated; used only if nothing else lands
+                    fallback_time = (time.perf_counter() - t0) / chunk
+                if profiling:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                for loss, acc in zip(c_losses, c_accs):
+                    completed += 1
+                    epoch_losses.append((loss, acc))
+                    _flush_epoch()
+            remaining = (total_steps - completed
+                         if not (cancel_event is not None
+                                 and cancel_event.is_set()) else 0)
+        else:
+            remaining = total_steps
+
+        # per-step path: the whole run (chunk == 1), the scan remainder
+        # (total_steps % chunk), or the whole run again when total_steps <
+        # chunk made the scan path skip itself
+        profile_from = completed + (1 if remaining > 1 else 0)
+        profile_until = profile_from + max(1, params_cfg.profile_steps)
+        profiling = False
+        per_step_runs = 0
+        for _ in range(remaining):
+            if cancel_event is not None and cancel_event.is_set():
+                break
+            if (params_cfg.profile_dir and not profiling and not traced
+                    and completed == profile_from):
+                jax.profiler.start_trace(params_cfg.profile_dir)
+                profiling = True
+            x, y = next(stream)
+            rng = jax.random.fold_in(rng, completed)
+            t0 = time.perf_counter()
+            params, batch_stats, opt_state, loss, acc = compiled(
+                params, batch_stats, opt_state, global_params,
+                place(x), place(y), rng)
+            per_step_runs += 1
+            if per_step_runs > 1 or (remaining == 1 and not step_times):
+                # the per-step program's first execution pays its jit
+                # compile — keep it out of steady-state timing (unless it
+                # would be the only sample in the whole run)
+                jax.block_until_ready(loss)
+                step_times.append(time.perf_counter() - t0)
+            if profiling and completed + 1 >= profile_until:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                profiling = False
+            completed += 1
+            epoch_losses.append((loss, acc))
+            _flush_epoch()
+
         if profiling:
             jax.block_until_ready(loss)
             jax.profiler.stop_trace()
 
-        if epoch_losses:
-            losses.extend(float(l) for l, _ in epoch_losses)
-            accs.extend(float(a) for _, a in epoch_losses)
+        _flush_epoch(force=True)
 
         new_vars = {"params": params}
         if self._has_batch_stats:
@@ -364,6 +465,8 @@ class FlaxModelOps:
         self.variables = new_vars
         self._rng = rng
 
+        if not step_times and fallback_time is not None:
+            step_times = [fallback_time]
         ms_per_step = float(np.median(step_times) * 1e3) if step_times else 0.0
         return TrainOutput(
             variables=self.get_variables(),

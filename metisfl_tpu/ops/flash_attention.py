@@ -206,9 +206,25 @@ def _pad_len(L: int, blk: int) -> int:
     return (L + blk - 1) // blk * blk
 
 
-def _resolve_blocks(L: int, blk_q: int, blk_k: int):
-    blk_q = min(blk_q, _pad_len(L, 8))
-    blk_k = min(blk_k, _pad_len(L, 8))
+def _auto_blk(L: int) -> int:
+    """Largest block edge in {512, 256, 128} that divides the 8-aligned
+    sequence length. Bigger blocks cut grid steps (less per-step predication
+    / scratch traffic, larger MXU matmuls) and stay well inside VMEM —
+    q/k/v/do blocks at 512x128 bf16 are 128 KB each, the f32 scratch
+    accumulators 256 KB — but an edge that does NOT divide L would pad the
+    grid up to the next multiple and burn the padding as masked FLOPs
+    (e.g. L=640 at blk 512 pads to 1024: ~2.5x the work), so divisibility
+    wins over size."""
+    L8 = _pad_len(L, 8)
+    for cand in (512, 256, 128):
+        if cand <= L8 and L8 % cand == 0:
+            return cand
+    return min(128, L8)
+
+
+def _resolve_blocks(L: int, blk_q: Optional[int], blk_k: Optional[int]):
+    blk_q = min(blk_q or _auto_blk(L), _pad_len(L, 8))
+    blk_k = min(blk_k or _auto_blk(L), _pad_len(L, 8))
     Lp = max(_pad_len(L, blk_q), _pad_len(L, blk_k))
     return blk_q, blk_k, Lp
 
@@ -330,10 +346,14 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, blk_q: int = 128,
-                    blk_k: int = 128, interpret: Optional[bool] = None):
-    """Flash attention over (B, H, L, D). ``interpret=None`` auto-selects
-    interpret mode off-TPU so the same call works in CI and on chip."""
+def flash_attention(q, k, v, causal: bool = False,
+                    blk_q: Optional[int] = None,
+                    blk_k: Optional[int] = None,
+                    interpret: Optional[bool] = None):
+    """Flash attention over (B, H, L, D). ``blk_q``/``blk_k=None`` auto-size
+    blocks (512 capped to the padded sequence). ``interpret=None``
+    auto-selects interpret mode off-TPU so the same call works in CI and on
+    chip."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out, _ = _flash_forward(q, k, v, causal, blk_q, blk_k, interpret)

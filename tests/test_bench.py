@@ -11,7 +11,8 @@ def test_mfu_sweep_plumbing_toy_shapes():
 
     out = bench_mfu(L=32, dim=16, depth=1, heads=2, vocab=64,
                     require_tpu=False)
-    for label in ("b8_dense", "b8_flash", "b16_flash_remat"):
+    for label in ("b8_dense", "b8_dense_scan8", "b8_flash_scan8",
+                  "b16_flash_remat_scan8"):
         assert f"lm_{label}_ms_per_step" in out, out.get(
             f"lm_{label}_error", f"variant {label} missing")
         assert out[f"lm_{label}_tokens_per_sec"] > 0

@@ -142,3 +142,55 @@ def test_train_profiler_traces(tmp_path):
     assert out.completed_steps == 5
     traces = glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
     assert traces, "no profiler trace captured"
+
+
+def test_scan_chunk_matches_per_step():
+    """scan_chunk fuses K steps into one lax.scan program; the math is the
+    per-step function, so final params and metrics must match the chunk=1
+    path exactly (including the non-divisible remainder steps)."""
+    def run(scan_chunk):
+        ds = _toy_classification(seed=9)
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3), ds.x[:2],
+                              rng_seed=3)
+        out = engine.train(ds, TrainParams(batch_size=16, local_steps=7,
+                                           learning_rate=0.05,
+                                           scan_chunk=scan_chunk))
+        return engine.get_variables(), out
+
+    vars1, out1 = run(1)
+    vars3, out3 = run(3)  # 2 chunks of 3 + 1 remainder step
+    assert out3.completed_steps == out1.completed_steps == 7
+    for a, b in zip(__import__("jax").tree.leaves(vars1),
+                    __import__("jax").tree.leaves(vars3)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert out3.train_metrics["loss"] == pytest.approx(
+        out1.train_metrics["loss"], rel=1e-5)
+    assert len(out3.epoch_metrics) == len(out1.epoch_metrics)
+
+
+def test_scan_chunk_whole_run():
+    """local_steps an exact multiple of scan_chunk: no remainder path."""
+    ds = _toy_classification(seed=11)
+    engine = FlaxModelOps(MLP(features=(8,), num_outputs=3), ds.x[:2])
+    out = engine.train(ds, TrainParams(batch_size=16, local_steps=6,
+                                       scan_chunk=3, learning_rate=0.05))
+    assert out.completed_steps == 6
+    assert out.ms_per_step > 0
+    assert np.isfinite(out.train_metrics["loss"])
+
+
+def test_profiler_runs_when_scan_chunk_exceeds_steps(tmp_path):
+    """scan_chunk > total_steps falls back to the per-step path; the
+    profiler must still capture a trace there."""
+    import glob
+
+    ds = _toy_classification(seed=13)
+    engine = FlaxModelOps(MLP(features=(8,), num_outputs=3), ds.x[:2])
+    out = engine.train(ds, TrainParams(batch_size=16, local_steps=3,
+                                       scan_chunk=8,
+                                       profile_dir=str(tmp_path),
+                                       profile_steps=1))
+    assert out.completed_steps == 3
+    traces = glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
+    assert traces, "no profiler trace captured on the fallback path"
