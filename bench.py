@@ -360,7 +360,8 @@ def bench_flash(seq: int = 2048, reps: int = 8):
             t_one = timed(chain(fn, 1))
             per_op = (t_many - t_one) / (reps - 1)
             out[f"attn_{label}_{tag}_ms"] = round(max(per_op, 0.0), 3)
-            out[f"attn_{label}_{tag}_dispatch_ms"] = round(t_one, 2)
+            # one dispatch + ONE op execution (not dispatch alone)
+            out[f"attn_{label}_{tag}_single_call_ms"] = round(t_one, 2)
     return out
 
 

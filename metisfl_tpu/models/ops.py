@@ -399,11 +399,12 @@ class FlaxModelOps:
                                   global_params, rng, step_ids, xs, ys))
                 c_losses = np.asarray(c_losses)
                 c_accs = np.asarray(c_accs)       # host sync, once per chunk
-                if chunk_idx > 0:
+                if chunk_idx > 0 and not profiling:
                     step_times.extend([(time.perf_counter() - t0) / chunk]
                                       * chunk)
-                elif n_chunks == 1:
-                    # compile-contaminated; used only if nothing else lands
+                elif n_chunks == 1 or profiling:
+                    # compile- or profiler-contaminated; used only if no
+                    # clean sample lands anywhere in the run
                     fallback_time = (time.perf_counter() - t0) / chunk
                 if profiling:
                     jax.profiler.stop_trace()

@@ -203,3 +203,30 @@ def test_lstm_classifier_trains():
                                     optimizer="adam", learning_rate=1e-2))
     assert out.completed_steps == 3
     assert np.isfinite(out.train_metrics["loss"])
+
+
+def test_sharded_scan_chunk_matches_per_step():
+    """scan_chunk over a TP×DP mesh: stacked (chunk, batch, ...) inputs
+    shard the batch dim (axis 1) over the data axes while the scan axis
+    stays replicated, producing the same trajectory as chunk=1."""
+    from metisfl_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(("dp", "tp"), (2, 4)))
+    ds = _tok_ds(lm=True)
+    module = LlamaLite(vocab_size=64, dim=16, depth=2, heads=2)
+
+    def run(chunk):
+        ops = FlaxModelOps(module, ds.x[:2], rng_seed=0, mesh=mesh,
+                           partition_rules=TRANSFORMER_RULES)
+        out = ops.train(ArrayDataset(ds.x, ds.y, seed=1),
+                        TrainParams(batch_size=8, local_steps=4,
+                                    learning_rate=0.05, optimizer="sgd",
+                                    scan_chunk=chunk))
+        return out
+
+    out1, out2 = run(1), run(2)
+    assert out2.completed_steps == out1.completed_steps == 4
+    for a, b in zip(jax.tree.leaves(out1.variables["params"]),
+                    jax.tree.leaves(out2.variables["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
