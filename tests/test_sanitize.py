@@ -1,0 +1,118 @@
+"""Sanitizer posture for the native layer (SURVEY.md §5.2).
+
+The reference has no sanitizer story at all (two coarse mutexes and hope —
+SURVEY §5.2); here the native C++ components are compiled with
+ASan + UBSan (-fno-sanitize-recover) and driven end to end — keygen →
+encrypt → keyless weighted-sum → decrypt for the CKKS library, and an
+OpenMP-threaded fold for the host-aggregation library — so memory errors
+or UB in the real API paths fail CI, not production. (TSan is deliberately
+not used: it false-positives on libgomp's own synchronization; cross-thread
+interleaving of the Python-facing paths is covered by tests/test_stress.py.)
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "metisfl_tpu", "native")
+
+DRIVER = r"""
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+long ckks_n();
+long ckks_ciphertext_size(long n_values);
+int ckks_keygen(const char* dir);
+void* ckks_open(const char* dir, int load_secret);
+void ckks_close(void* ctx);
+int ckks_has_secret(void* ctx);
+long ckks_encrypt(void* ctx, const double* vals, long n,
+                  unsigned char* out, long cap);
+long ckks_weighted_sum(const unsigned char* const* payloads,
+                       const long* sizes, const double* scales, long count,
+                       unsigned char* out, long cap);
+long ckks_decrypt(void* ctx, const unsigned char* payload, long size,
+                  double* out, long n);
+int ckks_selftest();
+void hostfold_f32(float* acc, const float* const* models,
+                  const double* scales, long count, long n, int accumulate);
+int hostfold_selftest();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) return 90;
+  const char* key_dir = argv[1];
+  if (ckks_selftest() != 0) return 1;
+  if (hostfold_selftest() != 0) return 2;
+
+  // full CKKS path at an awkward (non-multiple-of-ring) length
+  const long n = 10007;
+  if (ckks_keygen(key_dir) != 0) return 3;
+  void* learner = ckks_open(key_dir, 1);
+  if (!learner || !ckks_has_secret(learner)) return 4;
+  std::vector<double> vals(n);
+  for (long i = 0; i < n; i++) vals[i] = 0.001 * (i % 997) - 0.5;
+  long cap = ckks_ciphertext_size(n);
+  std::vector<unsigned char> ct(cap);
+  long ct_size = ckks_encrypt(learner, vals.data(), n, ct.data(), cap);
+  if (ct_size <= 0) return 5;
+  const unsigned char* payloads[3] = {ct.data(), ct.data(), ct.data()};
+  long sizes[3] = {ct_size, ct_size, ct_size};
+  double scales[3] = {0.25, 0.25, 0.5};
+  std::vector<unsigned char> combined(cap);
+  long c_size = ckks_weighted_sum(payloads, sizes, scales, 3,
+                                  combined.data(), cap);
+  if (c_size <= 0) return 6;
+  std::vector<double> out(n);
+  if (ckks_decrypt(learner, combined.data(), c_size, out.data(), n) != n)
+    return 7;
+  for (long i = 0; i < n; i++)
+    if (out[i] < vals[i] - 1e-3 || out[i] > vals[i] + 1e-3) return 8;
+  ckks_close(learner);
+
+  // OpenMP-threaded fold on a non-tiny buffer
+  const long fn = 1 << 18;
+  std::vector<float> acc(fn, 0.0f), m0(fn), m1(fn);
+  for (long i = 0; i < fn; i++) { m0[i] = 1.0f; m1[i] = 3.0f; }
+  const float* models[2] = {m0.data(), m1.data()};
+  double fscales[2] = {0.5, 0.5};
+  hostfold_f32(acc.data(), models, fscales, 2, fn, 0);
+  for (long i = 0; i < fn; i++)
+    if (acc[i] < 1.99f || acc[i] > 2.01f) return 9;
+  std::puts("SANITIZE_OK");
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_native_asan_ubsan_end_to_end(tmp_path):
+    driver = tmp_path / "driver.cc"
+    driver.write_text(DRIVER)
+    exe = tmp_path / "sanitize_driver"
+    cmd = [
+        "g++", "-O1", "-g", "-std=c++17", "-fopenmp",
+        "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+        os.path.join(NATIVE, "ckks.cc"),
+        os.path.join(NATIVE, "hostfold.cc"),
+        str(driver), "-o", str(exe),
+    ]
+    build = subprocess.run(cmd, capture_output=True, text=True)
+    assert build.returncode == 0, f"sanitizer build failed:\n{build.stderr}"
+
+    key_dir = tmp_path / "keys"
+    key_dir.mkdir()
+    run = subprocess.run(
+        [str(exe), str(key_dir)], capture_output=True, text=True,
+        env={**os.environ, "OMP_NUM_THREADS": "4",
+             "ASAN_OPTIONS": "detect_leaks=1"})
+    assert run.returncode == 0, (
+        f"sanitized run failed rc={run.returncode}\n"
+        f"stdout:{run.stdout}\nstderr:{run.stderr[-2000:]}")
+    assert "SANITIZE_OK" in run.stdout
