@@ -10,8 +10,12 @@ online (flash-attention style m/l/o carry). The result is mathematically
 EXACT attention over the full sequence with per-device memory O(L/sp) —
 attention never materializes an (L, L) matrix on any chip.
 
-Differentiable: the backward pass flows through ``lax.scan`` + ``ppermute``
-reverse collectives automatically.
+Differentiable with flash-style memory: the forward saves only the local
+(q, k, v, o, logsumexp) — O(L/sp·D) per device — and a custom VJP re-runs
+the ring in backward, rotating K/V again and shipping the dK/dV
+accumulators around with their blocks. (Plain autodiff through the forward
+scan would checkpoint the rotated K/V carries at every hop: O(L·D) per
+device, defeating sequence parallelism exactly when it matters.)
 """
 
 from __future__ import annotations
@@ -31,13 +35,8 @@ def _axis_size(axis_name: str) -> int:
     return jax.lax.axis_size(axis_name)
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
-    """Exact attention over the ring. Call INSIDE ``shard_map``.
-
-    Args: ``q``/``k``/``v`` of shape (B, H, Lc, D) — the LOCAL sequence
-    chunk; the global sequence length is ``Lc * axis_size(sp)`` and chunk
-    ``i`` holds positions ``[i*Lc, (i+1)*Lc)``.
-    """
+def _ring_forward(q, k, v, axis_name: str, causal: bool):
+    """Online-softmax ring forward → (normalized out [q.dtype], lse [f32])."""
     n = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, H, Lc, D = q.shape
@@ -94,7 +93,108 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     l0 = jnp.zeros((B, H, Lc), jnp.float32)
     (o, m, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(n))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+def _ring_backward(q, k, v, o, lse, g, axis_name: str, causal: bool):
+    """Flash-style ring backward. dQ accumulates locally; dK/dV accumulators
+    ride the ring WITH their K/V blocks (one extra ppermute pair per hop)
+    and arrive home after the full rotation. Probabilities are recomputed
+    from the forward's lse — nothing quadratic, and nothing O(L·D) beyond
+    the local chunks, is ever stored."""
+    n = _axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, Lc, D = q.shape
+    scale = float(1.0 / np.sqrt(D))
+    q_pos = my_idx * Lc + jnp.arange(Lc)
+    g32 = g.astype(jnp.float32)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-normalization cotangent
+    delta = jnp.sum(g32 * o.astype(jnp.float32), axis=-1)   # (B, H, Lc)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        owner = (my_idx - i) % n
+        k_pos = owner * Lc + jnp.arange(Lc)
+
+        def compute(args):
+            dq, dk_blk, dv_blk = args
+            s = jax.lax.dot_general(
+                q, k_blk, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(mask, s, _NEG)
+            p = jnp.exp(s - lse[..., None])                 # (B, H, Lq, Lk)
+            if causal:
+                p = jnp.where(mask, p, 0.0)
+            # dV_blk += P^T @ dO
+            dv_blk = dv_blk + jax.lax.dot_general(
+                p.astype(g.dtype), g, (((2,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                g, v_blk, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[..., None]) * scale
+            ds_c = ds.astype(q.dtype)
+            dq = dq + jax.lax.dot_general(
+                ds_c, k_blk, (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
+            # dK_blk += dS^T @ Q
+            dk_blk = dk_blk + jax.lax.dot_general(
+                ds_c, q, (((2,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)
+            return dq, dk_blk, dv_blk
+
+        if causal:
+            # fully-masked future blocks contribute nothing to any gradient
+            dq, dk_blk, dv_blk = jax.lax.cond(
+                owner > my_idx, lambda args: args, compute,
+                (dq, dk_blk, dv_blk))
+        else:
+            dq, dk_blk, dv_blk = compute((dq, dk_blk, dv_blk))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return (dq, k_next, v_next, dk_next, dv_next), None
+
+    zeros_kv = jnp.zeros((B, H, Lc, D), jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (jnp.zeros((B, H, Lc, D), jnp.float32), k, v,
+               zeros_kv, zeros_kv), jnp.arange(n))
+    # n rotations = identity: each dK/dV accumulator is home again
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """Exact attention over the ring. Call INSIDE ``shard_map``.
+
+    Args: ``q``/``k``/``v`` of shape (B, H, Lc, D) — the LOCAL sequence
+    chunk; the global sequence length is ``Lc * axis_size(sp)`` and chunk
+    ``i`` holds positions ``[i*Lc, (i+1)*Lc)``. Training memory is
+    O(Lc·D): the VJP re-rotates K/V instead of checkpointing ring carries.
+    """
+    out, _ = _ring_forward(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_forward(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, res, g):
+    q, k, v, out, lse = res
+    return _ring_backward(q, k, v, out, lse, g, axis_name, causal)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
@@ -105,7 +205,9 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     ring schedule."""
     spec = P(None, None, axis_name, None)
     return jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
+        # positional call: custom_vjp functions reject keyword arguments
+        # under differentiation
+        lambda q, k, v: ring_attention(q, k, v, axis_name, causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
 

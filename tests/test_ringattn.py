@@ -32,15 +32,22 @@ def test_ring_matches_full_attention(qkv, causal, sp):
                                atol=1e-5, rtol=1e-5)
 
 
-def test_ring_gradients_match(qkv):
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match(qkv, causal):
+    """Both branches of the hand-written ring VJP (causal skip vs full),
+    with a NON-uniform cotangent — a .sum() loss (g = ones) can mask
+    cotangent-indexing transpositions."""
     mesh = build_mesh(MeshConfig(("sp",), (4,)), devices=jax.devices()[:4])
     q, k, v = qkv
+    weight = jnp.asarray(
+        np.random.default_rng(5).standard_normal(q.shape), jnp.float32)
 
     def ring_loss(q, k, v):
-        return make_ring_attention(mesh, causal=True)(q, k, v).sum()
+        return (make_ring_attention(mesh, causal=causal)(q, k, v)
+                * weight).sum()
 
     def full_loss(q, k, v):
-        return reference_attention(q, k, v, causal=True).sum()
+        return (reference_attention(q, k, v, causal=causal) * weight).sum()
 
     g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
     g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
