@@ -73,8 +73,13 @@ def ensure_backend(max_attempts: int = 3):
     the first in-process device op — this makes that failure mode recoverable.
     """
     info = {"probe_attempts": 0, "degraded_to_cpu": False}
-    if os.environ.get("JAX_PLATFORMS"):
-        return info  # explicit platform: honor it, no probing
+    plat = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
+    if plat == "cpu":
+        return info  # explicit CPU: nothing to probe
+    # any accelerator platform — including one pinned via JAX_PLATFORMS
+    # (the driver env sets axon) — gets probed in a subprocess first: a
+    # wedged tunnel hangs the first in-process device op in native code,
+    # where not even the SIGALRM watchdog can interrupt it
     probe = ("import jax, jax.numpy as jnp; "
              "jnp.ones((8, 8)).sum().block_until_ready(); "
              "print(jax.default_backend())")

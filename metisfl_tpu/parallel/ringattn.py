@@ -129,9 +129,10 @@ def _ring_backward(q, k, v, o, lse, g, axis_name: str, causal: bool):
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]
                 s = jnp.where(mask, s, _NEG)
+            # masked scores are exactly _NEG and lse is finite (every causal
+            # row attends at least its diagonal), so exp underflows to 0.0
+            # — no second mask needed, unlike the forward's exp(s - m_new)
             p = jnp.exp(s - lse[..., None])                 # (B, H, Lq, Lk)
-            if causal:
-                p = jnp.where(mask, p, 0.0)
             # dV_blk += P^T @ dO
             dv_blk = dv_blk + jax.lax.dot_general(
                 p.astype(g.dtype), g, (((2,), (2,)), ((0, 1), (0, 1))),
