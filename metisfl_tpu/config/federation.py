@@ -85,6 +85,13 @@ class LearnerEndpoint:
     port: int = 0
     # per-learner dataset shard paths / recipe names (driver-side concern)
     dataset: Dict[str, Any] = field(default_factory=dict)
+    # Multi-host learner world: processes launched for this ONE learner.
+    # Rank 0 serves the federation; ranks 1..world_size-1 replay its compute
+    # calls (parallel/replicated.py). The local launcher starts all ranks on
+    # the endpoint host; true one-rank-per-host worlds are launched by the
+    # operator with the METISFL_JAX_* env vars.
+    world_size: int = 1
+    coordinator_port: int = 0                # 0 → driver picks a free port
 
 
 @dataclass

@@ -317,10 +317,52 @@ class DriverSession:
             # absolute paths (metisfl_tpu itself must be installed remotely)
             launcher.ship([recipe_path] + self._ssl_files()
                           + self._secure_files(idx))
+        env = {**self._base_env(), **self.learner_env}
+        world = max(1, int(getattr(ep, "world_size", 1)))
+        if world > 1:
+            # multi-host learner: one process per rank (rank 0 = the
+            # learner, others replay via parallel/replicated.py). All ranks
+            # need the recipe + the same jax.distributed world config.
+            port = ep.coordinator_port
+            is_local = ep.hostname in self._LOCAL_HOSTS
+            if not port:
+                if not is_local:
+                    # a port probed on the driver machine says nothing about
+                    # the remote host where rank 0's coordinator will bind
+                    raise ValueError(
+                        f"learner {idx}: world_size > 1 on remote host "
+                        f"{ep.hostname!r} requires an explicit "
+                        "coordinator_port")
+                import socket as _socket
+                with _socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                ep.coordinator_port = port
+            coord_host = "127.0.0.1" if is_local else ep.hostname
+            env = {**env,
+                   "METISFL_JAX_COORDINATOR": f"{coord_host}:{port}",
+                   "METISFL_JAX_NUM_PROCESSES": str(world)}
+            for rank in range(1, world):
+                rname = f"{name}_rank{rank}"
+                for old in [p for p in self._procs if p.name == rname]:
+                    # a relaunch must not orphan a live old follower (it
+                    # would keep holding the slice's devices while parked
+                    # on a dead coordinator's collective)
+                    if old.process.poll() is None:
+                        old.process.terminate()
+                        try:
+                            old.process.wait(timeout=5)
+                        except subprocess.TimeoutExpired:
+                            old.process.kill()
+                            old.process.wait(timeout=5)
+                self._procs = [p for p in self._procs if p.name != rname]
+                self._procs.append(launcher.launch(
+                    rname, argv,
+                    env={**env, "METISFL_JAX_PROCESS_ID": str(rank)}))
+            env["METISFL_JAX_PROCESS_ID"] = "0"
         # a relaunch replaces the tracked (dead) process of the same name
         self._procs = [p for p in self._procs if p.name != name]
-        proc = launcher.launch(name, argv,
-                               env={**self._base_env(), **self.learner_env})
+        proc = launcher.launch(name, argv, env=env)
         self._procs.append(proc)
         return proc
 
@@ -499,6 +541,12 @@ class DriverSession:
                     proc.process.wait(timeout=5)
                 except subprocess.TimeoutExpired:
                     proc.process.kill()
+                    try:
+                        # reap so returncode is recorded (kill() alone
+                        # leaves a zombie and returncode None)
+                        proc.process.wait(timeout=5)
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        pass
 
     def run(self) -> dict:
         """initialize → monitor → save stats → shutdown, one call."""

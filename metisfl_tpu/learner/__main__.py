@@ -95,6 +95,20 @@ def main(argv=None) -> int:
     test_ds = built[3] if len(built) > 3 else None
     secure_backend = built[4] if len(built) > 4 else None
 
+    # multi-host world: rank 0 continues as THE learner (gRPC + controller
+    # traffic) with its engine wrapped to broadcast every compute call;
+    # follower ranks replay those calls and never touch the federation
+    import jax as _jax
+    ds_by_name = {"train": train_ds, "val": val_ds, "test": test_ds}
+    if _jax.process_count() > 1:
+        from metisfl_tpu.parallel.replicated import follower_loop, lead
+        if _jax.process_index() > 0:
+            print(f"METISFL_TPU_FOLLOWER_READY "
+                  f"rank={_jax.process_index()}", flush=True)
+            follower_loop(model_ops, ds_by_name)
+            return 0
+        model_ops = lead(model_ops, ds_by_name)
+
     if secure_backend is None and args.secure_config:
         # driver-distributed secure material (reference ships HE keys to
         # learners the same way, driver_session.py:134-140)
@@ -138,22 +152,28 @@ def main(argv=None) -> int:
     port = server.start()
     print(f"METISFL_TPU_LEARNER_READY port={port}", flush=True)
 
-    reply = learner.join_federation(previous_id=previous_id,
-                                    auth_token=auth_token)
-    if args.credentials_dir:
-        save_credentials(args.credentials_dir, reply.learner_id,
-                         reply.auth_token)
-    print(f"METISFL_TPU_LEARNER_JOINED id={reply.learner_id} "
-          f"rejoined={reply.rejoined}", flush=True)
+    try:
+        reply = learner.join_federation(previous_id=previous_id,
+                                        auth_token=auth_token)
+        if args.credentials_dir:
+            save_credentials(args.credentials_dir, reply.learner_id,
+                             reply.auth_token)
+        print(f"METISFL_TPU_LEARNER_JOINED id={reply.learner_id} "
+              f"rejoined={reply.rejoined}", flush=True)
 
-    def _on_signal(signum, _frame):
-        logging.getLogger("metisfl_tpu.learner").info(
-            "received signal %d; shutting down", signum)
-        server.stop()
+        def _on_signal(signum, _frame):
+            logging.getLogger("metisfl_tpu.learner").info(
+                "received signal %d; shutting down", signum)
+            server.stop()
 
-    signal.signal(signal.SIGTERM, _on_signal)
-    signal.signal(signal.SIGINT, _on_signal)
-    server.wait_for_shutdown()
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        server.wait_for_shutdown()
+    finally:
+        # release follower ranks even when join fails (a stuck leader must
+        # not leave followers parked in their broadcast loop)
+        if hasattr(model_ops, "shutdown_replicas"):
+            model_ops.shutdown_replicas()
     return 0
 
 

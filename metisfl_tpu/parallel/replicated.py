@@ -1,0 +1,249 @@
+"""Multi-host learner execution: rank 0 leads, follower ranks replay.
+
+A learner that owns a multi-host TPU slice runs ONE process per host under
+``jax.distributed`` (env-configured — see ``platform.maybe_init_distributed``).
+Every process must execute the same jit programs in the same order for the
+slice's cross-host collectives to rendezvous. Only rank 0 talks to the
+federation (gRPC servicer, controller RPCs); this module makes the other
+ranks follow it:
+
+- ``lead(model_ops, datasets)`` wraps rank 0's engine. Each compute call
+  (``set_variables`` / ``train`` / ``evaluate`` / ``infer``) first
+  broadcasts an opcode + its arguments to all ranks (over the JAX
+  distributed runtime itself — no extra sockets), then runs locally; the
+  global-mesh collectives inside the computation line up with the
+  followers'.
+- ``follower_loop(model_ops, datasets)`` is the whole life of a follower
+  rank: receive, replay, repeat, until the leader broadcasts shutdown.
+
+The reference has no multi-host execution at all (its learner is one
+process per silo, SURVEY.md §2.3); this is the TPU-native scale-out for
+the in-learner sharded configs (Llama-LoRA and up).
+
+Constraints (asserted loudly, not silently wrong):
+- every rank's recipe must build the same module/mesh/datasets-by-name,
+  with per-name dataset lengths equal across ranks — step counts and eval
+  batch shapes derive from them, and a mismatch would desynchronize the
+  compiled programs;
+- mid-task cancellation is disabled in multi-host mode (a rank-0-only
+  cancel between steps would leave followers running ahead into a
+  collective no one else joins).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import logging
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger("metisfl_tpu.parallel.replicated")
+
+_SHUTDOWN = "shutdown"
+
+
+def _world():
+    import jax
+    return jax.process_count(), jax.process_index()
+
+
+def broadcast_bytes(data: Optional[bytes]) -> bytes:
+    """Broadcast a byte string from rank 0 to every rank. All ranks must
+    call this in step; followers pass ``None``. Two collective hops: the
+    length (fixed shape), then the padded payload."""
+    from jax.experimental import multihost_utils
+
+    if data is not None and len(data) >= 2**31:
+        # with jax_enable_x64 off (the default) the collective carries
+        # int32 — a longer length would silently wrap
+        raise ValueError(
+            f"broadcast payload of {len(data)} bytes exceeds the int32 "
+            "length limit; ship the model in parts")
+    n_local = np.asarray([0 if data is None else len(data)], np.int64)
+    n = int(multihost_utils.broadcast_one_to_all(n_local)[0])
+    buf = np.zeros((n,), np.uint8)
+    if data is not None:
+        if len(data) != n:  # pragma: no cover - rank-0 invariant
+            raise RuntimeError("broadcast length desync")
+        buf = np.frombuffer(data, np.uint8).copy()
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return out.tobytes()
+
+
+def _send(msg: dict) -> None:
+    from metisfl_tpu.comm.codec import dumps
+    broadcast_bytes(dumps(msg))
+
+
+def _recv() -> dict:
+    from metisfl_tpu.comm.codec import loads
+    return loads(broadcast_bytes(None))
+
+
+def _np_dumps(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _np_loads(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class LeaderOps:
+    """Rank-0 wrapper around ``FlaxModelOps``: broadcast, then compute."""
+
+    def __init__(self, inner, datasets: Dict[str, object]):
+        self.inner = inner
+        # ONE lock serializes every (broadcast + compute) pair: followers
+        # replay strictly in order, so concurrent leader calls (train on the
+        # task executor, eval on a digest thread, shutdown from main) must
+        # not interleave their collectives — interleaving desynchronizes
+        # the ring and deadlocks gloo
+        self._lock = threading.Lock()
+        self._warned_cancel = False
+        self._datasets = {name: ds for name, ds in datasets.items()
+                          if ds is not None}
+        self._names_by_id = {id(ds): name for name, ds in
+                             self._datasets.items()}
+
+    # -- passthroughs ------------------------------------------------------
+    @property
+    def variables(self):
+        return self.inner.variables
+
+    def get_variables(self):
+        return self.inner.get_variables()
+
+    @property
+    def module(self):
+        return self.inner.module
+
+    # -- replicated calls --------------------------------------------------
+    def set_variables(self, variables) -> None:
+        from metisfl_tpu.tensor.pytree import pack_model
+        with self._lock:
+            _send({"op": "set_variables", "blob": pack_model(variables)})
+            self.inner.set_variables(variables)
+
+    def _dataset_name(self, ds) -> str:
+        name = self._names_by_id.get(id(ds))
+        if name is None:
+            raise ValueError(
+                "multi-host training requires datasets registered at wrap "
+                f"time; got an unknown dataset object (have "
+                f"{sorted(self._datasets)})")
+        return name
+
+    def train(self, dataset, params_cfg, cancel_event=None):
+        name = self._dataset_name(dataset)
+        if cancel_event is not None and not self._warned_cancel:
+            # once per wrapper: the federation path passes a cancel event
+            # on EVERY task, and a per-call warning would bury real ones
+            self._warned_cancel = True
+            logger.warning(
+                "multi-host mode: mid-task cancellation disabled (a rank-0 "
+                "cancel would desynchronize follower collectives)")
+        with self._lock:
+            _send({"op": "train", "dataset": name,
+                   "expected_len": len(dataset),
+                   "params": dataclasses.asdict(params_cfg)})
+            return self.inner.train(dataset, params_cfg, cancel_event=None)
+
+    def evaluate(self, dataset, batch_size: int = 256, metrics=None,
+                 variables=None):
+        from metisfl_tpu.tensor.pytree import pack_model
+        name = self._dataset_name(dataset)
+        with self._lock:
+            _send({"op": "evaluate", "dataset": name,
+                   "expected_len": len(dataset),
+                   "batch_size": int(batch_size),
+                   "metrics": list(metrics or []),
+                   "blob": pack_model(variables) if variables is not None
+                   else b""})
+            return self.inner.evaluate(dataset, batch_size, metrics,
+                                       variables=variables)
+
+    def infer(self, x, batch_size: int = 256, variables=None):
+        from metisfl_tpu.tensor.pytree import pack_model
+        with self._lock:
+            _send({"op": "infer", "x": _np_dumps(x),
+                   "batch_size": int(batch_size),
+                   "blob": pack_model(variables) if variables is not None
+                   else b""})
+            return self.inner.infer(x, batch_size, variables=variables)
+
+    def shutdown_replicas(self) -> None:
+        """Release follower ranks (their loop returns). Waits for any
+        in-flight replicated call so the shutdown broadcast cannot
+        interleave with its collectives."""
+        with self._lock:
+            _send({"op": _SHUTDOWN})
+
+
+def lead(model_ops, datasets: Dict[str, object]):
+    """Wrap rank 0's engine for multi-host replay; identity in a
+    single-process world (no broadcast overhead)."""
+    count, index = _world()
+    if count == 1:
+        return model_ops
+    if index != 0:
+        raise RuntimeError("lead() is for rank 0; followers run "
+                           "follower_loop()")
+    return LeaderOps(model_ops, datasets)
+
+
+def follower_loop(model_ops, datasets: Dict[str, object]) -> None:
+    """Replay the leader's compute calls until shutdown. The entire life of
+    a follower rank."""
+    from metisfl_tpu.tensor.pytree import unpack_model
+
+    count, index = _world()
+    if index == 0:
+        raise RuntimeError("follower_loop() is for ranks > 0")
+    datasets = {name: ds for name, ds in datasets.items() if ds is not None}
+    logger.info("follower rank %d/%d ready", index, count)
+    while True:
+        msg = _recv()
+        op = msg["op"]
+        if op == _SHUTDOWN:
+            logger.info("follower rank %d shutting down", index)
+            return
+        if op == "set_variables":
+            model_ops.set_variables(
+                unpack_model(msg["blob"], model_ops.variables))
+            continue
+        ds = datasets.get(msg["dataset"]) if "dataset" in msg else None
+        if "dataset" in msg:
+            if ds is None:
+                raise RuntimeError(
+                    f"leader referenced dataset {msg['dataset']!r} that "
+                    f"this rank does not hold (have {sorted(datasets)})")
+            if len(ds) != msg["expected_len"]:
+                raise RuntimeError(
+                    f"dataset {msg['dataset']!r} length mismatch: leader "
+                    f"{msg['expected_len']}, rank {index} {len(ds)} — "
+                    "programs would desynchronize")
+        if op == "train":
+            from metisfl_tpu.comm.messages import TrainParams
+            params = TrainParams(**msg["params"])
+            if params.profile_dir:
+                # leader-relative paths do not exist here
+                params = dataclasses.replace(params, profile_dir="")
+            model_ops.train(ds, params, cancel_event=None)
+        elif op == "evaluate":
+            variables = (unpack_model(msg["blob"], model_ops.variables)
+                         if msg["blob"] else None)
+            model_ops.evaluate(ds, msg["batch_size"],
+                               list(msg["metrics"]) or None,
+                               variables=variables)
+        elif op == "infer":
+            variables = (unpack_model(msg["blob"], model_ops.variables)
+                         if msg["blob"] else None)
+            model_ops.infer(_np_loads(msg["x"]), msg["batch_size"],
+                            variables=variables)
+        else:  # pragma: no cover - future ops
+            raise RuntimeError(f"unknown replicated op {op!r}")

@@ -36,17 +36,12 @@ def maybe_init_distributed() -> bool:
             f"(got NUM_PROCESSES={os.environ.get('METISFL_JAX_NUM_PROCESSES')!r}, "
             f"PROCESS_ID={os.environ.get('METISFL_JAX_PROCESS_ID')!r})"
         ) from exc
-    if num != 1 or pid != 0:
-        # Every rank must execute the SAME jit programs for the slice's
-        # collectives to rendezvous; a follower-rank task-broadcast loop is
-        # not implemented yet, so a >1-process world cannot work — follower
-        # ranks would either register as spurious learners (hanging the
-        # first collective) or exit and leave rank 0's initialize() blocked
-        # waiting for them. Refuse the whole launch loudly instead.
+    if num < 1 or not (0 <= pid < num):
         raise RuntimeError(
-            "multi-host learner worlds (METISFL_JAX_NUM_PROCESSES > 1) are "
-            "not supported yet — the follower-rank task broadcast is "
-            "unimplemented. Run one single-process learner per host slice.")
+            f"invalid multi-host world: NUM_PROCESSES={num}, PROCESS_ID={pid}")
+    # Multi-process worlds: rank 0 serves the federation; ranks > 0 replay
+    # its compute calls via parallel/replicated.py (the learner __main__
+    # branches on jax.process_index() after this returns).
     import jax
 
     jax.distributed.initialize(coordinator_address=coordinator,
