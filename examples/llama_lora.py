@@ -30,6 +30,9 @@ def main() -> int:
     parser.add_argument("--vocab", type=int, default=256)
     parser.add_argument("--seq-len", type=int, default=32)
     parser.add_argument("--lora-rank", type=int, default=8)
+    parser.add_argument("--scan-chunk", type=int, default=1,
+                        help="fuse this many local steps into one compiled "
+                             "scan program (dispatch amortization on TPU)")
     parser.add_argument("--dp", type=int, default=2)
     parser.add_argument("--tp", type=int, default=0,
                         help="0 = absorb remaining devices")
@@ -71,7 +74,7 @@ def main() -> int:
     config = FederationConfig(
         aggregation=AggregationConfig(scaler="participants"),
         train=TrainParams(batch_size=16, local_steps=4, learning_rate=0.01,
-                          optimizer="adam"),
+                          optimizer="adam", scan_chunk=args.scan_chunk),
         eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=args.rounds),
     )

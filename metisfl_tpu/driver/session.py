@@ -46,6 +46,27 @@ class _Proc:
     log_path: str
 
 
+def _terminate_process(process: subprocess.Popen,
+                       grace_s: float = 5.0) -> None:
+    """terminate → wait → kill → reap, never raising: a process stuck in
+    the kernel (e.g. D-state on a wedged device ioctl) must not abort the
+    caller's loop, and the final wait records returncode instead of
+    leaving a zombie."""
+    if process.poll() is not None:
+        return
+    process.terminate()
+    try:
+        process.wait(timeout=grace_s)
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    process.kill()
+    try:
+        process.wait(timeout=grace_s)
+    except subprocess.TimeoutExpired:  # pragma: no cover - unkillable
+        pass
+
+
 class LocalLauncher:
     """Launch federation processes as localhost subprocesses."""
 
@@ -348,13 +369,7 @@ class DriverSession:
                     # a relaunch must not orphan a live old follower (it
                     # would keep holding the slice's devices while parked
                     # on a dead coordinator's collective)
-                    if old.process.poll() is None:
-                        old.process.terminate()
-                        try:
-                            old.process.wait(timeout=5)
-                        except subprocess.TimeoutExpired:
-                            old.process.kill()
-                            old.process.wait(timeout=5)
+                    _terminate_process(old.process)
                 self._procs = [p for p in self._procs if p.name != rname]
                 self._procs.append(launcher.launch(
                     rname, argv,
@@ -536,17 +551,7 @@ class DriverSession:
             try:
                 proc.process.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
-                proc.process.terminate()
-                try:
-                    proc.process.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    proc.process.kill()
-                    try:
-                        # reap so returncode is recorded (kill() alone
-                        # leaves a zombie and returncode None)
-                        proc.process.wait(timeout=5)
-                    except subprocess.TimeoutExpired:  # pragma: no cover
-                        pass
+                _terminate_process(proc.process)
 
     def run(self) -> dict:
         """initialize → monitor → save stats → shutdown, one call."""

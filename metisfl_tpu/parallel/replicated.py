@@ -105,6 +105,15 @@ class LeaderOps:
         # the ring and deadlocks gloo
         self._lock = threading.Lock()
         self._warned_cancel = False
+        # a leader-side failure AFTER an op was broadcast means followers
+        # completed work the leader did not (their dataset streams advanced
+        # past the leader's) — the world is desynchronized. Per the module
+        # contract, fail every subsequent call loudly instead of silently
+        # training on mismatched batch streams.
+        self._poisoned: Optional[str] = None
+        # strong ref: keeps the object alive so the `is` identity check in
+        # evaluate() can never alias a recycled id
+        self._last_eval_vars = None
         self._datasets = {name: ds for name, ds in datasets.items()
                           if ds is not None}
         self._names_by_id = {id(ds): name for name, ds in
@@ -122,12 +131,32 @@ class LeaderOps:
     def module(self):
         return self.inner.module
 
+    def _check_poisoned(self) -> None:
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "multi-host world desynchronized by an earlier leader-side "
+                f"failure ({self._poisoned}); restart the learner world")
+
+    def _run_replicated(self, fn, what: str):
+        """Leader-local compute right after its broadcast: a failure here is
+        a world desync (followers ran it, we did not) — poison the wrapper
+        so nothing silently continues."""
+        try:
+            return fn()
+        except BaseException as exc:
+            self._poisoned = f"{what}: {exc!r}"[:300]
+            logger.error("leader-side %s failed after broadcast; "
+                         "poisoning the world", what)
+            raise
+
     # -- replicated calls --------------------------------------------------
     def set_variables(self, variables) -> None:
         from metisfl_tpu.tensor.pytree import pack_model
         with self._lock:
+            self._check_poisoned()
             _send({"op": "set_variables", "blob": pack_model(variables)})
-            self.inner.set_variables(variables)
+            self._run_replicated(
+                lambda: self.inner.set_variables(variables), "set_variables")
 
     def _dataset_name(self, ds) -> str:
         name = self._names_by_id.get(id(ds))
@@ -148,33 +177,51 @@ class LeaderOps:
                 "multi-host mode: mid-task cancellation disabled (a rank-0 "
                 "cancel would desynchronize follower collectives)")
         with self._lock:
+            self._check_poisoned()
             _send({"op": "train", "dataset": name,
                    "expected_len": len(dataset),
                    "params": dataclasses.asdict(params_cfg)})
-            return self.inner.train(dataset, params_cfg, cancel_event=None)
+            return self._run_replicated(
+                lambda: self.inner.train(dataset, params_cfg,
+                                         cancel_event=None), "train")
 
     def evaluate(self, dataset, batch_size: int = 256, metrics=None,
                  variables=None):
         from metisfl_tpu.tensor.pytree import pack_model
         name = self._dataset_name(dataset)
         with self._lock:
-            _send({"op": "evaluate", "dataset": name,
+            self._check_poisoned()
+            # an EvalTask evaluates the SAME variables once per dataset
+            # (learner.py evaluate loop) — re-broadcasting a Llama-scale
+            # blob per dataset would triple the cross-host bytes, so repeat
+            # trees (checked by identity against a strong ref) ship as a
+            # "reuse the last ones" marker instead
+            cached = variables is not None and variables is self._last_eval_vars
+            msg = {"op": "evaluate", "dataset": name,
                    "expected_len": len(dataset),
                    "batch_size": int(batch_size),
                    "metrics": list(metrics or []),
-                   "blob": pack_model(variables) if variables is not None
-                   else b""})
-            return self.inner.evaluate(dataset, batch_size, metrics,
-                                       variables=variables)
+                   "vars_cached": cached,
+                   "blob": b"" if (cached or variables is None)
+                   else pack_model(variables)}
+            _send(msg)
+            if variables is not None:
+                self._last_eval_vars = variables
+            return self._run_replicated(
+                lambda: self.inner.evaluate(dataset, batch_size, metrics,
+                                            variables=variables), "evaluate")
 
     def infer(self, x, batch_size: int = 256, variables=None):
         from metisfl_tpu.tensor.pytree import pack_model
         with self._lock:
+            self._check_poisoned()
             _send({"op": "infer", "x": _np_dumps(x),
                    "batch_size": int(batch_size),
                    "blob": pack_model(variables) if variables is not None
                    else b""})
-            return self.inner.infer(x, batch_size, variables=variables)
+            return self._run_replicated(
+                lambda: self.inner.infer(x, batch_size, variables=variables),
+                "infer")
 
     def shutdown_replicas(self) -> None:
         """Release follower ranks (their loop returns). Waits for any
@@ -205,6 +252,7 @@ def follower_loop(model_ops, datasets: Dict[str, object]) -> None:
     if index == 0:
         raise RuntimeError("follower_loop() is for ranks > 0")
     datasets = {name: ds for name, ds in datasets.items() if ds is not None}
+    last_eval_vars = None   # mirrors the leader's eval-variables cache
     logger.info("follower rank %d/%d ready", index, count)
     while True:
         msg = _recv()
@@ -235,8 +283,17 @@ def follower_loop(model_ops, datasets: Dict[str, object]) -> None:
                 params = dataclasses.replace(params, profile_dir="")
             model_ops.train(ds, params, cancel_event=None)
         elif op == "evaluate":
-            variables = (unpack_model(msg["blob"], model_ops.variables)
-                         if msg["blob"] else None)
+            if msg.get("vars_cached"):
+                if last_eval_vars is None:
+                    raise RuntimeError(
+                        "leader marked eval variables as cached but this "
+                        "rank holds none — replay desynchronized")
+                variables = last_eval_vars
+            elif msg["blob"]:
+                variables = unpack_model(msg["blob"], model_ops.variables)
+                last_eval_vars = variables
+            else:
+                variables = None
             model_ops.evaluate(ds, msg["batch_size"],
                                list(msg["metrics"]) or None,
                                variables=variables)
