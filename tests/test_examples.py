@@ -100,3 +100,18 @@ def test_ladder_rungs_execute(tmp_path):
         assert record["round_wall_clock_s"][0] > 0
     for key in ("vit", "bert"):
         assert os.path.exists(tmp_path / f"experiment_{key}.json")
+
+
+def test_multihost_learner_example(tmp_path):
+    """The multi-host learner example completes rounds with a 2-process
+    world and both ranks exit cleanly."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "multihost_learner.py"),
+         "--world", "2", "--rounds", "2", "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=360, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "completed" in proc.stdout
+    assert "ERROR" not in proc.stdout  # exits 1 on incomplete rounds
+    assert "learner_0_rank1: exit 0" in proc.stdout
