@@ -105,9 +105,9 @@ def main() -> int:
         session.save_experiment()
     finally:
         session.shutdown_federation()
-    for p in session._procs:
-        if "_rank" in p.name:
-            print(f"{p.name}: exit {p.process.returncode}")
+    for name, code in sorted(session.process_exit_codes().items()):
+        if "_rank" in name:
+            print(f"{name}: exit {code}")
     if rounds < args.rounds:
         print(f"ERROR: only {rounds}/{args.rounds} rounds completed")
         return 1

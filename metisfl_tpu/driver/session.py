@@ -462,6 +462,11 @@ class DriverSession:
     def get_statistics(self) -> dict:
         return self._client.get_statistics()
 
+    def process_exit_codes(self) -> Dict[str, Optional[int]]:
+        """name → exit code (None while running) for every launched
+        federation process, incl. multi-host follower ranks."""
+        return {p.name: p.process.poll() for p in self._procs}
+
     def run_inference(self, learner_index: int = 0, inputs=None,
                       dataset: str = "test", batch_size: int = 256,
                       max_examples: int = 0, timeout_s: float = 120.0):
@@ -512,7 +517,18 @@ class DriverSession:
             json.dump(self.get_statistics(), f, indent=2, default=str)
         return path
 
-    def shutdown_federation(self, timeout_s: float = 15.0) -> None:
+    def shutdown_federation(self, timeout_s: Optional[float] = None) -> None:
+        # Default drain budget: 15 s, or 90 s when any learner is a
+        # multi-host world — its leader can only release the followers
+        # after an in-flight replicated task drains (the release broadcast
+        # serializes behind the task's lock, and a cold jit compile inside
+        # that task can take tens of seconds), and killing followers
+        # earlier aborts them mid-collective. An explicit timeout_s is
+        # honored as given.
+        if timeout_s is None:
+            multihost = any(int(getattr(ep, "world_size", 1)) > 1
+                            for ep in self.config.learners)
+            timeout_s = 90.0 if multihost else 15.0
         # learners first (reference _shutdown :344-364), then the controller —
         # dialing the endpoints learners actually registered on join, not
         # assumed port arithmetic

@@ -104,11 +104,21 @@ class Attention(nn.Module):
     sp_axis: str = "sp"
     use_flash: bool = False
     dtype: Any = None
+    # Grouped-query attention (Llama-3 style): K/V project to kv_heads
+    # groups (shrinking the wk/wv kernels and the shipped/optimizer state
+    # by heads/kv_heads) and are broadcast across each group's query heads
+    # at compute time. 0 → kv_heads = heads (plain MHA); 1 = MQA.
+    kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         B, L, _ = x.shape
         head_dim = self.dim // self.heads
+        kv_heads = self.kv_heads or self.heads
+        if kv_heads <= 0 or self.heads % kv_heads:
+            raise ValueError(
+                f"heads ({self.heads}) must be a multiple of kv_heads "
+                f"({kv_heads})")
         if self.dropout > 0.0 and (self.use_flash or self.sp_mesh is not None):
             # neither kernelized path materializes the (L, L) weight matrix,
             # so attention-weight dropout cannot be applied there
@@ -116,22 +126,34 @@ class Attention(nn.Module):
                 "attention dropout > 0 is only supported on the dense "
                 "attention path; set dropout=0 or disable use_flash/sp_mesh")
 
-        def proj(name, rank=0):
-            return LoRADense(self.dim, rank=rank, use_bias=False,
+        def proj(name, features, rank=0):
+            return LoRADense(features, rank=rank, use_bias=False,
                              dtype=self.dtype, name=name)
 
         # LoRA on q/v only (standard practice)
-        q = proj("wq", self.lora_rank)(x)
-        k = proj("wk")(x)
-        v = proj("wv", self.lora_rank)(x)
+        q = proj("wq", self.dim, self.lora_rank)(x)
+        k = proj("wk", kv_heads * head_dim)(x)
+        v = proj("wv", kv_heads * head_dim, self.lora_rank)(x)
         q = q.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
-        k = k.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
-        v = v.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, kv_heads, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, kv_heads, head_dim).transpose(0, 2, 1, 3)
         if self.rotary:
             positions = jnp.arange(L, dtype=jnp.float32)
             dt = q.dtype
             q = _rotary(q, positions).astype(dt)
             k = _rotary(k, positions).astype(dt)
+        if kv_heads != self.heads:
+            # broadcast each KV group across its query heads AFTER rotary
+            # (rotary is per-head pointwise, so they commute — this keeps
+            # the rotary work at kv_heads size). What GQA buys here is the
+            # smaller wk/wv params + optimizer/wire state; at compute time
+            # K/V are materialized at full head count for all three
+            # attention paths (XLA can fuse the repeat into the dense
+            # einsums, but the flash kernel and the ring's ppermute hops
+            # consume — and move — full-size K/V)
+            group = self.heads // kv_heads
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
         if self.sp_mesh is not None:
             from metisfl_tpu.parallel.ringattn import make_ring_attention
             out = make_ring_attention(self.sp_mesh, self.sp_axis,
@@ -288,12 +310,14 @@ class DecoderBlock(nn.Module):
     # > 0 replaces the SwiGLU FFN with a Switch MoE of this many experts
     moe_experts: int = 0
     dtype: Any = None
+    kv_heads: int = 0           # grouped-query attention; 0 = MHA
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
                           lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
                           use_flash=self.use_flash, dtype=self.dtype,
+                          kv_heads=self.kv_heads,
                           name="attn")(
             nn.RMSNorm(dtype=self.dtype)(x), train=train)
         if self.moe_experts > 0:
@@ -394,6 +418,8 @@ class LlamaLite(nn.Module):
     # computation dtype; jnp.bfloat16 is the MXU-native mixed-precision mode
     # (params stay fp32, activations/matmuls run bf16; loss/logits fp32)
     dtype: Any = None
+    # grouped-query attention (Llama-3 style): K/V heads; 0 = heads (MHA)
+    kv_heads: int = 0
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -408,6 +434,7 @@ class LlamaLite(nn.Module):
                           use_flash=self.use_flash,
                           moe_experts=self.moe_experts,
                           dtype=self.dtype,
+                          kv_heads=self.kv_heads,
                           name=f"block_{i}")(x, train)
         x = nn.RMSNorm(dtype=self.dtype)(x)
         # logits in fp32: softmax-cross-entropy over a large vocab is

@@ -190,6 +190,5 @@ def test_federation_with_multihost_learner(tmp_path):
     finally:
         session.shutdown_federation()
     # the follower rank must have exited cleanly (not killed)
-    follower = [p for p in session._procs if p.name.endswith("_rank1")]
-    assert follower and follower[0].process.returncode == 0, (
-        follower and follower[0].process.returncode)
+    codes = session.process_exit_codes()
+    assert codes.get("learner_0_rank1") == 0, codes
