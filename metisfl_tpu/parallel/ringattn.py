@@ -35,11 +35,27 @@ def _axis_size(axis_name: str) -> int:
     return jax.lax.axis_size(axis_name)
 
 
+def _group(q, kv_heads: int):
+    """(B, Hq, Lc, D) → (B, Hkv, G, Lc, D); Hq = Hkv·G (grouped-query)."""
+    B, Hq, Lc, D = q.shape
+    if Hq % kv_heads:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of KV heads ({kv_heads})")
+    return q.reshape(B, kv_heads, Hq // kv_heads, Lc, D)
+
+
 def _ring_forward(q, k, v, axis_name: str, causal: bool):
-    """Online-softmax ring forward → (normalized out [q.dtype], lse [f32])."""
+    """Online-softmax ring forward → (normalized out [q.dtype], lse [f32]).
+
+    Supports grouped-query attention natively: ``k``/``v`` may carry fewer
+    heads than ``q`` (Hq a multiple of Hkv) — the K/V blocks rotate around
+    the ring AT KV-HEAD SIZE, so GQA's bandwidth saving applies to the ICI
+    traffic itself, not just the projections."""
     n = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    B, H, Lc, D = q.shape
+    B, Hq, Lc, D = q.shape
+    Hkv = k.shape[1]
+    qg = _group(q, Hkv)                                     # (B,Hkv,G,Lc,D)
     scale = float(1.0 / np.sqrt(D))  # python float: weak type, no f64 promotion
     q_pos = my_idx * Lc + jnp.arange(Lc)                    # global q positions
 
@@ -56,9 +72,8 @@ def _ring_forward(q, k, v, axis_name: str, causal: bool):
             # scores + online statistics in fp32 regardless of the compute
             # dtype — bf16 exp/normalize across ring steps compounds; the
             # score/PV matmuls still run MXU-native on the input dtype
-            s = jax.lax.dot_general(
-                q, k_blk, (((3,), (3,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32) * scale
+            s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_blk,
+                           preferred_element_type=jnp.float32) * scale
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]     # (Lc, Lc)
                 s = jnp.where(mask, s, _NEG)
@@ -70,9 +85,8 @@ def _ring_forward(q, k, v, axis_name: str, causal: bool):
                 p = jnp.where(mask, p, 0.0)
             corr = jnp.exp(m - m_new)
             l_new = l * corr + p.sum(axis=-1)
-            o_new = o * corr[..., None] + jax.lax.dot_general(
-                p.astype(v_blk.dtype), v_blk,
-                (((3,), (2,)), ((0, 1), (0, 1))),
+            o_new = o * corr[..., None] + jnp.einsum(
+                "bhgqk,bhkd->bhgqd", p.astype(v_blk.dtype), v_blk,
                 preferred_element_type=jnp.float32)
             return o_new, m_new, l_new
 
@@ -88,14 +102,14 @@ def _ring_forward(q, k, v, axis_name: str, causal: bool):
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_next, v_next), None
 
-    o0 = jnp.zeros(q.shape, jnp.float32)
-    m0 = jnp.full((B, H, Lc), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, Lc), jnp.float32)
+    o0 = jnp.zeros(qg.shape, jnp.float32)
+    m0 = jnp.full(qg.shape[:4], _NEG, jnp.float32)
+    l0 = jnp.zeros(qg.shape[:4], jnp.float32)
     (o, m, l, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v), jnp.arange(n))
     l_safe = jnp.maximum(l, 1e-30)
-    out = (o / l_safe[..., None]).astype(q.dtype)
-    lse = m + jnp.log(l_safe)
+    out = (o / l_safe[..., None]).astype(q.dtype).reshape(B, Hq, Lc, D)
+    lse = (m + jnp.log(l_safe)).reshape(B, Hq, Lc)
     return out, lse
 
 
@@ -107,12 +121,17 @@ def _ring_backward(q, k, v, o, lse, g, axis_name: str, causal: bool):
     the local chunks, is ever stored."""
     n = _axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
-    B, H, Lc, D = q.shape
+    B, Hq, Lc, D = q.shape
+    Hkv = k.shape[1]
+    qg = _group(q, Hkv)
+    gg = _group(g, Hkv)
     scale = float(1.0 / np.sqrt(D))
     q_pos = my_idx * Lc + jnp.arange(Lc)
-    g32 = g.astype(jnp.float32)
     # delta_i = rowsum(dO_i * O_i) — the softmax-normalization cotangent
-    delta = jnp.sum(g32 * o.astype(jnp.float32), axis=-1)   # (B, H, Lc)
+    delta = jnp.sum(gg.astype(jnp.float32)
+                    * _group(o, Hkv).astype(jnp.float32),
+                    axis=-1)                                # (B,Hkv,G,Lc)
+    lse_g = lse.reshape(delta.shape)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -123,31 +142,28 @@ def _ring_backward(q, k, v, o, lse, g, axis_name: str, causal: bool):
 
         def compute(args):
             dq, dk_blk, dv_blk = args
-            s = jax.lax.dot_general(
-                q, k_blk, (((3,), (3,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32) * scale
+            s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_blk,
+                           preferred_element_type=jnp.float32) * scale
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]
                 s = jnp.where(mask, s, _NEG)
             # masked scores are exactly _NEG and lse is finite (every causal
             # row attends at least its diagonal), so exp underflows to 0.0
             # — no second mask needed, unlike the forward's exp(s - m_new)
-            p = jnp.exp(s - lse[..., None])                 # (B, H, Lq, Lk)
-            # dV_blk += P^T @ dO
-            dv_blk = dv_blk + jax.lax.dot_general(
-                p.astype(g.dtype), g, (((2,), (2,)), ((0, 1), (0, 1))),
+            p = jnp.exp(s - lse_g[..., None])               # (B,Hkv,G,Lq,Lk)
+            # dV_blk += sum over the group of P^T @ dO
+            dv_blk = dv_blk + jnp.einsum(
+                "bhgqk,bhgqd->bhkd", p.astype(g.dtype), gg,
                 preferred_element_type=jnp.float32)
-            dp = jax.lax.dot_general(
-                g, v_blk, (((3,), (3,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32)
+            dp = jnp.einsum("bhgqd,bhkd->bhgqk", gg, v_blk,
+                            preferred_element_type=jnp.float32)
             ds = p * (dp - delta[..., None]) * scale
             ds_c = ds.astype(q.dtype)
-            dq = dq + jax.lax.dot_general(
-                ds_c, k_blk, (((3,), (2,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32)
-            # dK_blk += dS^T @ Q
-            dk_blk = dk_blk + jax.lax.dot_general(
-                ds_c, q, (((2,), (2,)), ((0, 1), (0, 1))),
+            dq = dq + jnp.einsum("bhgqk,bhkd->bhgqd", ds_c, k_blk,
+                                 preferred_element_type=jnp.float32)
+            # dK_blk += sum over the group of dS^T @ Q
+            dk_blk = dk_blk + jnp.einsum(
+                "bhgqk,bhgqd->bhkd", ds_c, qg,
                 preferred_element_type=jnp.float32)
             return dq, dk_blk, dv_blk
 
@@ -164,22 +180,26 @@ def _ring_backward(q, k, v, o, lse, g, axis_name: str, causal: bool):
         dv_next = jax.lax.ppermute(dv_blk, axis_name, perm)
         return (dq, k_next, v_next, dk_next, dv_next), None
 
-    zeros_kv = jnp.zeros((B, H, Lc, D), jnp.float32)
+    zeros_kv = jnp.zeros((B, Hkv, Lc, D), jnp.float32)
     (dq, _, _, dk, dv), _ = jax.lax.scan(
-        step, (jnp.zeros((B, H, Lc, D), jnp.float32), k, v,
+        step, (jnp.zeros(qg.shape, jnp.float32), k, v,
                zeros_kv, zeros_kv), jnp.arange(n))
     # n rotations = identity: each dK/dV accumulator is home again
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype).reshape(B, Hq, Lc, D),
+            dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     """Exact attention over the ring. Call INSIDE ``shard_map``.
 
-    Args: ``q``/``k``/``v`` of shape (B, H, Lc, D) — the LOCAL sequence
-    chunk; the global sequence length is ``Lc * axis_size(sp)`` and chunk
-    ``i`` holds positions ``[i*Lc, (i+1)*Lc)``. Training memory is
-    O(Lc·D): the VJP re-rotates K/V instead of checkpointing ring carries.
+    Args: ``q`` of shape (B, Hq, Lc, D); ``k``/``v`` of shape
+    (B, Hkv, Lc, D) with Hq a multiple of Hkv (grouped-query attention —
+    K/V rotate the ring at KV-head size, so GQA's bandwidth saving applies
+    to the ICI traffic). The LOCAL sequence chunk: the global length is
+    ``Lc * axis_size(sp)`` and chunk ``i`` holds positions
+    ``[i*Lc, (i+1)*Lc)``. Training memory is O(Lc·D): the VJP re-rotates
+    K/V instead of checkpointing ring carries.
     """
     out, _ = _ring_forward(q, k, v, axis_name, causal)
     return out
