@@ -116,6 +116,13 @@ class TrainParams(Message):
     # difference is pure overhead on TPU (and dominant when the chip sits
     # behind a network tunnel). Cancellation is checked between chunks.
     scan_chunk: int = 1
+    # Wire dtype for shipped model weights (a DType name: "bf16", "f16",
+    # "f32", ...). "" ships the training dtype unchanged. Casting to bf16
+    # halves federation bandwidth; aggregation still accumulates in f32 and
+    # each learner restores its own training dtypes on receipt, so only the
+    # wire representation is narrowed. Ignored under secure aggregation
+    # (HE/masking payloads have their own fixed-point encoding).
+    ship_dtype: str = ""
 
 
 @dataclass

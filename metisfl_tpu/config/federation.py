@@ -150,6 +150,11 @@ class FederationConfig:
             raise ValueError(f"unknown protocol {self.protocol!r}")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
+        if self.train.ship_dtype:
+            # a typo here would otherwise fail only after round 1's full
+            # local training, on every learner, every round
+            from metisfl_tpu.tensor.spec import resolve_ship_dtype
+            resolve_ship_dtype(self.train.ship_dtype)
 
     # -- wire/launch serialization ----------------------------------------
     def to_wire(self) -> bytes:

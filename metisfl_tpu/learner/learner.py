@@ -40,6 +40,7 @@ from metisfl_tpu.comm.messages import (
 )
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.ops import FlaxModelOps
+from metisfl_tpu.tensor.spec import resolve_ship_dtype
 from metisfl_tpu.tensor.pytree import (
     ModelBlob,
     named_tensors_to_pytree,
@@ -119,7 +120,11 @@ class Learner:
     # ------------------------------------------------------------------ #
 
     def _load_model(self, blob_bytes: bytes):
-        """Decode (and decrypt) a model blob → variables pytree."""
+        """Decode (and decrypt) a model blob → variables pytree, restored
+        to the engine's own training dtypes (a community model may arrive
+        in a narrower wire dtype — TrainParams.ship_dtype)."""
+        import jax
+
         blob = ModelBlob.from_bytes(blob_bytes)
         if blob.opaque:
             if self.secure_backend is None:
@@ -132,9 +137,12 @@ class Learner:
                               .reshape(spec.shape)))
         else:
             named = blob.tensors
-        return named_tensors_to_pytree(named, self._treedef_like)
+        tree = named_tensors_to_pytree(named, self._treedef_like)
+        return jax.tree.map(
+            lambda a, t: a if a.dtype == t.dtype else np.asarray(a, t.dtype),
+            tree, self._treedef_like)
 
-    def _dump_model(self) -> bytes:
+    def _dump_model(self, ship_dtype: str = "") -> bytes:
         named = pytree_to_named_tensors(self.model_ops.get_variables())
         if self.secure_backend is not None:
             from metisfl_tpu.tensor.spec import TensorSpec, wire_dtype_of, TensorKind
@@ -146,6 +154,14 @@ class Learner:
                                   TensorKind.CIPHERTEXT)
                 opaque[name] = (payload, spec)
             return ModelBlob(opaque=opaque).to_bytes()
+        if ship_dtype:
+            target = resolve_ship_dtype(ship_dtype)
+            # floats only: casting integer/bool state (step counters,
+            # quantized weights) through a float mantissa would corrupt it
+            named = [(n, np.asarray(a, target)
+                      if np.issubdtype(np.asarray(a).dtype, np.floating)
+                      and np.asarray(a).dtype != target else a)
+                     for n, a in named]
         return ModelBlob(tensors=named).to_bytes()
 
     # ------------------------------------------------------------------ #
@@ -166,6 +182,9 @@ class Learner:
         self._cancel.clear()
         try:
             params = task.params
+            if params.ship_dtype:
+                # fail a bad dtype name BEFORE paying for local training
+                resolve_ship_dtype(params.ship_dtype)
             if params.profile_dir:
                 # per-learner trace subdir: same-host learners start traces
                 # within the same second and jax.profiler session dirs are
@@ -191,7 +210,7 @@ class Learner:
                 learner_id=self.learner_id,
                 auth_token=self.auth_token,
                 round_id=task.round_id,
-                model=self._dump_model(),
+                model=self._dump_model(ship_dtype=params.ship_dtype),
                 num_train_examples=len(self.datasets["train"]),
                 completed_steps=out.completed_steps,
                 completed_epochs=out.completed_epochs,

@@ -214,3 +214,14 @@ def quantify(array: np.ndarray) -> dict:
         "zeros": int(array.size) - nonzero,
         "bytes": int(array.nbytes),
     }
+
+
+def resolve_ship_dtype(name: str) -> np.dtype:
+    """A DType name ("bf16", "f16", ...) → numpy dtype, with a clear
+    error listing the valid names (used by TrainParams.ship_dtype)."""
+    try:
+        return np_dtype_of(DType[name.upper()])
+    except KeyError:
+        raise ValueError(
+            f"unknown ship_dtype {name!r}; valid names: "
+            f"{[d.name.lower() for d in DType]}") from None

@@ -352,3 +352,72 @@ def test_restore_without_checkpoint_is_fresh_start(tmp_path):
         assert fed.controller.global_iteration == 0
     finally:
         fed.shutdown()
+
+
+def test_bf16_wire_shipping_narrows_bytes_not_training():
+    """TrainParams.ship_dtype="bf16": learners ship half-width weights, the
+    community model is stored/shipped in bf16 (half the federation
+    bandwidth), aggregation still accumulates in f32, and each learner's
+    engine keeps training in its own f32 params."""
+    import ml_dtypes
+
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=2, learning_rate=0.1,
+                          ship_dtype="bf16"),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=2),
+    )
+    fed = InProcessFederation(config)
+    shards, _ = _shards(2)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3), shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        fed.add_learner(engine, shard)
+    fed.seed_model(template)
+    fed.start()
+    try:
+        assert fed.wait_for_rounds(2, 120.0)
+        blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
+        dtypes = {np.asarray(a).dtype for _, a in blob.tensors}
+        assert dtypes == {np.dtype(ml_dtypes.bfloat16)}, dtypes
+    finally:
+        fed.shutdown()
+    # engines still hold f32 training params (wire narrowing only); read
+    # AFTER shutdown — a live round-3 task would hold donated buffers
+    for learner in fed.learners:
+        for leaf in __import__("jax").tree.leaves(
+                learner.model_ops.get_variables()):
+            assert np.asarray(leaf).dtype == np.float32
+
+
+def test_bad_ship_dtype_rejected_at_startup():
+    with pytest.raises(ValueError, match="ship_dtype"):
+        FederationConfig(train=TrainParams(ship_dtype="bfloat16"))
+
+
+def test_ship_dtype_skips_integer_state():
+    """Integer/bool leaves (counters, quantized state) must cross the wire
+    untouched — a float mantissa would corrupt them."""
+    from metisfl_tpu.learner.learner import Learner
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    class _Ops:
+        def get_variables(self):
+            return {"w": np.linspace(0, 1, 8, dtype=np.float32),
+                    "steps": np.array([1001, 70000], np.uint32)}
+
+    learner = Learner.__new__(Learner)
+    learner.model_ops = _Ops()
+    learner.secure_backend = None
+    blob = ModelBlob.from_bytes(learner._dump_model(ship_dtype="bf16"))
+    by_name = dict(blob.tensors)
+    import ml_dtypes
+    assert np.asarray(by_name["w"]).dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(np.asarray(by_name["steps"]),
+                                  [1001, 70000])
+    assert np.asarray(by_name["steps"]).dtype == np.uint32
