@@ -106,10 +106,10 @@ class Attention(nn.Module):
     dtype: Any = None
     # Grouped-query attention (Llama-3 style): K/V project to kv_heads
     # groups, shrinking the wk/wv kernels and the shipped/optimizer state
-    # by heads/kv_heads. On the dense and flash paths K/V broadcast across
-    # each group's query heads at compute time; the ring path is GQA-native
-    # and keeps K/V at kv-head size all the way through the ICI rotation.
-    # 0 → kv_heads = heads (plain MHA); 1 = MQA.
+    # by heads/kv_heads. The flash kernel and the ring schedule are
+    # GQA-native (K/V stay at kv-head size in HBM / on the ICI ring); only
+    # the dense path broadcasts K/V across each group's query heads at
+    # compute time. 0 → kv_heads = heads (plain MHA); 1 = MQA.
     kv_heads: int = 0
 
     @nn.compact
@@ -144,15 +144,15 @@ class Attention(nn.Module):
             dt = q.dtype
             q = _rotary(q, positions).astype(dt)
             k = _rotary(k, positions).astype(dt)
-        if kv_heads != self.heads and self.sp_mesh is None:
-            # broadcast each KV group across its query heads AFTER rotary
-            # (rotary is per-head pointwise, so they commute — this keeps
-            # the rotary work at kv_heads size). On the dense path XLA can
-            # fuse the repeat into the einsums; the flash kernel consumes
-            # materialized full-size K/V, so there GQA buys only the
-            # smaller wk/wv params + optimizer/wire state. The ring path
-            # skips this repeat entirely: it is GQA-native, rotating K/V
-            # blocks over ICI at kv_heads size.
+        if (kv_heads != self.heads and self.sp_mesh is None
+                and not self.use_flash):
+            # dense path only: broadcast each KV group across its query
+            # heads AFTER rotary (rotary is per-head pointwise, so they
+            # commute — this keeps the rotary work at kv_heads size); XLA
+            # fuses the repeat into the einsums. The flash kernel and the
+            # ring schedule are both GQA-native — K/V stay at kv-head size
+            # in HBM / on the ICI ring, mapped to query heads by kernel
+            # index arithmetic.
             group = self.heads // kv_heads
             k = jnp.repeat(k, group, axis=1)
             v = jnp.repeat(v, group, axis=1)

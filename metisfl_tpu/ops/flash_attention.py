@@ -148,13 +148,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_s, dv_s, *, causal: bool, scale: float,
-                kv_len: int, nq: int):
+                kv_len: int, nq: int, g_size: int = 1):
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
+    # sequential dim enumerates (q block × query-head group member): the
+    # dK/dV of one KV head accumulates over every query head in its group
+    t = pl.program_id(2)
+    qi = t // g_size
     blk_k = k_ref.shape[1]
     blk_q = q_ref.shape[1]
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
@@ -185,7 +188,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == nq * g_size - 1)
     def _store():
         dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
@@ -233,14 +236,32 @@ _SEQ_PARAMS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
+def _kv_head_index(Hq: int, Hkv: int):
+    """Flat (batch*q-head) grid index → flat (batch*kv-head) array index:
+    query head h reads KV group h // (Hq // Hkv). The KV tensors stay at
+    kv-head size in HBM — no repeat is ever materialized."""
+    G = Hq // Hkv
+    return lambda b: (b // Hq) * Hkv + (b % Hq) // G
+
+
+def _gqa_shapes(q, k):
+    B, Hq, L, D = q.shape
+    Hkv = k.shape[1]
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads ({Hq}) must be a multiple of KV heads ({Hkv})")
+    return B, Hq, Hkv, L, D
+
+
 def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
                    interpret: bool):
-    B, H, L, D = q.shape
+    B, H, Hkv, L, D = _gqa_shapes(q, k)
     blk_q, blk_k, Lp = _resolve_blocks(L, blk_q, blk_k)
     scale = float(1.0 / np.sqrt(D))
+    kv_ix = _kv_head_index(H, Hkv)
     qf = q.reshape(B * H, L, D)
-    kf = k.reshape(B * H, L, D)
-    vf = v.reshape(B * H, L, D)
+    kf = k.reshape(B * Hkv, L, D)
+    vf = v.reshape(B * Hkv, L, D)
     if Lp != L:
         pad = ((0, 0), (0, Lp - L), (0, 0))
         qf, kf, vf = (jnp.pad(x, pad) for x in (qf, kf, vf))
@@ -258,8 +279,8 @@ def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
         grid=(B * H, Lp // blk_q, nk),
         in_specs=[
             pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (kv_ix(b), j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (kv_ix(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
@@ -278,10 +299,12 @@ def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
                     blk_k: int, interpret: bool):
-    B, H, L, D = q.shape
+    B, H, Hkv, L, D = _gqa_shapes(q, k)
+    G = H // Hkv
     blk_q, blk_k, Lp = _resolve_blocks(L, blk_q, blk_k)
     scale = float(1.0 / np.sqrt(D))
-    flat = lambda x: x.reshape(B * H, L, D)
+    kv_ix = _kv_head_index(H, Hkv)
+    flat = lambda x: x.reshape(-1, L, D)
     qf, kf, vf, of, gf = map(flat, (q, k, v, out, g))
     # delta_i = rowsum(dO_i * O_i), lane-replicated like lse
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
@@ -301,8 +324,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (kv_ix(b), j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (kv_ix(b), j, 0)),
             pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, i, j: (b, i, 0)),
@@ -313,25 +336,31 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
+    # dK/dV accumulate over (q block × group member): grid b runs over
+    # B*Hkv KV heads; the sequential dim t = qi * G + member picks the
+    # matching query head's blocks
+    def q_ix(b, j, t):
+        return ((b // Hkv) * H + (b % Hkv) * G + t % G, t // G, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale,
-                          kv_len=L, nq=nq),
+                          kv_len=L, nq=nq, g_size=G),
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Lp, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Lp, D), v.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, Lp, D), k.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, Lp, D), v.dtype),
         ],
-        grid=(B * H, nk, nq),
+        grid=(B * Hkv, nk, nq * G),
         in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, blk_q, _STAT_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, D), q_ix),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, D), q_ix),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), q_ix),
+            pl.BlockSpec((1, blk_q, _STAT_LANES), q_ix),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, t: (b, j, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, D), jnp.float32),
@@ -341,8 +370,9 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
-    unflat = lambda x: x[:, :L].reshape(B, H, L, D)
-    return unflat(dq), unflat(dk), unflat(dv)
+    return (dq[:, :L].reshape(B, H, L, D),
+            dk[:, :L].reshape(B, Hkv, L, D),
+            dv[:, :L].reshape(B, Hkv, L, D))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -350,10 +380,13 @@ def flash_attention(q, k, v, causal: bool = False,
                     blk_q: Optional[int] = None,
                     blk_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
-    """Flash attention over (B, H, L, D). ``blk_q``/``blk_k=None`` auto-size
-    blocks (512 capped to the padded sequence). ``interpret=None``
-    auto-selects interpret mode off-TPU so the same call works in CI and on
-    chip."""
+    """Flash attention over (B, H, L, D). Grouped-query attention is
+    native: ``k``/``v`` may carry fewer heads than ``q`` (Hq a multiple of
+    Hkv) and stay at kv-head size in HBM — block index maps route each
+    query head to its KV group; dK/dV accumulate over the group in the
+    backward. ``blk_q``/``blk_k=None`` auto-size blocks (512 capped to the
+    padded sequence). ``interpret=None`` auto-selects interpret mode
+    off-TPU so the same call works in CI and on chip."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out, _ = _flash_forward(q, k, v, causal, blk_q, blk_k, interpret)
