@@ -192,3 +192,33 @@ def test_federation_with_multihost_learner(tmp_path):
     # the follower rank must have exited cleanly (not killed)
     codes = session.process_exit_codes()
     assert codes.get("learner_0_rank1") == 0, codes
+
+
+def test_leader_poisons_after_local_failure(monkeypatch):
+    """A leader-side failure after the op broadcast desynchronizes the
+    world (followers ran work the leader did not); every later call must
+    fail loudly instead of silently training on mismatched streams."""
+    from metisfl_tpu.parallel import replicated
+    from metisfl_tpu.parallel.replicated import LeaderOps
+
+    monkeypatch.setattr(replicated, "broadcast_bytes",
+                        lambda data=None: data or b"")
+
+    class _Dataset:
+        def __len__(self):
+            return 8
+
+    class _Inner:
+        def train(self, ds, params, cancel_event=None):
+            raise RuntimeError("leader-side OOM")
+
+    ds = _Dataset()
+    leader = LeaderOps(_Inner(), {"train": ds})
+    from metisfl_tpu.comm.messages import TrainParams
+
+    with pytest.raises(RuntimeError, match="leader-side OOM"):
+        leader.train(ds, TrainParams(local_steps=1))
+    with pytest.raises(RuntimeError, match="desynchronized"):
+        leader.train(ds, TrainParams(local_steps=1))
+    with pytest.raises(RuntimeError, match="desynchronized"):
+        leader.set_variables({})
