@@ -351,12 +351,13 @@ def bench_flash(seq: int = 2048, reps: int = 8):
             return out
         return jax.jit(run)
 
-    def timed(fn):
-        jax.block_until_ready(fn(*qkv))          # compile
+    def timed(fn, args=None):
+        args = qkv if args is None else args
+        jax.block_until_ready(fn(*args))         # compile
         times = []
         for _ in range(5):
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(*qkv))
+            jax.block_until_ready(fn(*args))
             times.append((time.perf_counter() - t0) * 1e3)
         return float(np.median(times))
 
@@ -370,6 +371,14 @@ def bench_flash(seq: int = 2048, reps: int = 8):
             out[f"attn_{label}_{tag}_ms"] = round(max(per_op, 0.0), 3)
             # one dispatch + ONE op execution (not dispatch alone)
             out[f"attn_{label}_{tag}_single_call_ms"] = round(t_one, 2)
+
+    # GQA-native flash (4 of 16 KV heads): K/V at quarter size in HBM,
+    # index-mapped to query heads inside the kernels
+    gqa_args = (qkv[0], qkv[1][:, :4], qkv[2][:, :4])
+    t_many = timed(chained_fwd(flash, reps), gqa_args)
+    t_one = timed(chained_fwd(flash, 1), gqa_args)
+    out["attn_flash_gqa4of16_fwd_ms"] = round(
+        max((t_many - t_one) / (reps - 1), 0.0), 3)
     return out
 
 
