@@ -298,7 +298,12 @@ def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
-                    blk_k: int, interpret: bool):
+                    blk_k: int, interpret: bool, delta=None):
+    """``lse`` (and the optional precomputed ``delta``) arrive in LOGICAL
+    layout — (B, H, L) fp32; the kernel HBM layout (padded, lane-
+    replicated) is produced here so callers never touch it. Padded query
+    rows get a large lse sentinel: their g/delta are zero, but a small pad
+    value could overflow p = exp(s - lse) into inf·0 = nan."""
     B, H, Hkv, L, D = _gqa_shapes(q, k)
     G = H // Hkv
     blk_q, blk_k, Lp = _resolve_blocks(L, blk_q, blk_k)
@@ -306,14 +311,19 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, blk_q: int,
     kv_ix = _kv_head_index(H, Hkv)
     flat = lambda x: x.reshape(-1, L, D)
     qf, kf, vf, of, gf = map(flat, (q, k, v, out, g))
-    # delta_i = rowsum(dO_i * O_i), lane-replicated like lse
-    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (B * H, L, _STAT_LANES))
+    if delta is None:
+        # delta_i = rowsum(dO_i * O_i)
+        delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                        axis=-1)
+    delta = jnp.asarray(delta, jnp.float32).reshape(B * H, L)
+    lse = jnp.asarray(lse, jnp.float32).reshape(B * H, L)
     if Lp != L:
         pad3 = ((0, 0), (0, Lp - L), (0, 0))
         qf, kf, vf, gf = (jnp.pad(x, pad3) for x in (qf, kf, vf, gf))
-        delta = jnp.pad(delta, pad3)
+        delta = jnp.pad(delta, ((0, 0), (0, Lp - L)))
+        lse = jnp.pad(lse, ((0, 0), (0, Lp - L)), constant_values=1e30)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, Lp, _STAT_LANES))
+    lse = jnp.broadcast_to(lse[..., None], (B * H, Lp, _STAT_LANES))
     nq = Lp // blk_q
     nk = Lp // blk_k
 
@@ -397,7 +407,10 @@ def _fwd(q, k, v, causal, blk_q, blk_k, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out, lse = _flash_forward(q, k, v, causal, blk_q, blk_k, interpret)
-    return out, (q, k, v, out, lse)
+    B, H, L, _ = q.shape
+    # residual lse in logical layout: 8x smaller than the kernel's
+    # lane-replicated padded buffer, and the layout knowledge stays here
+    return out, (q, k, v, out, lse[:, :L, 0].reshape(B, H, L))
 
 
 def _bwd(causal, blk_q, blk_k, interpret, res, g):

@@ -218,17 +218,171 @@ def _ring_bwd(axis_name, causal, res, g):
 ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
+def _ring_forward_pallas(q, k, v, axis_name: str, causal: bool):
+    """Blockwise-kernel ring forward: every hop's local attention runs the
+    pallas flash kernel (ops/flash_attention.py) instead of XLA einsums, so
+    no (Lc, Lc) score matrix is ever materialized — not even transiently —
+    and per-hop results merge through their logsumexps:
+
+        lse' = logaddexp(lse, lse_b)
+        o'   = o·exp(lse−lse') + o_b·exp(lse_b−lse')
+
+    The diagonal hop runs the causal kernel; prior-chunk hops run full
+    attention; future chunks are skipped whole. GQA passes straight
+    through (the flash kernels are GQA-native). Same (out, lse) contract
+    as :func:`_ring_forward`, so the standard ring backward applies."""
+    from metisfl_tpu.ops.flash_attention import _flash_forward
+
+    n = _axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Hq, Lc, D = q.shape
+    interpret = jax.default_backend() != "tpu"
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def block(k_blk, v_blk, blk_causal: bool):
+        o_b, lse_b = _flash_forward(q, k_blk, v_blk, blk_causal,
+                                    None, None, interpret)
+        # kernel lse layout: (B*Hq, Lp, STAT_LANES), lanes replicated
+        lse_b = lse_b[:, :Lc, 0].reshape(B, Hq, Lc)
+        return o_b.astype(jnp.float32), lse_b
+
+    # hop 0: the diagonal chunk (causal iff the whole attention is), then
+    # one rotation — the transfer overlaps the peeled hop's kernel
+    o, lse = block(k, v, causal)
+    k_blk = jax.lax.ppermute(k, axis_name, perm)
+    v_blk = jax.lax.ppermute(v, axis_name, perm)
+
+    def step(carry, i):
+        # compute on the CARRIED block and rotate at the end: the kernel
+        # and the next hop's ICI transfer consume the same block
+        # independently, so they overlap (transfer-then-compute would
+        # serialize every hop into comm + compute)
+        o, lse, k_blk, v_blk = carry
+        owner = (my_idx - i) % n
+
+        def merge(args):
+            o, lse = args
+            o_b, lse_b = block(k_blk, v_blk, False)
+            lse_new = jnp.logaddexp(lse, lse_b)
+            w_old = jnp.exp(lse - lse_new)[..., None]
+            w_new = jnp.exp(lse_b - lse_new)[..., None]
+            return o * w_old + o_b * w_new, lse_new
+
+        if causal:
+            o, lse = jax.lax.cond(owner > my_idx, lambda args: args, merge,
+                                  (o, lse))
+        else:
+            o, lse = merge((o, lse))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, lse, k_next, v_next), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o, lse, k_blk, v_blk),
+                                     jnp.arange(1, n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_backward_pallas(q, k, v, o, lse, g, axis_name: str, causal: bool):
+    """Blockwise-kernel ring backward: each hop runs the pallas dQ and
+    dK/dV kernels (ops/flash_attention.py ``_flash_backward``) against the
+    visiting K/V block, with the forward's GLOBAL logsumexp — so like the
+    forward, no (Lc, Lc) tensor is ever materialized. dQ accumulates
+    locally in fp32; per-block dK/dV accumulators ride the ring with their
+    blocks (n rotations = identity)."""
+    from metisfl_tpu.ops.flash_attention import _flash_backward
+
+    n = _axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Hq, Lc, D = q.shape
+    interpret = jax.default_backend() != "tpu"
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # hop-invariant: precompute once instead of per hop inside the scan
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def hop_grads(k_blk, v_blk, blk_causal: bool):
+        dq_b, dk_b, dv_b = _flash_backward(q, k_blk, v_blk, o, lse, g,
+                                           blk_causal, None, None, interpret,
+                                           delta=delta)
+        return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
+                dv_b.astype(jnp.float32))
+
+    # hop 0: diagonal block, then one rotation (overlaps the peeled hop)
+    dq, dk_blk, dv_blk = hop_grads(k, v, causal)
+    k_blk = jax.lax.ppermute(k, axis_name, perm)
+    v_blk = jax.lax.ppermute(v, axis_name, perm)
+    dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+    dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+
+    def step(carry, i):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        owner = (my_idx - i) % n
+
+        def compute(args):
+            dq, dk_blk, dv_blk = args
+            dq_b, dk_b, dv_b = hop_grads(k_blk, v_blk, False)
+            return dq + dq_b, dk_blk + dk_b, dv_blk + dv_b
+
+        if causal:
+            dq, dk_blk, dv_blk = jax.lax.cond(
+                owner > my_idx, lambda args: args, compute,
+                (dq, dk_blk, dv_blk))
+        else:
+            dq, dk_blk, dv_blk = compute((dq, dk_blk, dv_blk))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return (dq, k_next, v_next, dk_next, dv_next), None
+
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (dq, k_blk, v_blk, dk_blk, dv_blk), jnp.arange(1, n))
+    # hop 0's rotation + (n-1) scan rotations = n = identity: home again
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention_pallas(q, k, v, axis_name: str = "sp",
+                          causal: bool = False):
+    """`ring_attention` with pallas flash kernels for each hop's block
+    attention (O(blk·D) VMEM working set per hop instead of a transient
+    (Lc, Lc) HBM score matrix) — in the FORWARD AND THE BACKWARD, which
+    runs the pallas dQ/dKV kernels per hop. Call INSIDE ``shard_map``;
+    same semantics as the einsum ring. Per-hop block outputs/gradients are
+    rounded to the io dtype once per hop before the fp32 merge (the einsum
+    ring carries unnormalized fp32 statistics instead), so bf16 error
+    grows mildly with the ring size."""
+    out, _ = _ring_forward_pallas(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_pallas_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_forward_pallas(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_pallas_bwd(axis_name, causal, res, g):
+    q, k, v, out, lse = res
+    return _ring_backward_pallas(q, k, v, out, lse, g, axis_name, causal)
+
+
+ring_attention_pallas.defvjp(_ring_pallas_fwd, _ring_pallas_bwd)
+
+
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
-                        causal: bool = False):
+                        causal: bool = False, block_kernels: bool = False):
     """shard_map-wrapped ring attention over GLOBAL (B, H, L, D) arrays with
     the L dimension sharded over ``axis_name``. Usable directly under jit —
     GSPMD handles the surrounding program, the shard_map island runs the
-    ring schedule."""
+    ring schedule. ``block_kernels=True`` runs each hop's block attention
+    as a pallas flash kernel (long-Lc configs where even one chunk's
+    score matrix is too big to materialize)."""
     spec = P(None, None, axis_name, None)
+    fn = ring_attention_pallas if block_kernels else ring_attention
     return jax.shard_map(
         # positional call: custom_vjp functions reject keyword arguments
         # under differentiation
-        lambda q, k, v: ring_attention(q, k, v, axis_name, causal),
+        lambda q, k, v: fn(q, k, v, axis_name, causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
 
