@@ -83,7 +83,9 @@ def main() -> int:
         aggregation=AggregationConfig(scaler="participants"),
         train=TrainParams(batch_size=16, local_steps=4, learning_rate=0.1,
                           scan_chunk=2),
-        eval=EvalConfig(datasets=["test"], every_n_rounds=1),
+        # eval off: a fresh eval-program compile under the leader
+        # lock at shutdown time can delay follower release under load
+        eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=args.rounds),
         learners=[LearnerEndpoint(world_size=args.world)],
     )

@@ -518,7 +518,7 @@ class DriverSession:
         return path
 
     def shutdown_federation(self, timeout_s: Optional[float] = None) -> None:
-        # Default drain budget: 15 s, or 90 s when any learner is a
+        # Default drain budget: 15 s, or 150 s when any learner is a
         # multi-host world — its leader can only release the followers
         # after an in-flight replicated task drains (the release broadcast
         # serializes behind the task's lock, and a cold jit compile inside
@@ -528,7 +528,7 @@ class DriverSession:
         if timeout_s is None:
             multihost = any(int(getattr(ep, "world_size", 1)) > 1
                             for ep in self.config.learners)
-            timeout_s = 90.0 if multihost else 15.0
+            timeout_s = 150.0 if multihost else 15.0
         # learners first (reference _shutdown :344-364), then the controller —
         # dialing the endpoints learners actually registered on join, not
         # assumed port arithmetic

@@ -156,7 +156,10 @@ def test_federation_with_multihost_learner(tmp_path):
         controller_port=controller_port,
         aggregation=AggregationConfig(scaler="participants"),
         train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
-        eval=EvalConfig(every_n_rounds=0),
+        # eval ON: keeps the eval-replay path (leader broadcast + follower
+        # replay + shutdown draining behind an eval compile) exercised
+        # end to end in a multi-host world
+        eval=EvalConfig(datasets=["train"], every_n_rounds=1),
         termination=TerminationConfig(federation_rounds=2),
         learners=[LearnerEndpoint(world_size=2),
                   LearnerEndpoint()],
