@@ -34,7 +34,13 @@ def main() -> int:
                         help="0 = absorb remaining devices")
     parser.add_argument("--no-ring", action="store_true",
                         help="plain full attention baseline")
+    parser.add_argument("--block-kernels", action="store_true",
+                        help="run each ring hop on the pallas flash "
+                             "kernels (no (Lc, Lc) score matrix, ever)")
     args = parser.parse_args()
+    if args.no_ring and args.block_kernels:
+        parser.error("--block-kernels selects the ring hop kernel; it "
+                     "cannot combine with --no-ring (dense baseline)")
 
     from metisfl_tpu.platform import honor_platform_env
     honor_platform_env()
@@ -58,7 +64,8 @@ def main() -> int:
 
     module = LlamaLite(vocab_size=args.vocab, dim=args.dim, depth=args.depth,
                        heads=args.heads,
-                       sp_mesh=None if args.no_ring else mesh)
+                       sp_mesh=None if args.no_ring else mesh,
+                       sp_block_kernels=args.block_kernels)
     ops = FlaxModelOps(module, ds.x[:2], mesh=mesh,
                        partition_rules=TRANSFORMER_RULES)
     t0 = time.time()

@@ -102,6 +102,9 @@ class Attention(nn.Module):
     lora_rank: int = 0
     sp_mesh: object = None
     sp_axis: str = "sp"
+    # run each ring hop's block attention on the pallas flash kernels
+    # (ringattn.make_ring_attention(block_kernels=True))
+    sp_block_kernels: bool = False
     use_flash: bool = False
     dtype: Any = None
     # Grouped-query attention (Llama-3 style): K/V project to kv_heads
@@ -158,8 +161,9 @@ class Attention(nn.Module):
             v = jnp.repeat(v, group, axis=1)
         if self.sp_mesh is not None:
             from metisfl_tpu.parallel.ringattn import make_ring_attention
-            out = make_ring_attention(self.sp_mesh, self.sp_axis,
-                                      causal=self.causal)(q, k, v)
+            out = make_ring_attention(
+                self.sp_mesh, self.sp_axis, causal=self.causal,
+                block_kernels=self.sp_block_kernels)(q, k, v)
         elif self.use_flash:
             from metisfl_tpu.ops import flash_attention
             out = flash_attention(q, k, v, self.causal)
@@ -308,6 +312,7 @@ class DecoderBlock(nn.Module):
     mlp_ratio: int = 4
     lora_rank: int = 0
     sp_mesh: object = None
+    sp_block_kernels: bool = False
     use_flash: bool = False
     # > 0 replaces the SwiGLU FFN with a Switch MoE of this many experts
     moe_experts: int = 0
@@ -318,6 +323,7 @@ class DecoderBlock(nn.Module):
     def __call__(self, x, train: bool = False):
         x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
                           lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
+                          sp_block_kernels=self.sp_block_kernels,
                           use_flash=self.use_flash, dtype=self.dtype,
                           kv_heads=self.kv_heads,
                           name="attn")(
@@ -406,8 +412,10 @@ class LlamaLite(nn.Module):
     heads: int = 4
     lora_rank: int = 0
     # sequence parallelism: a Mesh with an "sp" axis routes every block's
-    # attention through the ring schedule (long-context configs)
+    # attention through the ring schedule (long-context configs);
+    # sp_block_kernels runs each hop on the pallas flash kernels
     sp_mesh: object = None
+    sp_block_kernels: bool = False
     # single-chip pallas flash-attention kernel (ops/flash_attention.py)
     use_flash: bool = False
     # expert parallelism: > 0 gives every block a Switch MoE FFN of this
@@ -433,6 +441,7 @@ class LlamaLite(nn.Module):
             x = block_cls(self.dim, self.heads,
                           lora_rank=self.lora_rank,
                           sp_mesh=self.sp_mesh,
+                          sp_block_kernels=self.sp_block_kernels,
                           use_flash=self.use_flash,
                           moe_experts=self.moe_experts,
                           dtype=self.dtype,

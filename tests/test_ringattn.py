@@ -277,3 +277,20 @@ def test_pallas_block_ring_gqa_gradients_match():
         want = np.asarray(full).reshape(B, 2, Hq // 2, L, D).sum(axis=2)
         np.testing.assert_allclose(np.asarray(got), want,
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_llama_sp_block_kernels_matches_dense():
+    """LlamaLite(sp_mesh=..., sp_block_kernels=True): the pallas block-ring
+    wired through the model matches the plain attention path."""
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    mesh = build_mesh(MeshConfig(("sp",), (4,)), devices=jax.devices()[:4])
+    tokens = jnp.asarray(
+        np.random.default_rng(43).integers(0, 64, (2, 32)), jnp.int32)
+    plain = LlamaLite(vocab_size=64, dim=16, depth=1, heads=2)
+    ring = LlamaLite(vocab_size=64, dim=16, depth=1, heads=2, sp_mesh=mesh,
+                     sp_block_kernels=True)
+    variables = plain.init(jax.random.PRNGKey(0), tokens)
+    np.testing.assert_allclose(
+        np.asarray(ring.apply(variables, tokens)),
+        np.asarray(plain.apply(variables, tokens)), atol=1e-4, rtol=1e-4)
