@@ -219,7 +219,7 @@ def _lm_step_flops(B, L, dim, depth, vocab) -> int:
 
 
 def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
-              require_tpu=True):
+              require_tpu=True, on_update=None):
     """Causal-LM MFU on an MXU-sized LlamaLite (dim 1024 / depth 8 /
     seq 1024, bf16): a small config sweep (dense/flash attention, batch,
     remat) — each variant individually guarded — reporting every variant's
@@ -286,6 +286,8 @@ def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
                 best = (label, tps, flops, res.ms_per_step, tokens)
         except Exception:
             out[f"lm_{label}_error"] = traceback.format_exc(limit=2)[-200:]
+        if on_update is not None:
+            on_update(out)
     if best is not None:
         label, tps, flops, ms, tokens = best
         out.update({
@@ -300,7 +302,7 @@ def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
     return out
 
 
-def bench_flash(seq: int = 2048, reps: int = 8):
+def bench_flash(seq: int = 2048, reps: int = 8, on_update=None):
     """Pallas flash-attention kernel vs dense XLA attention, fwd and
     fwd+bwd, at seq >= 1024 (VERDICT r2 #5). TPU only — interpret mode is a
     debugging path, far too slow to time.
@@ -371,6 +373,8 @@ def bench_flash(seq: int = 2048, reps: int = 8):
             out[f"attn_{label}_{tag}_ms"] = round(max(per_op, 0.0), 3)
             # one dispatch + ONE op execution (not dispatch alone)
             out[f"attn_{label}_{tag}_single_call_ms"] = round(t_one, 2)
+            if on_update is not None:
+                on_update(out)
 
     # GQA-native flash (4 of 16 KV heads): K/V at quarter size in HBM,
     # index-mapped to query heads inside the kernels
@@ -498,6 +502,124 @@ def bench_store(num_learners: int = 64):
     return out
 
 
+# --- section isolation -----------------------------------------------------
+#
+# Round-3 observation: the tunnel to the TPU can wedge MID-RUN, blocking the
+# main thread inside native code where no Python signal handler (SIGALRM or
+# the driver's SIGTERM) can run — the process then hangs until SIGKILL and
+# prints NOTHING. The only robust shape is to run each section in a child
+# process with a kill-on-timeout: the parent never touches the device, stays
+# interruptible, and always emits the JSON line.
+
+_SECTIONS = {
+    "train": lambda a: bench_train_step(),
+    "ckks": lambda a: bench_secure_ckks(),
+    "store": lambda a: bench_store(),
+    "mfu": lambda a: bench_mfu(on_update=a),
+    "flash": lambda a: bench_flash(on_update=a),
+}
+
+
+def _run_section_child(name: str, out_path: str, quick: bool) -> int:
+    """Child mode: run ONE section, streaming partial results to
+    ``out_path`` (write + atomic rename) so a kill mid-section still leaves
+    everything measured so far for the parent."""
+    def dump(d):
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(d, fh)
+        os.replace(tmp, out_path)
+
+    if name == "agg":
+        num_learners = 8 if quick else NUM_LEARNERS
+        rounds = 2 if quick else ROUNDS
+        out = bench_aggregation(num_learners, rounds, STRIDE)
+    else:
+        out = _SECTIONS[name](dump)
+    try:
+        import jax
+        out["backend"] = jax.default_backend()
+        out["devices"] = len(jax.devices())
+    except Exception:
+        pass
+    dump(out)
+    return 0
+
+
+def _probe_backend_alive(timeout: int = 90) -> bool:
+    """Quick subprocess probe: is the accelerator still reachable?"""
+    if (os.environ.get("JAX_PLATFORMS") or "").strip().lower() == "cpu":
+        return True
+    probe = ("import jax, jax.numpy as jnp; "
+             "jnp.ones((8, 8)).sum().block_until_ready()")
+    try:
+        return subprocess.run([sys.executable, "-c", probe],
+                              capture_output=True,
+                              timeout=timeout).returncode == 0
+    except Exception:
+        return False
+
+
+# the currently-running section child, so the watchdog's emergency bail can
+# kill it — os._exit alone would orphan a child still holding the TPU
+_ACTIVE_CHILD = {"proc": None}
+
+
+def _kill_active_child() -> None:
+    proc = _ACTIVE_CHILD.get("proc")
+    if proc is not None and proc.poll() is None:
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def _run_section(name: str, quick: bool, timeout: int, errors: dict) -> dict:
+    """Run a section in a subprocess; on timeout the child is SIGKILLed and
+    whatever partials it streamed out are kept."""
+    import tempfile
+
+    fd, out_path = tempfile.mkstemp(suffix=f".bench.{name}.json")
+    os.close(fd)
+    os.unlink(out_path)
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--section", name, "--out", out_path]
+    if quick:
+        argv.append("--quick")
+    try:
+        proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        _ACTIVE_CHILD["proc"] = proc
+        try:
+            _, stderr = proc.communicate(timeout=timeout)
+            if proc.returncode != 0:
+                errors[name] = (stderr or "")[-400:] or f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+            errors[name] = f"section timed out after {timeout}s (killed)"
+            # a wedged tunnel makes every later accelerator section eat its
+            # full timeout too — re-probe, and degrade the REST to CPU if dead
+            if not _probe_backend_alive():
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                errors[name + "_tunnel"] = "backend unreachable; rest on cpu"
+    except Exception:
+        errors[name] = traceback.format_exc(limit=2)[-400:]
+    finally:
+        _ACTIVE_CHILD["proc"] = None
+    try:
+        with open(out_path) as fh:
+            return json.load(fh)
+    except Exception:
+        return {}
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
 # partial-result state for the watchdog/signal emergency print: sections
 # fill this in as they finish, so a hang (or the driver's kill) in a later
 # section still surfaces everything measured so far
@@ -539,6 +661,7 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
     import signal
 
     def _bail(signum, frame):
+        _kill_active_child()  # never leave an orphan holding the TPU
         details = dict(_PARTIAL["details"])
         errors = dict(_PARTIAL["errors"])
         errors["watchdog"] = f"interrupted by signal {signum} (partial result)"
@@ -553,15 +676,35 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
     signal.alarm(budget_secs)
 
 
-def run_bench(quick: bool):
+# per-section kill timeouts (full mode): generous for compile-heavy
+# sections, bounded so a wedged tunnel cannot eat the whole driver budget.
+# Their sum (3180s + probe overhead) must stay under the parent watchdog
+# (WATCHDOG_FULL_SECS) or healthy runs the caps allow get cut short; in
+# practice a wedge burns at most ONE cap before the re-probe degrades the
+# remaining sections to CPU.
+_SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
+                     "mfu": 900, "flash": 900}
+WATCHDOG_FULL_SECS = sum(_SECTION_TIMEOUTS.values()) + 300
+
+
+def run_bench(quick: bool, isolate: bool = True):
     num_learners = 8 if quick else NUM_LEARNERS
     rounds = 2 if quick else ROUNDS
     errors = _PARTIAL["errors"]
     details = _PARTIAL["details"]
 
+    if not quick and isolate:
+        # full mode: every section in its own killable child process; this
+        # parent never initializes an accelerator backend itself
+        for name in ("agg", "train", "ckks", "store", "mfu", "flash"):
+            details.update(_run_section(name, quick,
+                                        _SECTION_TIMEOUTS[name], errors))
+        return _result_from(details, errors, num_learners)
+
+    # in-process path: quick CI/CPU smoke (small sizes, CKKS only) or the
+    # isolate=False full fallback (every section, old single-process shape)
     agg = bench_aggregation(num_learners, rounds, STRIDE)
     details.update(agg)
-
     secondary = [bench_secure_ckks] if quick else [
         bench_train_step, bench_secure_ckks, bench_store, bench_mfu,
         bench_flash]
@@ -570,7 +713,6 @@ def run_bench(quick: bool):
             details.update(fn())
         except Exception:
             errors[fn.__name__] = traceback.format_exc(limit=3)[-400:]
-
     return _result_from(details, errors, num_learners)
 
 
@@ -585,14 +727,23 @@ def main():
     parser.add_argument("--quick", action="store_true",
                         help="small sizes for CI/CPU smoke validation "
                              "(the driver runs the full bench on TPU)")
+    parser.add_argument("--section", choices=["agg", *_SECTIONS],
+                        help="internal: run ONE section (child mode)")
+    parser.add_argument("--out", help="internal: child-mode output path")
     args, _ = parser.parse_known_args()
+
+    if args.section:
+        return _run_section_child(args.section, args.out, args.quick)
 
     backend_info = ensure_backend()
     if backend_info.get("degraded_to_cpu"):
         honor_platform_env()
 
+    # full-mode budget: the per-section kill timeouts bound a wedged run;
+    # this alarm is the parent's own last resort and sits above the sum of
+    # the section caps so it never cuts a run the caps themselves allow
     _install_watchdog(8 if args.quick else NUM_LEARNERS,
-                      budget_secs=600 if args.quick else 1800)
+                      budget_secs=600 if args.quick else WATCHDOG_FULL_SECS)
     try:
         result = run_bench(args.quick)
     except Exception as exc:
@@ -618,12 +769,20 @@ def main():
                         "exc": repr(exc)[-200:]},
         }
 
-    try:
-        import jax
-        result["details"]["backend"] = jax.default_backend()
-        result["details"]["devices"] = len(jax.devices())
-    except Exception:
-        result["details"]["backend"] = "unavailable"
+    # full (isolated) mode: sections report their own backend — querying
+    # jax here would initialize the accelerator in the one process that
+    # must stay interruptible. Quick mode runs in-process anyway.
+    if "backend" not in result["details"]:
+        if args.quick or os.environ.get("JAX_PLATFORMS") == "cpu":
+            try:
+                import jax
+                result["details"]["backend"] = jax.default_backend()
+                result["details"]["devices"] = len(jax.devices())
+            except Exception:
+                result["details"]["backend"] = "unavailable"
+        else:
+            result["details"]["backend"] = backend_info.get(
+                "probed_backend", "unknown")
     result["details"].update(backend_info)
     result["details"]["cpu_retry"] = os.environ.get(
         "MFTPU_BENCH_CPU_RETRY") == "1"

@@ -44,6 +44,35 @@ def test_store_bench_section():
     assert out["store_disk_insert_ms"] > 0
 
 
+def test_section_subprocess_roundtrip():
+    """Child mode runs one section and the parent reads its JSON back —
+    the isolation shape that makes a mid-run tunnel wedge non-fatal."""
+    from bench import _run_section
+
+    errors = {}
+    out = _run_section("ckks", quick=True, timeout=240, errors=errors)
+    assert errors == {}
+    assert out["ckks_parties"] == 8
+    assert out["ckks_encrypt_ms"] > 0
+
+
+def test_section_timeout_is_killed_and_recorded():
+    """A section that exceeds its budget is SIGKILLed; the parent records
+    the error and keeps going instead of hanging the whole bench."""
+    import time as _time
+
+    from bench import _run_section
+
+    errors = {}
+    t0 = _time.monotonic()
+    out = _run_section("store", quick=False, timeout=1, errors=errors)
+    # the child streams partials; whatever survived must be a dict
+    assert isinstance(out, dict)
+    assert "store" in errors and "timed out" in errors["store"]
+    # kill must be prompt: well under the in-process section runtime
+    assert _time.monotonic() - t0 < 120
+
+
 def test_aggregation_headline_correctness():
     from bench import STRIDE, aggregate_once, synth_models
 
