@@ -684,7 +684,10 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 # remaining sections to CPU.
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 900, "flash": 900}
-WATCHDOG_FULL_SECS = sum(_SECTION_TIMEOUTS.values()) + 300
+# worst case: every section eats its cap AND its post-timeout 90s backend
+# probe, plus slack for child startup — the alarm must sit above that
+WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
+                      + 90 * len(_SECTION_TIMEOUTS) + 300)
 
 
 def run_bench(quick: bool, isolate: bool = True):

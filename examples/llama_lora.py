@@ -104,6 +104,17 @@ def main() -> int:
         if "lora_" in "/".join(str(k) for k in p))
     print(f"params: {n_total} total, {n_lora} trainable LoRA "
           f"({100 * n_lora / n_total:.1f}%)")
+
+    # KV-cache decode on the federated model (models/generate.py): greedy
+    # continuation of a prompt, one jitted program for the whole sequence
+    from metisfl_tpu.tensor.pytree import unpack_model
+    blob = fed.controller.community_model_bytes()
+    final = unpack_model(blob, template) if blob else template
+    gen_ops = FlaxModelOps(module, sample, variables=final)
+    prompt = np.arange(1, 9, dtype=np.int32)[None, :]
+    tokens = gen_ops.generate(prompt, max_new_tokens=8)
+    print(f"greedy continuation of {prompt[0].tolist()}: "
+          f"{tokens[0].tolist()}")
     return 0
 
 

@@ -10,12 +10,15 @@ parts"), evaluation as a jit forward pass.
 
 from metisfl_tpu.models.ops import FlaxModelOps, TrainOutput
 from metisfl_tpu.models.dataset import ArrayDataset
+from metisfl_tpu.models.generate import generate, init_cache
 from metisfl_tpu.models.optimizers import make_optimizer, fedprox
 
 __all__ = [
     "FlaxModelOps",
     "TrainOutput",
     "ArrayDataset",
+    "generate",
+    "init_cache",
     "make_optimizer",
     "fedprox",
 ]

@@ -509,6 +509,21 @@ class FlaxModelOps:
             return np.zeros((0,), np.float32)
         return np.concatenate(outs, axis=0)
 
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 variables: Optional[Pytree] = None,
+                 **sampling) -> np.ndarray:
+        """Autoregressive decoding on a causal-LM module (KV-cache decode,
+        one jitted program per shape/config — models/generate.py). Sampling
+        kwargs: ``temperature``, ``top_k``, ``eos_id``, ``pad_id``, ``rng``,
+        ``max_len``."""
+        from metisfl_tpu.models.generate import generate as _generate
+
+        if variables is None:
+            variables = self.variables
+        return np.asarray(_generate(self.module, variables,
+                                    np.asarray(prompt, np.int32),
+                                    max_new_tokens, **sampling))
+
     # -- evaluation --------------------------------------------------------
     def _make_eval(self, metric_names: Tuple[str, ...]):
         cached = self._eval_cache.get(metric_names)

@@ -116,7 +116,7 @@ class Attention(nn.Module):
     kv_heads: int = 0
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, cache=None, position=None):
         B, L, _ = x.shape
         head_dim = self.dim // self.heads
         kv_heads = self.kv_heads or self.heads
@@ -142,6 +142,12 @@ class Attention(nn.Module):
         q = q.reshape(B, L, self.heads, head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(B, L, kv_heads, head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(B, L, kv_heads, head_dim).transpose(0, 2, 1, 3)
+        if cache is not None:
+            out, cache = self._cached_attention(q, k, v, cache, position,
+                                                head_dim)
+            out = out.transpose(0, 2, 1, 3).reshape(B, L, self.dim)
+            return nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                            name="wo")(out), cache
         if self.rotary:
             positions = jnp.arange(L, dtype=jnp.float32)
             dt = q.dtype
@@ -184,6 +190,52 @@ class Attention(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(B, L, self.dim)
         return nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                         name="wo")(out)
+
+    def _cached_attention(self, q, k, v, cache, position, head_dim):
+        """Incremental attention against a KV cache (autoregressive decode).
+
+        ``cache`` is ``(ck, cv)`` of shape (B, kv_heads, L_max, head_dim);
+        ``position`` is the (traced) index of the first query position. The
+        new K/V land in the cache via ``dynamic_update_slice`` and q attends
+        over the full cache under the mask ``key_pos <= position + q_idx``
+        — static shapes throughout, so one compiled program serves every
+        decode step. Handles both prefill (L = prompt length at position 0)
+        and single-token decode (L = 1). Dense math only: at L = 1 there is
+        no (L, L) matrix for flash/ring to save."""
+        if self.sp_mesh is not None:
+            raise ValueError("cached decode does not compose with sp_mesh; "
+                             "decode on a replicated module instead")
+        ck, cv = cache
+        L = q.shape[2]
+        L_max = ck.shape[2]
+        pos0 = jnp.asarray(position, jnp.int32)
+        if self.rotary:
+            positions = (pos0 + jnp.arange(L)).astype(jnp.float32)
+            dt = q.dtype
+            q = _rotary(q, positions).astype(dt)
+            k = _rotary(k, positions).astype(dt)
+        zero = jnp.zeros((), pos0.dtype)  # index dtypes must all match
+        ck = jax.lax.dynamic_update_slice(
+            ck, k.astype(ck.dtype), (zero, zero, pos0, zero))
+        cv = jax.lax.dynamic_update_slice(
+            cv, v.astype(cv.dtype), (zero, zero, pos0, zero))
+        kk, vv = ck, cv
+        kv_heads = kk.shape[1]
+        if kv_heads != self.heads:
+            group = self.heads // kv_heads
+            kk = jnp.repeat(kk, group, axis=1)
+            vv = jnp.repeat(vv, group, axis=1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk).astype(
+            jnp.float32) * float(1.0 / np.sqrt(head_dim))
+        # causal over absolute positions; also hides the cache's unwritten
+        # (zero) tail beyond position + L
+        mask = (jnp.arange(L_max)[None, :]
+                <= pos0 + jnp.arange(L)[:, None])
+        scores = jnp.where(mask[None, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        weights = nn.softmax(scores, axis=-1).astype(vv.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights, vv)
+        return out, (ck, cv)
 
 
 class SwiGLU(nn.Module):
@@ -320,14 +372,20 @@ class DecoderBlock(nn.Module):
     kv_heads: int = 0           # grouped-query attention; 0 = MHA
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
-        x = x + Attention(self.dim, self.heads, causal=True, rotary=True,
-                          lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
-                          sp_block_kernels=self.sp_block_kernels,
-                          use_flash=self.use_flash, dtype=self.dtype,
-                          kv_heads=self.kv_heads,
-                          name="attn")(
-            nn.RMSNorm(dtype=self.dtype)(x), train=train)
+    def __call__(self, x, train: bool = False, cache=None, position=None):
+        attn = Attention(self.dim, self.heads, causal=True, rotary=True,
+                         lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
+                         sp_block_kernels=self.sp_block_kernels,
+                         use_flash=self.use_flash, dtype=self.dtype,
+                         kv_heads=self.kv_heads,
+                         name="attn")
+        normed = nn.RMSNorm(dtype=self.dtype)(x)
+        if cache is not None:
+            a, cache = attn(normed, train=train, cache=cache,
+                            position=position)
+        else:
+            a = attn(normed, train=train)
+        x = x + a
         if self.moe_experts > 0:
             ffn = MoEMLP(self.dim, self.mlp_ratio * self.dim,
                          num_experts=self.moe_experts, dtype=self.dtype,
@@ -336,7 +394,7 @@ class DecoderBlock(nn.Module):
             ffn = SwiGLU(self.dim, self.mlp_ratio * self.dim,
                          dtype=self.dtype, name="mlp")
         x = x + ffn(nn.RMSNorm(dtype=self.dtype)(x))
-        return x
+        return x if cache is None else (x, cache)
 
 
 class ViTLite(nn.Module):
@@ -432,23 +490,32 @@ class LlamaLite(nn.Module):
     kv_heads: int = 0
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, caches=None,
+                 position=None):
         x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
                      name="embed")(tokens)
+        # decode mode never wraps in remat (inference has no backward pass)
         block_cls = (nn.remat(DecoderBlock, static_argnums=(2,))
-                     if self.remat else DecoderBlock)
+                     if self.remat and caches is None else DecoderBlock)
+        new_caches = []
         for i in range(self.depth):
-            x = block_cls(self.dim, self.heads,
-                          lora_rank=self.lora_rank,
-                          sp_mesh=self.sp_mesh,
-                          sp_block_kernels=self.sp_block_kernels,
-                          use_flash=self.use_flash,
-                          moe_experts=self.moe_experts,
-                          dtype=self.dtype,
-                          kv_heads=self.kv_heads,
-                          name=f"block_{i}")(x, train)
+            block = block_cls(self.dim, self.heads,
+                              lora_rank=self.lora_rank,
+                              sp_mesh=self.sp_mesh,
+                              sp_block_kernels=self.sp_block_kernels,
+                              use_flash=self.use_flash,
+                              moe_experts=self.moe_experts,
+                              dtype=self.dtype,
+                              kv_heads=self.kv_heads,
+                              name=f"block_{i}")
+            if caches is not None:
+                x, c = block(x, train, cache=caches[i], position=position)
+                new_caches.append(c)
+            else:
+                x = block(x, train)
         x = nn.RMSNorm(dtype=self.dtype)(x)
         # logits in fp32: softmax-cross-entropy over a large vocab is
         # precision-sensitive, and this final cast is cheap
-        return nn.Dense(self.vocab_size, use_bias=False,
-                        name="lm_head")(x.astype(jnp.float32))
+        logits = nn.Dense(self.vocab_size, use_bias=False,
+                          name="lm_head")(x.astype(jnp.float32))
+        return logits if caches is None else (logits, tuple(new_caches))
