@@ -386,6 +386,45 @@ def bench_flash(seq: int = 2048, reps: int = 8, on_update=None):
     return out
 
 
+def bench_decode(B=8, prompt_len=128, new_tokens=128, dim=1024, depth=8,
+                 heads=16, kv_heads=4, vocab=32768):
+    """KV-cache autoregressive decode throughput (models/generate.py) on
+    the MXU-sized GQA LlamaLite: tokens/sec and per-token latency for one
+    jitted prefill+scan program. TPU only. Decode is HBM-bandwidth-bound;
+    GQA's kv_heads/heads shrinks the cache traffic by 4x here."""
+    import jax
+    import jax.numpy as jnp
+
+    from metisfl_tpu.models.generate import generate
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    if jax.default_backend() != "tpu":
+        return {}
+    module = LlamaLite(vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+                       kv_heads=kv_heads, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, vocab, (B, prompt_len)).astype(np.int32)
+    variables = module.init(jax.random.PRNGKey(0), jnp.asarray(prompt[:1]))
+
+    out = generate(module, variables, prompt, new_tokens)  # compile
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(generate(module, variables, prompt,
+                                       new_tokens))
+        times.append(time.perf_counter() - t0)
+    sec = float(np.median(times))
+    total_new = B * new_tokens
+    return {
+        "decode_config": (f"dim{dim}/depth{depth}/h{heads}kv{kv_heads}"
+                          f"/prompt{prompt_len}/new{new_tokens}/bf16"),
+        "decode_tokens_per_sec": round(total_new / sec),
+        "decode_ms_per_token": round(sec / new_tokens * 1e3, 3),
+        "decode_batch": B,
+    }
+
+
 def bench_secure_ckks(num_learners: int = 8):
     """Native CKKS secure aggregation on the same 1.64M-param model:
     encrypt / keyless homomorphic weighted-sum / decrypt wall-clock
@@ -517,6 +556,7 @@ _SECTIONS = {
     "store": lambda a: bench_store(),
     "mfu": lambda a: bench_mfu(on_update=a),
     "flash": lambda a: bench_flash(on_update=a),
+    "decode": lambda a: bench_decode(),
 }
 
 
@@ -683,7 +723,7 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 # practice a wedge burns at most ONE cap before the re-probe degrades the
 # remaining sections to CPU.
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
-                     "mfu": 900, "flash": 900}
+                     "mfu": 900, "flash": 900, "decode": 600}
 # worst case: every section eats its cap AND its post-timeout 90s backend
 # probe, plus slack for child startup — the alarm must sit above that
 WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
@@ -699,7 +739,8 @@ def run_bench(quick: bool, isolate: bool = True):
     if not quick and isolate:
         # full mode: every section in its own killable child process; this
         # parent never initializes an accelerator backend itself
-        for name in ("agg", "train", "ckks", "store", "mfu", "flash"):
+        for name in ("agg", "train", "ckks", "store", "mfu", "flash",
+                     "decode"):
             details.update(_run_section(name, quick,
                                         _SECTION_TIMEOUTS[name], errors))
         return _result_from(details, errors, num_learners)
@@ -710,7 +751,7 @@ def run_bench(quick: bool, isolate: bool = True):
     details.update(agg)
     secondary = [bench_secure_ckks] if quick else [
         bench_train_step, bench_secure_ckks, bench_store, bench_mfu,
-        bench_flash]
+        bench_flash, bench_decode]
     for fn in secondary:
         try:
             details.update(fn())

@@ -129,9 +129,19 @@ def generate(module, variables: Pytree, prompt, max_new_tokens: int, *,
            int(top_k), eos_id, pad_id)
     compiled = _COMPILED.get(key)
     if compiled is None:
+        while len(_COMPILED) >= _COMPILED_MAX:  # LRU bound: a long-lived
+            # server with many (shape, sampling) combos must not retain
+            # every XLA executable forever
+            _COMPILED.pop(next(iter(_COMPILED)))
         compiled = _COMPILED[key] = jax.jit(run)
+    else:
+        _COMPILED[key] = _COMPILED.pop(key)  # refresh LRU position
     return compiled(variables, prompt, rng)
 
 
-# compiled generation programs, keyed on (module config, shapes, sampling)
+# compiled generation programs, keyed on (module config, shapes, sampling);
+# insertion-ordered dict used as an LRU with _COMPILED_MAX entries. Callers
+# with many distinct prompt lengths should bucket them via ``max_len`` +
+# left-padding rather than compiling one program per length.
 _COMPILED: dict = {}
+_COMPILED_MAX = 32

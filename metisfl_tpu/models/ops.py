@@ -515,11 +515,14 @@ class FlaxModelOps:
         """Autoregressive decoding on a causal-LM module (KV-cache decode,
         one jitted program per shape/config — models/generate.py). Sampling
         kwargs: ``temperature``, ``top_k``, ``eos_id``, ``pad_id``, ``rng``,
-        ``max_len``."""
+        ``max_len``. Sampled calls without an explicit ``rng`` advance the
+        engine's own rng, so repeated requests draw different streams."""
         from metisfl_tpu.models.generate import generate as _generate
 
         if variables is None:
             variables = self.variables
+        if sampling.get("temperature", 0.0) > 0.0 and "rng" not in sampling:
+            self._rng, sampling["rng"] = jax.random.split(self._rng)
         return np.asarray(_generate(self.module, variables,
                                     np.asarray(prompt, np.int32),
                                     max_new_tokens, **sampling))

@@ -219,23 +219,26 @@ class Attention(nn.Module):
             ck, k.astype(ck.dtype), (zero, zero, pos0, zero))
         cv = jax.lax.dynamic_update_slice(
             cv, v.astype(cv.dtype), (zero, zero, pos0, zero))
-        kk, vv = ck, cv
-        kv_heads = kk.shape[1]
-        if kv_heads != self.heads:
-            group = self.heads // kv_heads
-            kk = jnp.repeat(kk, group, axis=1)
-            vv = jnp.repeat(vv, group, axis=1)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk).astype(
+        # grouped einsums read the cache at kv-head size (decode is
+        # HBM-bound; repeating K/V to all query heads would rewrite the
+        # whole cache heads/kv_heads times per step and erase the GQA
+        # bandwidth win). Query heads group contiguously per kv head —
+        # the same layout jnp.repeat gives the dense training path.
+        kv_heads = ck.shape[1]
+        B = q.shape[0]
+        group = self.heads // kv_heads
+        qg = q.reshape(B, kv_heads, group, L, head_dim)
+        scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, ck).astype(
             jnp.float32) * float(1.0 / np.sqrt(head_dim))
         # causal over absolute positions; also hides the cache's unwritten
         # (zero) tail beyond position + L
         mask = (jnp.arange(L_max)[None, :]
                 <= pos0 + jnp.arange(L)[:, None])
-        scores = jnp.where(mask[None, None], scores,
+        scores = jnp.where(mask[None, None, None], scores,
                            jnp.finfo(scores.dtype).min)
-        weights = nn.softmax(scores, axis=-1).astype(vv.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", weights, vv)
-        return out, (ck, cv)
+        weights = nn.softmax(scores, axis=-1).astype(cv.dtype)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", weights, cv)
+        return out.reshape(B, self.heads, L, head_dim), (ck, cv)
 
 
 class SwiGLU(nn.Module):

@@ -44,6 +44,16 @@ def test_store_bench_section():
     assert out["store_disk_insert_ms"] > 0
 
 
+def test_decode_bench_gates_on_tpu_and_registers():
+    """Off-TPU the decode section reports nothing (tokens/sec vs a CPU is
+    meaningless); it must still be wired into both full-mode paths."""
+    import bench
+
+    assert bench.bench_decode() == {}
+    assert "decode" in bench._SECTIONS
+    assert "decode" in bench._SECTION_TIMEOUTS
+
+
 def test_section_subprocess_roundtrip():
     """Child mode runs one section and the parent reads its JSON back —
     the isolation shape that makes a mid-run tunnel wedge non-fatal."""

@@ -137,6 +137,40 @@ def test_repeat_calls_hit_compiled_cache():
     assert len(gen_mod._COMPILED) == 2  # different config compiles anew
 
 
+def test_compiled_cache_is_bounded():
+    import importlib
+
+    gen_mod = importlib.import_module("metisfl_tpu.models.generate")
+    module = LlamaLite(vocab_size=32, dim=16, depth=1, heads=2)
+    variables, prompt = _init(module, seed=10)
+    gen_mod._COMPILED.clear()
+    old_max = gen_mod._COMPILED_MAX
+    gen_mod._COMPILED_MAX = 2
+    try:
+        for n in (2, 3, 4):  # 3 distinct configs, bound 2
+            generate(module, variables, prompt, n)
+        assert len(gen_mod._COMPILED) == 2
+        # the oldest (n=2) was evicted, the newest two remain
+        kept = {k[4] for k in gen_mod._COMPILED}
+        assert kept == {3, 4}
+    finally:
+        gen_mod._COMPILED_MAX = old_max
+
+
+def test_ops_generate_advances_rng_between_sampled_calls():
+    module = LlamaLite(vocab_size=32, dim=16, depth=1, heads=2)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 32, (2, 5)).astype(np.int32)
+    ops = FlaxModelOps(module, prompt[:1])
+    a = ops.generate(prompt, 8, temperature=50.0)
+    b = ops.generate(prompt, 8, temperature=50.0)
+    assert not np.array_equal(a, b)  # engine rng advanced
+    # greedy calls stay deterministic
+    c = ops.generate(prompt, 8)
+    d = ops.generate(prompt, 8)
+    np.testing.assert_array_equal(c, d)
+
+
 def test_zero_new_tokens_rejected():
     module = LlamaLite(vocab_size=32, dim=16, depth=1, heads=2)
     variables, prompt = _init(module, seed=9)
