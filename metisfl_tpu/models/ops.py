@@ -521,8 +521,15 @@ class FlaxModelOps:
 
         if variables is None:
             variables = self.variables
-        if sampling.get("temperature", 0.0) > 0.0 and "rng" not in sampling:
-            self._rng, sampling["rng"] = jax.random.split(self._rng)
+        if sampling.get("temperature", 0.0) > 0.0 \
+                and sampling.get("rng") is None:
+            # a DEDICATED generation stream: advancing self._rng here would
+            # make training dropout depend on how many inference requests
+            # were served in between (breaking cross-learner train
+            # reproducibility)
+            if not hasattr(self, "_gen_rng"):
+                self._gen_rng = jax.random.fold_in(self._rng, 0x6E67)
+            self._gen_rng, sampling["rng"] = jax.random.split(self._gen_rng)
         return np.asarray(_generate(self.module, variables,
                                     np.asarray(prompt, np.int32),
                                     max_new_tokens, **sampling))

@@ -162,13 +162,20 @@ def test_ops_generate_advances_rng_between_sampled_calls():
     rng = np.random.default_rng(11)
     prompt = rng.integers(1, 32, (2, 5)).astype(np.int32)
     ops = FlaxModelOps(module, prompt[:1])
+    train_rng_before = np.asarray(ops._rng)
     a = ops.generate(prompt, 8, temperature=50.0)
     b = ops.generate(prompt, 8, temperature=50.0)
-    assert not np.array_equal(a, b)  # engine rng advanced
+    assert not np.array_equal(a, b)  # generation rng advanced
+    # rng=None explicitly must behave like omitting it (kwargs forwarding)
+    c = ops.generate(prompt, 8, temperature=50.0, rng=None)
+    assert not np.array_equal(b, c)
+    # ...without touching the TRAINING stream: dropout reproducibility
+    # across learners must not depend on how much inference each served
+    np.testing.assert_array_equal(np.asarray(ops._rng), train_rng_before)
     # greedy calls stay deterministic
-    c = ops.generate(prompt, 8)
     d = ops.generate(prompt, 8)
-    np.testing.assert_array_equal(c, d)
+    e = ops.generate(prompt, 8)
+    np.testing.assert_array_equal(d, e)
 
 
 def test_zero_new_tokens_rejected():
@@ -176,6 +183,28 @@ def test_zero_new_tokens_rejected():
     variables, prompt = _init(module, seed=9)
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(module, variables, prompt, 0)
+
+
+def test_tp_sharded_engine_decodes_identically():
+    """generate on a dp x tp mesh-sharded engine (the Llama-LoRA ladder
+    config) emits the same tokens as a replicated engine: the jitted decode
+    program consumes the sharded variables directly (GSPMD propagates their
+    shardings), no gather-to-host needed."""
+    from jax.sharding import Mesh
+
+    from metisfl_tpu.models.zoo import TRANSFORMER_RULES
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    module = LlamaLite(vocab_size=64, dim=32, depth=2, heads=4, lora_rank=4)
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(1, 64, (2, 5)).astype(np.int32)
+    ops = FlaxModelOps(module, prompt[:1], mesh=mesh,
+                       partition_rules=TRANSFORMER_RULES)
+    sharded = ops.generate(prompt, 6)
+    replicated = FlaxModelOps(
+        module, prompt[:1],
+        variables=jax.tree.map(np.asarray, ops.variables)).generate(prompt, 6)
+    np.testing.assert_array_equal(sharded, replicated)
 
 
 def test_training_params_unchanged_by_decode_support():
