@@ -210,6 +210,13 @@ class InferTask(Message):
     # ...or explicit inputs shipped as a packed {"x": array} ModelBlob
     inputs: bytes = b""
     max_examples: int = 0       # 0 = all
+    # > 0 turns the task into autoregressive generation on a causal-LM
+    # engine (models/generate.py): inputs are token prompts, the result
+    # packs the generated continuations instead of logits
+    generate_tokens: int = 0
+    temperature: float = 0.0    # 0 = greedy
+    top_k: int = 0
+    eos_id: int = -1            # < 0 = no early stop
 
 
 @dataclass

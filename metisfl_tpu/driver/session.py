@@ -469,13 +469,18 @@ class DriverSession:
 
     def run_inference(self, learner_index: int = 0, inputs=None,
                       dataset: str = "test", batch_size: int = 256,
-                      max_examples: int = 0, timeout_s: float = 120.0):
+                      max_examples: int = 0, timeout_s: float = 120.0,
+                      generate_tokens: int = 0, temperature: float = 0.0,
+                      top_k: int = 0, eos_id: Optional[int] = None):
         """Run the community model's inference on one learner and return its
         predictions as a numpy array (the reference driver's counterpart to
         the learner's third task type, reference learner.py:311-330).
 
         ``inputs`` (optional numpy array) ships explicit examples; otherwise
         the learner infers over its local ``dataset`` split.
+        ``generate_tokens > 0`` makes it a generation task on a causal-LM
+        learner: ``inputs`` are (B, L) token prompts and the returned array
+        holds the sampled/greedy continuations (models/generate.py).
         """
         import uuid as _uuid
 
@@ -500,6 +505,10 @@ class DriverSession:
             inputs=(ModelBlob(tensors=[("x", np.asarray(inputs))]).to_bytes()
                     if inputs is not None else b""),
             max_examples=max_examples,
+            generate_tokens=generate_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            eos_id=-1 if eos_id is None else int(eos_id),
         )
         client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,
                            ssl=self.config.ssl)

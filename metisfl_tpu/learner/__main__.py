@@ -106,7 +106,14 @@ def main(argv=None) -> int:
             print(f"METISFL_TPU_FOLLOWER_READY "
                   f"rank={_jax.process_index()}", flush=True)
             follower_loop(model_ops, ds_by_name)
-            return 0
+            # exit WITHOUT interpreter teardown: the jax.distributed client's
+            # atexit talks to rank 0's coordinator, and rank 0 exits right
+            # after its shutdown broadcast — losing that race leaves this
+            # rank blocked in native code until the driver SIGKILLs it
+            logging.shutdown()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
         model_ops = lead(model_ops, ds_by_name)
 
     if secure_backend is None and args.secure_config:
@@ -171,9 +178,16 @@ def main(argv=None) -> int:
         server.wait_for_shutdown()
     finally:
         # release follower ranks even when join fails (a stuck leader must
-        # not leave followers parked in their broadcast loop)
+        # not leave followers parked in their broadcast loop); a failed
+        # release (e.g. collective timeout against an already-dead rank)
+        # must not turn THIS rank's clean exit into a crash — the driver's
+        # drain-and-kill is the backstop for stuck followers
         if hasattr(model_ops, "shutdown_replicas"):
-            model_ops.shutdown_replicas()
+            try:
+                model_ops.shutdown_replicas()
+            except Exception:
+                logging.getLogger("metisfl_tpu.learner").exception(
+                    "follower release broadcast failed")
     return 0
 
 

@@ -268,7 +268,25 @@ class Learner:
             x = ds.x
         if task.max_examples > 0:
             x = x[: task.max_examples]
-        preds = self.model_ops.infer(x, task.batch_size, variables=variables)
+        if task.generate_tokens > 0:
+            # generation task: x is a (B, L) int prompt batch; the result
+            # packs continuations, not logits. Chunked by batch_size like
+            # the infer path — one unbounded (B, L+new) KV-cache program
+            # over a whole split would blow device memory.
+            prompts = np.asarray(x, np.int32)
+            bs = max(1, int(task.batch_size))
+            chunks = [
+                self.model_ops.generate(
+                    prompts[i : i + bs], task.generate_tokens,
+                    variables=variables,
+                    temperature=task.temperature, top_k=task.top_k,
+                    eos_id=None if task.eos_id < 0 else task.eos_id)
+                for i in range(0, len(prompts), bs)
+            ]
+            preds = np.concatenate(chunks, axis=0)
+        else:
+            preds = self.model_ops.infer(x, task.batch_size,
+                                         variables=variables)
         return InferResult(
             task_id=task.task_id,
             learner_id=self.learner_id,

@@ -223,6 +223,30 @@ class LeaderOps:
                 lambda: self.inner.infer(x, batch_size, variables=variables),
                 "infer")
 
+    def generate(self, prompt, max_new_tokens: int, variables=None,
+                 **sampling):
+        """Replicated KV-cache decoding: every rank runs the same jitted
+        decode program (sharded-model collectives must rendezvous), and the
+        sampling rng comes from each rank's engine — identical seeds and
+        identical call order keep the streams in lockstep."""
+        from metisfl_tpu.tensor.pytree import pack_model
+        with self._lock:
+            self._check_poisoned()
+            if sampling.get("rng") is not None:
+                raise ValueError(
+                    "multi-host generate cannot take an explicit rng (it is "
+                    "not broadcast); seed the engines identically instead")
+            _send({"op": "generate", "prompt": _np_dumps(prompt),
+                   "max_new_tokens": int(max_new_tokens),
+                   "sampling": {k: v for k, v in sampling.items()
+                                if v is not None},
+                   "blob": pack_model(variables) if variables is not None
+                   else b""})
+            return self._run_replicated(
+                lambda: self.inner.generate(prompt, max_new_tokens,
+                                            variables=variables, **sampling),
+                "generate")
+
     def shutdown_replicas(self) -> None:
         """Release follower ranks (their loop returns). Waits for any
         in-flight replicated call so the shutdown broadcast cannot
@@ -302,5 +326,11 @@ def follower_loop(model_ops, datasets: Dict[str, object]) -> None:
                          if msg["blob"] else None)
             model_ops.infer(_np_loads(msg["x"]), msg["batch_size"],
                             variables=variables)
+        elif op == "generate":
+            variables = (unpack_model(msg["blob"], model_ops.variables)
+                         if msg["blob"] else None)
+            model_ops.generate(_np_loads(msg["prompt"]),
+                               msg["max_new_tokens"],
+                               variables=variables, **msg["sampling"])
         else:  # pragma: no cover - future ops
             raise RuntimeError(f"unknown replicated op {op!r}")

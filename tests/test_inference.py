@@ -92,3 +92,63 @@ def test_infer_task_explicit_inputs(engine_and_data):
     assert preds.shape == (8, 3)
     want = np.asarray(ops.module.apply(ops.variables, inputs))
     np.testing.assert_allclose(preds, want, atol=1e-5)
+
+
+def test_generation_task_chunks_by_batch_size():
+    """A generation task over a whole split must decode in batch_size
+    chunks (one unbounded KV-cache program would blow device memory);
+    greedy decoding is chunk-invariant, so results match the one-shot."""
+    from metisfl_tpu.models import generate
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    module = LlamaLite(vocab_size=64, dim=32, depth=1, heads=2)
+    rng = np.random.default_rng(14)
+    prompts = rng.integers(1, 64, (7, 5)).astype(np.int32)
+    ds = ArrayDataset(prompts, np.roll(prompts, -1, axis=1))
+    ops = FlaxModelOps(module, prompts[:1])
+    learner = Learner(model_ops=ops, train_dataset=ds,
+                      controller=_NopController())
+    task = InferTask(task_id="g2", dataset="train", batch_size=3,
+                     generate_tokens=4)
+    result = learner.infer(task)
+    got = dict(ModelBlob.from_bytes(result.predictions).tensors)[
+        "predictions"]
+    want = np.asarray(generate(module, ops.get_variables(), prompts, 4))
+    np.testing.assert_array_equal(got, want)
+    assert result.num_examples == 7
+
+
+def test_generation_task_over_rpc():
+    """InferTask.generate_tokens > 0 turns RunInference into KV-cache
+    decoding on a causal-LM learner: the shipped model generates greedy
+    continuations of shipped prompts, matching local generate()."""
+    from metisfl_tpu.models import generate
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    module = LlamaLite(vocab_size=64, dim=32, depth=2, heads=4)
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(1, 64, (2, 6)).astype(np.int32)
+    tokens = rng.integers(1, 64, (16, 6)).astype(np.int32)
+    ds = ArrayDataset(tokens, np.roll(tokens, -1, axis=1))
+    ops = FlaxModelOps(module, prompt[:1])
+    learner = Learner(model_ops=ops, train_dataset=ds,
+                      controller=_NopController())
+    server = LearnerServer(learner, host="127.0.0.1", port=0)
+    port = server.start()
+    try:
+        seeded = FlaxModelOps(module, prompt[:1], rng_seed=21)
+        task = InferTask(
+            task_id="g1", model=pack_model(seeded.get_variables()),
+            inputs=ModelBlob(tensors=[("x", prompt)]).to_bytes(),
+            generate_tokens=5)
+        client = RpcClient("127.0.0.1", port, LEARNER_SERVICE)
+        result = InferResult.from_wire(
+            client.call("RunInference", task.to_wire(), timeout=120))
+        client.close()
+        got = dict(ModelBlob.from_bytes(result.predictions).tensors)[
+            "predictions"]
+        want = np.asarray(generate(module, seeded.get_variables(),
+                                   prompt, 5))
+        np.testing.assert_array_equal(got, want)
+    finally:
+        server.stop(leave=False)

@@ -68,6 +68,84 @@ print(f"RANK{{rank}}_DONE", flush=True)
 """
 
 
+RANK_GEN_SCRIPT = r"""
+import os, sys
+rank = int(sys.argv[1])
+coordinator = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=2, process_id=rank)
+import numpy as np
+from jax.sharding import Mesh
+
+from metisfl_tpu.models import FlaxModelOps, generate
+from metisfl_tpu.models.zoo import TRANSFORMER_RULES, LlamaLite
+from metisfl_tpu.parallel.replicated import follower_loop, lead
+
+devices = jax.devices()
+mesh = Mesh(np.array(devices).reshape(2, 4), ("dp", "tp"))
+module = LlamaLite(vocab_size=64, dim=32, depth=2, heads=4)
+rng = np.random.default_rng(5)
+prompt = rng.integers(1, 64, (2, 6)).astype(np.int32)
+ops = FlaxModelOps(module, prompt[:1], rng_seed=0, mesh=mesh,
+                   partition_rules=TRANSFORMER_RULES)
+
+if rank == 0:
+    leader = lead(ops, {{}})
+    toks = leader.generate(prompt, 5)
+    # identical to a plain single-process decode of the same weights
+    want = np.asarray(generate(
+        module, jax.tree.map(np.asarray, ops.variables), prompt, 5))
+    assert np.array_equal(np.asarray(toks), want), (toks, want)
+    # sampled path: engine rngs are seed-identical across ranks, so the
+    # replayed program's collectives stay in lockstep
+    leader.generate(prompt, 4, temperature=0.8, top_k=4)
+    leader.shutdown_replicas()
+    print("TOKENS=" + ",".join(map(str, np.asarray(toks)[0])), flush=True)
+else:
+    follower_loop(ops, {{}})
+print(f"RANK{{rank}}_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_generate_replay(tmp_path):
+    """The generation opcode rides the replay protocol: a TP-sharded LM on
+    a mesh spanning two processes decodes under the leader with the
+    follower replaying the same jitted program."""
+    script = tmp_path / "rank_gen.py"
+    script.write_text(RANK_GEN_SCRIPT.format(repo=REPO))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(rank), coordinator],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("generate replay ranks hung (desynchronized programs?)")
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} failed rc={rc}\n{err[-3000:]}"
+        assert f"RANK{rank}_DONE" in out
+    assert "TOKENS=" in outs[0][1]
+
+
 @pytest.mark.slow
 def test_two_process_leader_follower(tmp_path):
     script = tmp_path / "rank.py"
