@@ -259,16 +259,18 @@ class SwiGLU(nn.Module):
 
 
 class MoEMLP(nn.Module):
-    """Switch-style top-1 mixture-of-experts FFN (expert parallelism).
+    """Top-k mixture-of-experts FFN (expert parallelism).
 
     Expert weights are stacked on a leading expert axis (``experts_w1`` /
     ``experts_w2``) that :data:`TRANSFORMER_RULES` shards over ``ep``.
     Dispatch and combine are one-hot einsums over a fixed per-expert
     capacity — static shapes, MXU-shaped (E, C, D) @ (E, D, H) batched
     matmuls, and when token shardings (dp) and expert shardings (ep) differ
-    XLA inserts the all-to-alls over ICI. Routing follows the Switch
-    transformer: top-1 expert, tokens beyond an expert's capacity are
-    dropped (residual connections carry them through), and the standard
+    XLA inserts the all-to-alls over ICI. ``top_k=1`` is the Switch
+    transformer (default); ``top_k=2`` is GShard-style routing with gates
+    renormalized over the chosen experts and second choices queued behind
+    first choices in each expert's capacity buffer. Tokens beyond capacity
+    are dropped (residual connections carry them through), and the standard
     load-balance auxiliary loss is sown under
     ``intermediates/moe_aux_loss``.
     """
@@ -277,6 +279,7 @@ class MoEMLP(nn.Module):
     hidden: int
     num_experts: int = 8
     capacity_factor: float = 1.25
+    top_k: int = 1
     dtype: Any = None
 
     @nn.compact
@@ -284,29 +287,44 @@ class MoEMLP(nn.Module):
         B, L, D = x.shape
         T = B * L
         E = self.num_experts
+        K = self.top_k
+        if not 1 <= K <= E:
+            raise ValueError(f"top_k ({K}) must be in [1, {E}]")
         tokens = x.reshape(T, D)
         # routing in fp32: tiny matmul, precision-sensitive softmax
         logits = nn.Dense(E, use_bias=False, name="router")(
             tokens.astype(jnp.float32))
         probs = nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)                  # (T,)
-        gate = jnp.max(probs, axis=-1)                           # (T,)
-        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (T, E)
+        top_vals, top_idx = jax.lax.top_k(probs, K)              # (T, K)
+        # gates renormalized over the chosen experts (GShard); for K=1 this
+        # reduces to dividing by itself only when normalizing — keep the
+        # Switch convention of the raw top prob at K=1
+        gates = (top_vals if K == 1
+                 else top_vals / jnp.sum(top_vals, -1, keepdims=True))
+        onehots = jax.nn.one_hot(top_idx.T, E, dtype=jnp.float32)  # (K, T, E)
 
-        # load-balance aux loss (Switch eq. 4): E * Σ_e fraction_e * prob_e
-        density = onehot.mean(axis=0)
+        # load-balance aux loss (Switch eq. 4) on FIRST choices:
+        # E * Σ_e fraction_e * prob_e
+        density = onehots[0].mean(axis=0)
         router_prob = probs.mean(axis=0)
         self.sow("intermediates", "moe_aux_loss",
                  E * jnp.sum(density * router_prob))
 
-        capacity = int(np.ceil(T / E * self.capacity_factor))
-        # position of each token within its expert's capacity buffer
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (T, E)
-        keep = (pos < capacity).astype(jnp.float32) * onehot
+        capacity = int(np.ceil(T / E * self.capacity_factor * K))
+        # choice-major buffer order: every first choice queues before any
+        # second choice, within a choice tokens queue in order — computed by
+        # one running cumsum over the (K*T, E) choice-major assignment
+        flat = onehots.reshape(K * T, E)
+        pos_flat = (jnp.cumsum(flat, axis=0) - 1.0) * flat       # (K*T, E)
+        keep_flat = (pos_flat < capacity).astype(jnp.float32) * flat
         pos_cap = jax.nn.one_hot(
-            (pos * keep).sum(-1).astype(jnp.int32), capacity,
-            dtype=jnp.float32)                                   # (T, C)
-        dispatch = keep[:, :, None] * pos_cap[:, None, :]        # (T, E, C)
+            (pos_flat * keep_flat).sum(-1).astype(jnp.int32), capacity,
+            dtype=jnp.float32)                                   # (K*T, C)
+        # (K*T, E, C) → sum over choices → (T, E, C); gate-weighted combine
+        disp_flat = (keep_flat[:, :, None] * pos_cap[:, None, :]).reshape(
+            K, T, E, capacity)
+        dispatch = disp_flat.sum(0)
+        gate_disp = (disp_flat * gates.T[:, :, None, None]).sum(0)
 
         dt = self.dtype or tokens.dtype
         expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt),
@@ -319,8 +337,7 @@ class MoEMLP(nn.Module):
                         (E, self.hidden, D))
         h = nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1.astype(dt)))
         out = jnp.einsum("ech,ehd->ecd", h, w2.astype(dt))       # (E, C, D)
-        combine = dispatch * gate[:, None, None]                 # (T, E, C)
-        mixed = jnp.einsum("tec,ecd->td", combine.astype(dt), out)
+        mixed = jnp.einsum("tec,ecd->td", gate_disp.astype(dt), out)
         return mixed.reshape(B, L, D)
 
 
@@ -371,6 +388,7 @@ class DecoderBlock(nn.Module):
     use_flash: bool = False
     # > 0 replaces the SwiGLU FFN with a Switch MoE of this many experts
     moe_experts: int = 0
+    moe_top_k: int = 1          # experts per token (1 = Switch, 2 = GShard)
     dtype: Any = None
     kv_heads: int = 0           # grouped-query attention; 0 = MHA
 
@@ -391,8 +409,8 @@ class DecoderBlock(nn.Module):
         x = x + a
         if self.moe_experts > 0:
             ffn = MoEMLP(self.dim, self.mlp_ratio * self.dim,
-                         num_experts=self.moe_experts, dtype=self.dtype,
-                         name="moe")
+                         num_experts=self.moe_experts, top_k=self.moe_top_k,
+                         dtype=self.dtype, name="moe")
         else:
             ffn = SwiGLU(self.dim, self.mlp_ratio * self.dim,
                          dtype=self.dtype, name="mlp")
@@ -479,9 +497,11 @@ class LlamaLite(nn.Module):
     sp_block_kernels: bool = False
     # single-chip pallas flash-attention kernel (ops/flash_attention.py)
     use_flash: bool = False
-    # expert parallelism: > 0 gives every block a Switch MoE FFN of this
-    # many experts (weights shardable over the mesh's "ep" axis)
+    # expert parallelism: > 0 gives every block a MoE FFN of this many
+    # experts (weights shardable over the mesh's "ep" axis); moe_top_k
+    # routes each token to that many experts (1 = Switch, 2 = GShard)
     moe_experts: int = 0
+    moe_top_k: int = 1
     # rematerialize each block's activations in the backward pass
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(depth) less activation
     # HBM — the lever that fits bigger batches/sequences on one chip
@@ -508,6 +528,7 @@ class LlamaLite(nn.Module):
                               sp_block_kernels=self.sp_block_kernels,
                               use_flash=self.use_flash,
                               moe_experts=self.moe_experts,
+                              moe_top_k=self.moe_top_k,
                               dtype=self.dtype,
                               kv_heads=self.kv_heads,
                               name=f"block_{i}")

@@ -52,6 +52,11 @@ def pipelined_lm_apply(module, variables: Pytree, tokens, mesh,
     same math, any compute dtype) — verified in tests — while each device
     only holds and runs its own stage's blocks. ``sp_mesh`` modules are
     rejected (pp x sp composition is not implemented).
+
+    MoE caveat: expert capacity is computed over the routing pool, and the
+    pipeline routes per MICROBATCH — with ``num_microbatches > 1`` a
+    capacity-dropped token may differ from the full-batch apply (exact
+    equality holds at ``num_microbatches=1``, and always for dense FFNs).
     """
     import flax.linen as nn
 
@@ -68,6 +73,8 @@ def pipelined_lm_apply(module, variables: Pytree, tokens, mesh,
                          lora_rank=module.lora_rank,
                          use_flash=module.use_flash,
                          moe_experts=module.moe_experts,
+                         moe_top_k=module.moe_top_k,
+                         kv_heads=module.kv_heads,
                          dtype=module.dtype)
 
     def stage_fn(stage_params, h):
