@@ -1,0 +1,110 @@
+"""Experiment summary CLI: ``python -m metisfl_tpu.stats experiment.json``.
+
+The reference ships convergence-plot helpers with its examples
+(reference examples/analysis, driver_session.py:408-418 dumps the raw
+lineage); this is the rebuild's text equivalent — a round-by-round table
+(wall-clock, cohort, aggregation time, model size) and per-metric
+convergence summaries from the ``experiment.json`` a driver writes, with no
+plotting dependencies. Usable as a library via :func:`summarize`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from statistics import median
+from typing import Any, Dict, List
+
+
+def _fmt_ms(ms: float) -> str:
+    return f"{ms / 1e3:.2f}s" if ms >= 1e3 else f"{ms:.1f}ms"
+
+
+def summarize(stats: Dict[str, Any]) -> str:
+    """Human-readable summary of a ``get_statistics()`` / experiment.json
+    payload; returns the text (the CLI prints it)."""
+    lines: List[str] = []
+    rounds = stats.get("round_metadata", [])
+    lines.append(
+        f"federation: {stats.get('global_iteration', len(rounds))} rounds, "
+        f"{len(stats.get('learners', []))} learners registered")
+
+    if rounds:
+        lines.append("")
+        lines.append(f"{'round':>5} {'wall':>8} {'cohort':>6} {'agg':>8} "
+                     f"{'params':>10} {'errors':>6}")
+        for meta in rounds:
+            wall_ms = 1e3 * max(
+                0.0, meta.get("completed_at", 0) - meta.get("started_at", 0))
+            lines.append(
+                f"{meta.get('global_iteration', '?'):>5} "
+                f"{_fmt_ms(wall_ms):>8} "
+                f"{len(meta.get('selected_learners', [])):>6} "
+                f"{_fmt_ms(meta.get('aggregation_duration_ms', 0.0)):>8} "
+                f"{meta.get('model_size', {}).get('values', 0):>10} "
+                f"{len(meta.get('errors', [])):>6}")
+        # clamped like the table rows, so both views agree on skewed clocks
+        walls = [1e3 * max(0.0, m.get("completed_at", 0)
+                           - m.get("started_at", 0))
+                 for m in rounds if m.get("completed_at")]
+        aggs = [m.get("aggregation_duration_ms", 0.0) for m in rounds]
+        if walls:
+            lines.append(
+                f"round wall-clock: median {_fmt_ms(median(walls))}, "
+                f"max {_fmt_ms(max(walls))}; aggregation median "
+                f"{_fmt_ms(median(aggs))}")
+        errors = [e for m in rounds for e in m.get("errors", [])]
+        if errors:
+            lines.append(f"round errors ({len(errors)}):")
+            lines.extend(f"  - {e}" for e in errors[:10])
+
+    evals = [e for e in stats.get("community_evaluations", [])
+             if e.get("evaluations")]
+    if evals:
+        # metric → per-round mean across learners and datasets
+        series: Dict[str, List[float]] = {}
+        for entry in evals:
+            per_metric: Dict[str, List[float]] = {}
+            for learner_metrics in entry["evaluations"].values():
+                for dataset, metrics in learner_metrics.items():
+                    for name, value in metrics.items():
+                        try:
+                            per_metric.setdefault(
+                                f"{dataset}/{name}", []).append(float(value))
+                        except (TypeError, ValueError):
+                            continue
+            for key, values in per_metric.items():
+                series.setdefault(key, []).append(
+                    sum(values) / len(values))
+        lines.append("")
+        lines.append("community-model evaluations (mean across learners):")
+        for key in sorted(series):
+            vals = series[key]
+            # "best" follows the metric's direction: loss/error-like
+            # metrics improve downward, everything else upward
+            lower_better = any(tag in key.lower()
+                               for tag in ("loss", "error", "mse", "mae"))
+            best = min(vals) if lower_better else max(vals)
+            lines.append(
+                f"  {key}: first={vals[0]:.4f} best={best:.4f} "
+                f"last={vals[-1]:.4f} over {len(vals)} evaluated rounds")
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print("usage: python -m metisfl_tpu.stats <experiment.json>",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(argv[0]) as fh:
+            stats = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"cannot read {argv[0]}: {exc}", file=sys.stderr)
+        return 1
+    print(summarize(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

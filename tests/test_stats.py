@@ -1,0 +1,59 @@
+"""Experiment summary CLI (metisfl_tpu/stats.py)."""
+
+import json
+import subprocess
+import sys
+
+from metisfl_tpu.stats import summarize
+
+
+def _stats():
+    return {
+        "global_iteration": 2,
+        "learners": ["a", "b"],
+        "round_metadata": [
+            {"global_iteration": 1, "started_at": 10.0, "completed_at": 11.5,
+             "selected_learners": ["a", "b"],
+             "aggregation_duration_ms": 40.0,
+             "model_size": {"values": 1000}, "errors": []},
+            {"global_iteration": 2, "started_at": 11.5, "completed_at": 12.0,
+             "selected_learners": ["a"],
+             "aggregation_duration_ms": 60.0,
+             "model_size": {"values": 1000},
+             "errors": ["masking needs all parties"]},
+        ],
+        "community_evaluations": [
+            {"evaluations": {
+                "a": {"test": {"accuracy": 0.5, "loss": 1.2}},
+                "b": {"test": {"accuracy": 0.7, "loss": 1.0}}}},
+            {"evaluations": {
+                "a": {"test": {"accuracy": 0.8, "loss": 0.6}}}},
+        ],
+    }
+
+
+def test_summarize_rounds_and_metrics():
+    text = summarize(_stats())
+    assert "2 rounds, 2 learners" in text
+    assert "1.50s" in text          # round 1 wall-clock
+    assert "test/accuracy: first=0.6000 best=0.8000 last=0.8000" in text
+    assert "masking needs all parties" in text
+    assert "aggregation median 50.0ms" in text
+
+
+def test_cli_reads_experiment_json(tmp_path):
+    path = tmp_path / "experiment.json"
+    path.write_text(json.dumps(_stats()))
+    out = subprocess.run(
+        [sys.executable, "-m", "metisfl_tpu.stats", str(path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "test/accuracy" in out.stdout
+
+
+def test_cli_usage_error():
+    out = subprocess.run(
+        [sys.executable, "-m", "metisfl_tpu.stats"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2
+    assert "usage" in out.stderr
