@@ -7,6 +7,8 @@ Capability map to the reference's C++ aggregators
 - :class:`FedStride`   ≈ ``FederatedStride`` (federated_stride.cc:5-68)
 - :class:`FedRec`      ≈ ``FederatedRecency`` (federated_recency.cc:7-107)
 - :class:`SecureAgg`   ≈ ``PWA`` over CKKS (private_weighted_average.cc:9-111)
+- :class:`ServerOpt`   — FedAvgM/FedAdam/FedYogi server optimizers (beyond
+  the reference; Reddi et al. adaptive federated optimization)
 
 The reference loops over variables with OpenMP and does byte-blob arithmetic
 per dtype; here a model is a pytree and one jit-compiled scaled-add runs the
@@ -15,16 +17,24 @@ shape — no per-variable dispatch, no host round trips when arrays are
 already on device).
 """
 
+import functools
+
 from metisfl_tpu.aggregation.base import AggregationRule, AggState
 from metisfl_tpu.aggregation.fedavg import FedAvg
 from metisfl_tpu.aggregation.rolling import FedRec, FedStride
 from metisfl_tpu.aggregation.secure import SecureAgg
+from metisfl_tpu.aggregation.serveropt import ServerOpt
 
 AGGREGATION_RULES = {
     "fedavg": FedAvg,
     "fedstride": FedStride,
     "fedrec": FedRec,
     "secure_agg": SecureAgg,
+    # server-side adaptive optimization over the FedAvg fold
+    # (aggregation/serveropt.py — beyond the reference's inventory)
+    "fedavgm": functools.partial(ServerOpt, "fedavgm"),
+    "fedadam": functools.partial(ServerOpt, "fedadam"),
+    "fedyogi": functools.partial(ServerOpt, "fedyogi"),
 }
 
 
@@ -45,6 +55,7 @@ __all__ = [
     "FedStride",
     "FedRec",
     "SecureAgg",
+    "ServerOpt",
     "AGGREGATION_RULES",
     "make_aggregation_rule",
 ]

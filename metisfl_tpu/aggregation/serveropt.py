@@ -1,0 +1,209 @@
+"""Server-side adaptive optimization: FedAvgM / FedAdam / FedYogi.
+
+Adaptive federated optimization (Reddi et al., "Adaptive Federated
+Optimization") treats the round's weighted average as a direction: with the
+previous community model ``w`` and the round average ``avg``, the
+pseudo-gradient is ``g = w - avg``, and the server applies a first-order
+optimizer step to it instead of adopting ``avg`` outright:
+
+- ``fedavgm``: momentum ``m = β1·m + g``;              ``w ← w - lr·m``
+- ``fedadam``: Adam moments on ``g`` (bias-corrected); ``w ← w - lr·m̂/(√v̂+τ)``
+- ``fedyogi``: Adam with Yogi's sign-damped second moment.
+
+The reference has nothing past plain/rolling averaging (its aggregation
+inventory is FedAvg/FedStride/FedRec/PWA — SURVEY.md §2.1 C3-C7); this is
+the standard modern server rule family on top of the same stride-blocked
+fold. The inner averaging reuses :class:`FedAvg` (so the fold is the same
+fused XLA/host-BLAS kernel, one stride block resident at a time), and the
+optimizer state lives host-side in fp32 — it is touched once per round, so
+device residency would buy nothing.
+
+Semantics notes:
+- integer leaves (step counters and the like) take the plain average —
+  adaptive moments on discrete state are meaningless;
+- the first round after a cold start adopts the average as-is and seeds
+  ``w`` (there is no previous community model to step from); when the
+  driver seeds an initial model the controller hands it to
+  :meth:`seed_community`, so round 1 already steps;
+- ``export_state``/``restore_state`` persist ``w``/moments across
+  controller restarts (wired into the controller checkpoint like the
+  rolling rules' scales export).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from metisfl_tpu.aggregation.base import Pytree
+from metisfl_tpu.aggregation.fedavg import FedAvg
+
+_OPTS = ("fedavgm", "fedadam", "fedyogi")
+
+
+class ServerOpt:
+    """Wraps the FedAvg fold with a server optimizer step on the result."""
+
+    required_lineage = 1
+
+    def __init__(self, opt: str = "fedadam", learning_rate: float = 1.0,
+                 beta1: float = 0.9, beta2: float = 0.99, tau: float = 1e-3):
+        if opt not in _OPTS:
+            raise ValueError(f"unknown server optimizer {opt!r}; have {_OPTS}")
+        self.name = opt
+        self.opt = opt
+        self.learning_rate = float(learning_rate)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.tau = float(tau)
+        self._fold = FedAvg()
+        # seed_community arrives from RPC threads while result() runs on the
+        # scheduling executor: one lock orders every state mutation
+        self._state_lock = threading.Lock()
+        self._prev: Optional[Pytree] = None      # fp32 numpy community model
+        self._m: Optional[Pytree] = None
+        self._v: Optional[Pytree] = None
+        self._step = 0
+        # packed state deferred from restore_state until a tree template
+        # exists (wire blobs are name-keyed, structure comes from the model)
+        self._pending: Optional[Dict[str, Any]] = None
+
+    # -- fold interface (the controller streams stride blocks) -------------
+    def reset(self) -> None:
+        """Per-round fold reset. Optimizer state intentionally survives —
+        it is the whole point of the rule; see :meth:`reset_state`."""
+        self._fold.reset()
+
+    def accumulate(
+        self, models: Sequence[Tuple[Sequence[Pytree], float]]
+    ) -> None:
+        self._fold.accumulate(models)
+
+    def result(self) -> Pytree:
+        avg = self._fold.result()
+        with self._state_lock:
+            return self._apply_server_step(avg)
+
+    def aggregate(self, models, state=None) -> Pytree:
+        self.reset()
+        self.accumulate(models)
+        out = self.result()
+        self.reset()
+        return out
+
+    # -- server step -------------------------------------------------------
+    def seed_community(self, community: Pytree) -> None:
+        """Adopt a driver-seeded initial model as the step-from point."""
+        with self._state_lock:
+            self._prev = jax.tree.map(self._to_f32, community)
+
+    @staticmethod
+    def _to_f32(x):
+        x = np.asarray(x)
+        return x if np.issubdtype(x.dtype, np.integer) \
+            else np.asarray(x, np.float32)
+
+    def _apply_server_step(self, avg: Pytree) -> Pytree:
+        self._resolve_pending(avg)
+        if self._prev is None:
+            self._prev = jax.tree.map(self._to_f32, avg)
+            return avg
+        if self._m is None:
+            self._m = jax.tree.map(np.zeros_like,
+                                   jax.tree.map(self._to_f32, avg))
+            self._v = jax.tree.map(np.zeros_like, self._m)
+        self._step += 1
+        lr, b1, b2, tau = (self.learning_rate, self.beta1, self.beta2,
+                           self.tau)
+        opt, step = self.opt, self._step
+
+        def leaf(prev, a, m, v):
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.integer):
+                return a, m, v  # discrete state: adopt the average
+            g = prev - np.asarray(a, np.float32)
+            if opt == "fedavgm":
+                m = b1 * m + g
+                new = prev - lr * m
+            else:
+                m = b1 * m + (1.0 - b1) * g
+                g2 = g * g
+                if opt == "fedadam":
+                    v = b2 * v + (1.0 - b2) * g2
+                else:  # fedyogi
+                    v = v - (1.0 - b2) * g2 * np.sign(v - g2)
+                m_hat = m / (1.0 - b1 ** step)
+                v_hat = v / (1.0 - b2 ** step)
+                new = prev - lr * m_hat / (np.sqrt(v_hat) + tau)
+            return new.astype(np.float32), m, v
+
+        prev_leaves, treedef = jax.tree.flatten(self._prev)
+        avg_leaves = jax.tree.leaves(avg)
+        m_leaves = jax.tree.leaves(self._m)
+        v_leaves = jax.tree.leaves(self._v)
+        new_leaves, new_m, new_v = [], [], []
+        for p, a, m, v in zip(prev_leaves, avg_leaves, m_leaves, v_leaves):
+            n, m2, v2 = leaf(p, a, m, v)
+            new_leaves.append(n)
+            new_m.append(m2)
+            new_v.append(v2)
+        self._prev = jax.tree.unflatten(treedef, new_leaves)
+        self._m = jax.tree.unflatten(treedef, new_m)
+        self._v = jax.tree.unflatten(treedef, new_v)
+        # community keeps each tensor's storage dtype (wire contract)
+        return jax.tree.map(
+            lambda n, a: n.astype(np.asarray(a).dtype), self._prev, avg)
+
+    # -- persistence (controller checkpoint) --------------------------------
+    def export_state(self) -> Dict[str, Any]:
+        from metisfl_tpu.tensor.pytree import pack_model
+
+        with self._state_lock:
+            if self._pending is not None:
+                # restored state not yet resolved against a model template
+                # (no aggregation ran since restore): re-export it verbatim,
+                # else a save-after-restore would silently drop the moments
+                return dict(self._pending, opt=self.opt, step=self._step)
+            out: Dict[str, Any] = {"opt": self.opt, "step": self._step}
+            if self._prev is not None:
+                out["prev"] = pack_model(self._prev)
+            if self._m is not None:
+                out["m"] = pack_model(self._m)
+                out["v"] = pack_model(self._v)
+            return out
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Blobs are name-keyed; the tree structure arrives with the first
+        aggregated model, so unpacking defers until then."""
+        if state.get("opt") not in (None, self.opt):
+            raise ValueError(
+                f"checkpoint server-opt state is for {state.get('opt')!r}, "
+                f"this rule is {self.opt!r}")
+        with self._state_lock:
+            self._step = int(state.get("step", 0))
+            self._pending = state
+
+    def _resolve_pending(self, template: Pytree) -> None:
+        if self._pending is None:
+            return
+        from metisfl_tpu.tensor.pytree import unpack_model
+
+        state, self._pending = self._pending, None
+        if "prev" in state:
+            self._prev = jax.tree.map(
+                self._to_f32, unpack_model(state["prev"], template))
+        if "m" in state:
+            f32_tpl = jax.tree.map(self._to_f32, template)
+            self._m = unpack_model(state["m"], f32_tpl)
+            self._v = unpack_model(state["v"], f32_tpl)
+
+    def reset_state(self) -> None:
+        """Full reset including optimizer state (tests/operators)."""
+        self.reset()
+        with self._state_lock:
+            self._prev = self._m = self._v = None
+            self._step = 0
+            self._pending = None

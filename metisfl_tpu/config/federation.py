@@ -31,7 +31,14 @@ class TerminationConfig:
 
 @dataclass
 class AggregationConfig:
-    rule: str = "fedavg"                     # fedavg | fedstride | fedrec | secure_agg
+    rule: str = "fedavg"                     # fedavg | fedstride | fedrec |
+                                             # secure_agg | fedavgm |
+                                             # fedadam | fedyogi
+    # server-optimizer hyperparameters (fedavgm / fedadam / fedyogi only)
+    server_learning_rate: float = 1.0
+    server_beta1: float = 0.9
+    server_beta2: float = 0.99
+    server_tau: float = 1e-3
     scaler: str = "train_dataset_size"       # participants | train_dataset_size | batches
     stride_length: int = 0                   # 0 → all models in one block
     # how many learners participate per round (1.0 = all) — reference

@@ -137,6 +137,13 @@ class Controller:
             if secure_backend is None:
                 raise ValueError("secure aggregation enabled but no backend given")
             self._aggregator = SecureAgg(secure_backend)
+        elif agg.rule.lower() in ("fedavgm", "fedadam", "fedyogi"):
+            # normalized like make_aggregation_rule, so a mixed-case rule
+            # string cannot silently drop the server hyperparameters
+            self._aggregator = make_aggregation_rule(
+                agg.rule, learning_rate=agg.server_learning_rate,
+                beta1=agg.server_beta1, beta2=agg.server_beta2,
+                tau=agg.server_tau)
         else:
             self._aggregator = make_aggregation_rule(agg.rule)
         self._scaler = make_scaler(agg.scaler)
@@ -286,6 +293,10 @@ class Controller:
             self._community_blob = bytes(blob_bytes)
             if blob.tensors:
                 self._community_flat = dict(blob.tensors)
+                if hasattr(self._aggregator, "seed_community"):
+                    # server-opt rules step FROM the seeded model (a mid-run
+                    # replacement intentionally re-anchors the optimizer)
+                    self._aggregator.seed_community(self._community_flat)
             if blob.opaque:
                 self._community_opaque = dict(blob.opaque)
 
@@ -607,10 +618,12 @@ class Controller:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
             community = self._aggregator.aggregate(self._parse_secure(pairs))
-        elif self._aggregator.name == "fedavg":
-            # FedAvg is a fold: accumulate block-by-block so only one stride
-            # block of models is ever resident (the point of the reference's
-            # stride loop, controller.cc:842-936).
+        elif hasattr(self._aggregator, "accumulate"):
+            # Fold rules (FedAvg and the ServerOpt family wrapping it):
+            # accumulate block-by-block so only one stride block of models is
+            # ever resident (the point of the reference's stride loop,
+            # controller.cc:842-936). ServerOpt applies its optimizer step
+            # once, inside result().
             self._aggregator.reset()
             accumulated = 0
             for i in range(0, len(ids), stride):
@@ -836,6 +849,9 @@ class Controller:
             # store's lineage (aggregation/rolling.py rehydrate).
             if hasattr(self._aggregator, "export_scales"):
                 state["agg_scales"] = self._aggregator.export_scales()
+            # server-opt rules persist their moments + step-from model
+            if hasattr(self._aggregator, "export_state"):
+                state["agg_state"] = self._aggregator.export_state()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # unique temp per writer: concurrent saves (per-round auto-checkpoint
@@ -883,6 +899,11 @@ class Controller:
             restored = self._aggregator.rehydrate(self._store, agg_scales)
             logger.info("rehydrated %d/%d rolling contributions from store",
                         restored, len(agg_scales))
+        agg_state = state.get("agg_state")
+        if agg_state and hasattr(self._aggregator, "restore_state"):
+            # server-opt restart-correctness: moments + step counter resume
+            # the exact update sequence of an uninterrupted run
+            self._aggregator.restore_state(agg_state)
         logger.info("restored checkpoint %s at round %d",
                     path, self.global_iteration)
         return True
