@@ -123,6 +123,15 @@ class TrainParams(Message):
     # wire representation is narrowed. Ignored under secure aggregation
     # (HE/masking payloads have their own fixed-point encoding).
     ship_dtype: str = ""
+    # Client-level differential privacy on the shipped update
+    # (secure/dp.py): the delta vs the received community model is
+    # L2-clipped to dp_clip_norm (> 0 enables; also a robustness tool on
+    # its own) and Gaussian noise with per-coordinate std
+    # dp_noise_multiplier * dp_clip_norm is added. Composes with secure
+    # aggregation (privatize, then encrypt/mask). Account the guarantee
+    # with secure.dp.rdp_epsilon(noise_multiplier, rounds, delta).
+    dp_clip_norm: float = 0.0
+    dp_noise_multiplier: float = 0.0
 
 
 @dataclass

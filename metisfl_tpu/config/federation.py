@@ -157,6 +157,17 @@ class FederationConfig:
             raise ValueError(f"unknown protocol {self.protocol!r}")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
+        if self.train.dp_noise_multiplier < 0.0 or self.train.dp_clip_norm < 0.0:
+            # a sign typo must not silently disable the mechanism
+            raise ValueError("dp_clip_norm and dp_noise_multiplier must be "
+                             ">= 0")
+        if (self.train.dp_noise_multiplier > 0.0
+                and self.train.dp_clip_norm <= 0.0):
+            # the noise std is noise_multiplier * clip_norm — without a
+            # clip bound the mechanism has no sensitivity and no guarantee
+            raise ValueError(
+                "dp_noise_multiplier > 0 requires dp_clip_norm > 0 "
+                "(noise scales with the clip bound)")
         if self.train.ship_dtype:
             # a typo here would otherwise fail only after round 1's full
             # local training, on every learner, every round

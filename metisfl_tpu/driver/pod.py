@@ -53,6 +53,12 @@ class PodFederationDriver:
         if config.secure.enabled:
             raise ValueError("pod transport keeps weights on-device; secure "
                              "aggregation applies to the host path")
+        if config.train.dp_clip_norm > 0.0:
+            # refusing beats silently training without the configured
+            # guarantee: the on-device round never runs privatize_update
+            raise ValueError(
+                "pod transport does not implement client-level DP "
+                "(dp_clip_norm); use the host path for DP federations")
         self.config = config
         self.datasets = list(train_datasets)
         self.test_dataset = test_dataset

@@ -142,8 +142,11 @@ class Learner:
             lambda a, t: a if a.dtype == t.dtype else np.asarray(a, t.dtype),
             tree, self._treedef_like)
 
-    def _dump_model(self, ship_dtype: str = "") -> bytes:
-        named = pytree_to_named_tensors(self.model_ops.get_variables())
+    def _dump_model(self, ship_dtype: str = "",
+                    variables=None) -> bytes:
+        if variables is None:
+            variables = self.model_ops.get_variables()
+        named = pytree_to_named_tensors(variables)
         if self.secure_backend is not None:
             from metisfl_tpu.tensor.spec import TensorSpec, wire_dtype_of, TensorKind
             opaque = {}
@@ -195,7 +198,8 @@ class Learner:
                     params, profile_dir=_os.path.join(
                         params.profile_dir,
                         self.learner_id or f"port_{self.port}"))
-            self.model_ops.set_variables(self._load_model(task.model))
+            incoming = self._load_model(task.model)
+            self.model_ops.set_variables(incoming)
             out = self.model_ops.train(self.datasets["train"], params,
                                        cancel_event=self._cancel)
             # round-scoped mask derivation (pairwise-masking secure agg)
@@ -205,12 +209,21 @@ class Learner:
             if self._cancel.is_set():
                 logger.info("%s: task %s cancelled", self.learner_id, task.task_id)
                 return
+            ship_vars = None
+            if params.dp_clip_norm > 0.0:
+                # client-level DP: clip + noise the update BEFORE any
+                # encryption/masking or wire narrowing (secure/dp.py)
+                from metisfl_tpu.secure.dp import privatize_update
+                ship_vars = privatize_update(
+                    self.model_ops.get_variables(), incoming,
+                    params.dp_clip_norm, params.dp_noise_multiplier)
             result = TaskResult(
                 task_id=task.task_id,
                 learner_id=self.learner_id,
                 auth_token=self.auth_token,
                 round_id=task.round_id,
-                model=self._dump_model(ship_dtype=params.ship_dtype),
+                model=self._dump_model(ship_dtype=params.ship_dtype,
+                                       variables=ship_vars),
                 num_train_examples=len(self.datasets["train"]),
                 completed_steps=out.completed_steps,
                 completed_epochs=out.completed_epochs,

@@ -1,0 +1,168 @@
+"""Client-level differential privacy (secure/dp.py): clipping math, noise
+calibration, accounting, and the federation integration."""
+
+import math
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.secure.dp import privatize_update, rdp_epsilon
+
+
+def _trees(delta):
+    community = {"w": np.zeros((4, 4), np.float32),
+                 "b": np.zeros((4,), np.float32),
+                 "count": np.asarray([3, 3], np.int32)}
+    trained = {"w": community["w"] + delta,
+               "b": community["b"] + delta[0],
+               "count": np.asarray([5, 7], np.int32)}
+    return trained, community
+
+
+def _global_norm(tree_a, tree_b):
+    return math.sqrt(sum(
+        float(np.sum((np.asarray(a, np.float64) - np.asarray(b)) ** 2))
+        for (a, b) in [(tree_a["w"], tree_b["w"]),
+                       (tree_a["b"], tree_b["b"])]))
+
+
+def test_small_update_passes_through_exactly():
+    delta = np.full((4, 4), 0.01, np.float32)
+    trained, community = _trees(delta)
+    out = privatize_update(trained, community, clip_norm=100.0)
+    np.testing.assert_allclose(out["w"], trained["w"], atol=1e-6)
+    np.testing.assert_allclose(out["b"], trained["b"], atol=1e-6)
+
+
+def test_large_update_clipped_to_global_norm():
+    delta = np.full((4, 4), 3.0, np.float32)
+    trained, community = _trees(delta)
+    clip = 1.5
+    out = privatize_update(trained, community, clip_norm=clip)
+    norm = _global_norm(out, community)
+    assert norm == pytest.approx(clip, rel=1e-4)
+    # direction preserved: scaled version of the raw delta
+    raw = trained["w"] - community["w"]
+    got = out["w"] - community["w"]
+    np.testing.assert_allclose(got / np.linalg.norm(got.ravel()),
+                               raw / np.linalg.norm(raw.ravel()), atol=1e-5)
+
+
+def test_integer_leaves_ship_as_trained():
+    trained, community = _trees(np.full((4, 4), 3.0, np.float32))
+    out = privatize_update(trained, community, clip_norm=0.1,
+                           noise_multiplier=5.0)
+    np.testing.assert_array_equal(out["count"], trained["count"])
+    assert out["count"].dtype == np.int32
+
+
+def test_noise_calibrated_to_multiplier_times_clip():
+    rng = np.random.default_rng(0)
+    community = {"w": np.zeros((400, 400), np.float32)}
+    trained = {"w": community["w"].copy()}  # zero delta: output IS the noise
+    clip, mult = 2.0, 0.5
+    out = privatize_update(trained, community, clip, mult, rng=rng)
+    std = float(np.std(out["w"]))
+    assert std == pytest.approx(clip * mult, rel=0.02)
+
+
+def test_noise_stream_not_reproducible_by_default():
+    trained, community = _trees(np.full((4, 4), 1.0, np.float32))
+    a = privatize_update(trained, community, 1.0, 1.0)
+    b = privatize_update(trained, community, 1.0, 1.0)
+    assert not np.array_equal(a["w"], b["w"])
+
+
+def test_privatize_validates_clip():
+    trained, community = _trees(np.zeros((4, 4), np.float32))
+    with pytest.raises(ValueError, match="clip_norm"):
+        privatize_update(trained, community, 0.0)
+
+
+def test_rdp_epsilon_properties():
+    # monotone: more noise → less epsilon; more rounds → more epsilon
+    assert rdp_epsilon(2.0, 10) < rdp_epsilon(1.0, 10)
+    assert rdp_epsilon(1.0, 100) > rdp_epsilon(1.0, 10)
+    assert rdp_epsilon(0.0, 10) == math.inf
+    assert rdp_epsilon(1.0, 0) == 0.0
+    # single Gaussian release at sigma=1, delta=1e-5: epsilon via the RDP
+    # conversion min_a [a/2 + log(1e5)/(a-1)] ~= 5.29 (a-1 = sqrt(2 ln 1e5))
+    want = min(a / 2 + math.log(1e5) / (a - 1)
+               for a in np.linspace(1.001, 100, 200000))
+    assert rdp_epsilon(1.0, 1, 1e-5) == pytest.approx(want, rel=1e-2)
+
+
+def test_negative_dp_params_rejected():
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+
+    with pytest.raises(ValueError, match=">= 0"):
+        FederationConfig(
+            aggregation=AggregationConfig(scaler="participants"),
+            train=TrainParams(dp_clip_norm=1.0, dp_noise_multiplier=-1.0),
+            eval=EvalConfig(),
+            termination=TerminationConfig(federation_rounds=1),
+        )
+    with pytest.raises(ValueError, match="noise_multiplier"):
+        privatize_update(*_trees(np.zeros((4, 4), np.float32)),
+                         clip_norm=1.0, noise_multiplier=-0.5)
+
+
+def test_pod_driver_rejects_dp_config():
+    """The pod round never runs privatize_update: refusing at construction
+    beats silently training without the configured guarantee."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+    from metisfl_tpu.driver.pod import PodFederationDriver
+    from metisfl_tpu.models import ArrayDataset
+    from metisfl_tpu.models.zoo import MLP
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1, dp_clip_norm=1.0),
+        eval=EvalConfig(),
+        termination=TerminationConfig(federation_rounds=1),
+    )
+    ds = [ArrayDataset(np.zeros((8, 4), np.float32),
+                       np.zeros((8,), np.int32))]
+    with pytest.raises(ValueError, match="dp_clip_norm"):
+        PodFederationDriver(config, MLP(features=(4,), num_outputs=2), ds)
+
+
+def test_config_rejects_noise_without_clip():
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+
+    with pytest.raises(ValueError, match="dp_clip_norm"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(dp_noise_multiplier=1.0),
+            eval=EvalConfig(),
+            termination=TerminationConfig(federation_rounds=1),
+        )
+
+
+def test_dp_federation_completes_and_learns():
+    """3-learner federation with clipping + mild noise: rounds complete and
+    the community model still learns the task (DP costs accuracy, not
+    liveness)."""
+    from tests.test_federation_inprocess import _make_federation
+
+    fed, _ = _make_federation(local_steps=8)
+    fed.config.train.dp_clip_norm = 50.0          # generous: mild clipping
+    fed.config.train.dp_noise_multiplier = 1e-3   # mild noise
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=180)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.5
+    finally:
+        fed.shutdown()
