@@ -44,6 +44,11 @@ class AggregationConfig:
     # how many learners participate per round (1.0 = all) — reference
     # ControllerParams.participation_ratio
     participation_ratio: float = 1.0
+    # FedAsync-style staleness damping: contribution weights multiply by
+    # (1 + staleness_rounds)^-decay and renormalize. 0 disables. Only
+    # meaningful under the asynchronous protocol (synchronous barriers
+    # have staleness 0 everywhere).
+    staleness_decay: float = 0.0
 
 
 @dataclass
@@ -161,6 +166,17 @@ class FederationConfig:
             # a sign typo must not silently disable the mechanism
             raise ValueError("dp_clip_norm and dp_noise_multiplier must be "
                              ">= 0")
+        if self.aggregation.staleness_decay < 0.0:
+            raise ValueError("staleness_decay must be >= 0")
+        if (self.secure.enabled and self.secure.scheme == "masking"
+                and self.aggregation.staleness_decay > 0.0):
+            # damping re-introduces non-uniform scales AFTER the scaler, and
+            # pairwise masks only cancel under uniform scales — a deadline
+            # straggler would otherwise poison every aggregation until the
+            # failure limit halts the federation
+            raise ValueError(
+                "staleness_decay is incompatible with masking secure "
+                "aggregation (masks only cancel under uniform scales)")
         if (self.train.dp_noise_multiplier > 0.0
                 and self.train.dp_clip_norm <= 0.0):
             # the noise std is noise_multiplier * clip_norm — without a

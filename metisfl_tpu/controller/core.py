@@ -48,7 +48,7 @@ from metisfl_tpu.comm.messages import (
     TrainTask,
 )
 from metisfl_tpu.config import FederationConfig
-from metisfl_tpu.scaling import make_scaler
+from metisfl_tpu.scaling import apply_staleness_decay, make_scaler
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
 from metisfl_tpu.selection import make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
@@ -87,6 +87,9 @@ class LearnerRecord:
     ms_per_step: float = 0.0
     # consecutive failed train dispatches (liveness; reset on completion)
     dispatch_failures: int = 0
+    # round the latest accepted contribution was DISPATCHED from (async
+    # staleness: a result computed against an old community model)
+    last_result_round: int = -1
     # per-learner train overrides (semi-sync step budgets)
     local_steps_override: int = 0
     proxy: Optional[LearnerProxy] = None
@@ -108,6 +111,9 @@ class RoundMetadata:
     aggregation_block_sizes: List[int] = field(default_factory=list)
     aggregation_block_duration_ms: List[float] = field(default_factory=list)
     aggregation_duration_ms: float = 0.0
+    # the contribution weights actually applied this round (post scaler and
+    # staleness damping) — reference lineage has nothing comparable
+    scales: Dict[str, float] = field(default_factory=dict)
     model_insertion_duration_ms: Dict[str, float] = field(default_factory=dict)
     model_size: Dict[str, int] = field(default_factory=dict)
     peak_rss_kb: int = 0
@@ -360,6 +366,7 @@ class Controller:
                 return
             record.completed_batches = result.completed_batches
             record.dispatch_failures = 0  # provably reachable
+            record.last_result_round = result.round_id
             if result.processing_ms_per_step > 0:
                 record.ms_per_step = result.processing_ms_per_step
             self._tasks_in_flight.pop(result.task_id, None)
@@ -590,7 +597,11 @@ class Controller:
         t0 = time.time()
         lineage_k = self._aggregator.required_lineage
         stride = self.config.aggregation.stride_length or len(selected) or 1
-        scales = self._scaler(self._scaling_metadata(selected))
+        metadata = self._scaling_metadata(selected)
+        scales = self._scaler(metadata)
+        decay = self.config.aggregation.staleness_decay
+        if decay > 0.0:
+            scales = apply_staleness_decay(scales, metadata, decay)
         # FedStride state resets between rounds (federated_stride.cc:52-68);
         # FedRec carries state across rounds; FedAvg resets in its own branch.
         if self._aggregator.name == "fedstride":
@@ -667,6 +678,8 @@ class Controller:
             self._community_blob = blob
             meta = self._current_meta
             meta.selected_learners = list(selected)
+            meta.scales = {lid: round(float(w), 6)
+                           for lid, w in scales.items()}
             meta.aggregation_block_sizes = meta_blocks
             meta.aggregation_block_duration_ms = meta_durations
             meta.aggregation_duration_ms = (time.time() - t0) * 1e3
@@ -699,12 +712,16 @@ class Controller:
 
     def _scaling_metadata(self, selected: Sequence[str]) -> Dict[str, Dict[str, float]]:
         with self._lock:
+            records = [(lid, self._learners[lid]) for lid in selected]
             return {
                 lid: {
-                    "num_train_examples": self._learners[lid].num_train_examples,
-                    "completed_batches": self._learners[lid].completed_batches,
+                    "num_train_examples": r.num_train_examples,
+                    "completed_batches": r.completed_batches,
+                    "staleness": float(max(
+                        0, self.global_iteration - r.last_result_round))
+                    if r.last_result_round >= 0 else 0.0,
                 }
-                for lid in selected
+                for lid, r in records
                 if lid in self._learners
             }
 

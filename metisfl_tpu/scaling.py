@@ -32,6 +32,28 @@ def train_dataset_size_scaler(metadata: Metadata) -> Dict[str, float]:
     return {lid: s / total for lid, s in sizes.items()}
 
 
+def apply_staleness_decay(scales: Dict[str, float], metadata: Metadata,
+                          decay: float) -> Dict[str, float]:
+    """Down-weight stale contributions: scale *= (1 + staleness)^-decay,
+    renormalized (FedAsync-style polynomial staleness damping).
+
+    ``staleness`` is how many rounds behind the current community model a
+    learner's latest contribution was computed — 0 for everyone under a
+    synchronous barrier (no-op there); under the asynchronous protocol a
+    slow learner's update trained against an old model stops steering the
+    aggregate as hard as a fresh one. The reference weighs all async
+    contributions equally regardless of age.
+    """
+    damped = {
+        lid: w * (1.0 + float(metadata[lid].get("staleness", 0.0))) ** -decay
+        for lid, w in scales.items()
+    }
+    total = sum(damped.values())
+    if total <= 0.0:
+        return scales
+    return {lid: w / total for lid, w in damped.items()}
+
+
 def batches_scaler(metadata: Metadata) -> Dict[str, float]:
     """Weights proportional to completed batches in the last task."""
     batches = {lid: float(m.get("completed_batches", 0)) for lid, m in metadata.items()}

@@ -107,6 +107,35 @@ def test_async_fedrec_federation():
         fed.shutdown()
 
 
+def test_async_staleness_decay_federation():
+    """Async federation with FedAsync-style staleness damping: a slowed
+    learner's contribution is provably down-weighted — some recorded
+    round's applied scales (new lineage field) are non-uniform, which
+    under the uniform participants scaler can only come from the decay."""
+    import time as _time
+
+    fed, _ = _make_federation(protocol="asynchronous")
+    fed.config.aggregation.staleness_decay = 1.0
+    # learner 2 lags: its results arrive with staleness > 0 while the
+    # fast learners keep advancing the global round counter
+    slow = fed.learners[2]
+    orig = slow.run_task
+    slow.run_task = lambda task: (_time.sleep(0.8), orig(task))[-1]
+
+    def saw_damped_round():
+        metas = fed.statistics()["round_metadata"]
+        return any(
+            len(set(m["scales"].values())) > 1 for m in metas if m["scales"])
+
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(4, timeout_s=120)
+        assert fed.wait_until(saw_damped_round, timeout_s=60), (
+            "no round recorded non-uniform scales; decay never applied")
+    finally:
+        fed.shutdown()
+
+
 def test_fedstride_with_stride_blocks():
     fed, _ = _make_federation(rule="fedstride", stride=2)
     try:

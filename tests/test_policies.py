@@ -300,3 +300,33 @@ class TestStragglerExpiry:
         from metisfl_tpu.scheduling import make_scheduler
         s = make_scheduler("asynchronous")
         assert s.expire_pending(["a"]) == []
+
+
+class TestStalenessDecay:
+    def test_fresh_contributions_unchanged(self):
+        from metisfl_tpu.scaling import apply_staleness_decay
+
+        scales = {"a": 0.5, "b": 0.5}
+        meta = {"a": {"staleness": 0.0}, "b": {"staleness": 0.0}}
+        out = apply_staleness_decay(scales, meta, decay=1.0)
+        assert out == pytest.approx({"a": 0.5, "b": 0.5})
+
+    def test_stale_contribution_downweighted_and_renormalized(self):
+        from metisfl_tpu.scaling import apply_staleness_decay
+
+        scales = {"fresh": 0.5, "stale": 0.5}
+        meta = {"fresh": {"staleness": 0.0}, "stale": {"staleness": 3.0}}
+        out = apply_staleness_decay(scales, meta, decay=1.0)
+        # stale damped by 1/(1+3) = 0.25x -> weights 0.5 : 0.125 -> 0.8 : 0.2
+        assert out["fresh"] == pytest.approx(0.8)
+        assert out["stale"] == pytest.approx(0.2)
+        assert sum(out.values()) == pytest.approx(1.0)
+
+    def test_decay_strength_scales_damping(self):
+        from metisfl_tpu.scaling import apply_staleness_decay
+
+        scales = {"fresh": 0.5, "stale": 0.5}
+        meta = {"fresh": {"staleness": 0.0}, "stale": {"staleness": 3.0}}
+        soft = apply_staleness_decay(scales, meta, decay=0.5)
+        hard = apply_staleness_decay(scales, meta, decay=2.0)
+        assert hard["stale"] < soft["stale"] < 0.5
