@@ -20,7 +20,7 @@ already on device).
 import functools
 
 from metisfl_tpu.aggregation.base import AggregationRule, AggState
-from metisfl_tpu.aggregation.fedavg import FedAvg
+from metisfl_tpu.aggregation.fedavg import FedAvg, Scaffold
 from metisfl_tpu.aggregation.rolling import FedRec, FedStride
 from metisfl_tpu.aggregation.secure import SecureAgg
 from metisfl_tpu.aggregation.serveropt import ServerOpt
@@ -30,6 +30,7 @@ AGGREGATION_RULES = {
     "fedstride": FedStride,
     "fedrec": FedRec,
     "secure_agg": SecureAgg,
+    "scaffold": Scaffold,
     # server-side adaptive optimization over the FedAvg fold
     # (aggregation/serveropt.py — beyond the reference's inventory)
     "fedavgm": functools.partial(ServerOpt, "fedavgm"),
@@ -54,6 +55,7 @@ __all__ = [
     "FedAvg",
     "FedStride",
     "FedRec",
+    "Scaffold",
     "SecureAgg",
     "ServerOpt",
     "AGGREGATION_RULES",

@@ -103,3 +103,15 @@ class FedAvg:
         out = self.result()
         self.reset()
         return out
+
+
+class Scaffold(FedAvg):
+    """SCAFFOLD (Karimireddy et al.): weights aggregate exactly like FedAvg;
+    the control-variate machinery lives around the fold — learners correct
+    their local gradients by (c - c_i) and ship control deltas
+    (learner/learner.py), the controller folds the cohort's deltas into the
+    server variate c and ships c with every task (controller/core.py
+    _fold_scaffold_controls). This class exists so the rule name selects
+    that protocol while reusing the stride-blocked weight fold."""
+
+    name = "scaffold"

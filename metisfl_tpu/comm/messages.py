@@ -163,6 +163,12 @@ class TrainTask(Message):
     global_iteration: int = 0
     model: bytes = b""          # ModelBlob wire bytes (community model)
     params: TrainParams = field(default_factory=TrainParams)
+    # SCAFFOLD (aggregation.rule='scaffold'): ``scaffold`` marks the task
+    # as control-variate-corrected (the learner must report a delta even
+    # while the server variate is still zero), ``control`` carries the
+    # server variate c as a ModelBlob (empty = zeros).
+    scaffold: bool = False
+    control: bytes = b""
 
 
 @dataclass
@@ -181,6 +187,9 @@ class TaskResult(Message):
     processing_ms_per_step: float = 0.0
     train_metrics: Dict[str, float] = field(default_factory=dict)
     epoch_metrics: List[Dict[str, float]] = field(default_factory=list)
+    # SCAFFOLD client control-variate delta (c_i_new - c_i, ModelBlob);
+    # the controller folds the cohort's deltas into the server variate.
+    control_delta: bytes = b""
 
 
 @dataclass

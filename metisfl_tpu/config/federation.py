@@ -168,6 +168,27 @@ class FederationConfig:
                              ">= 0")
         if self.aggregation.staleness_decay < 0.0:
             raise ValueError("staleness_decay must be >= 0")
+        if self.aggregation.rule.lower() == "scaffold":
+            if self.secure.enabled:
+                # control deltas (essentially averaged local gradients)
+                # would ship and fold in plaintext next to encrypted model
+                # payloads, defeating the keyless-controller guarantee
+                raise ValueError(
+                    "scaffold is incompatible with secure aggregation: "
+                    "control deltas are not encrypted/masked")
+            if self.train.dp_clip_norm > 0.0:
+                # the model delta would be privatized but the control delta
+                # ships raw — the DP guarantee would silently not hold
+                raise ValueError(
+                    "scaffold is incompatible with dp_clip_norm: control "
+                    "deltas are not privatized, so the DP guarantee would "
+                    "not cover them")
+            if any(int(getattr(ep, "world_size", 1)) > 1
+                   for ep in self.learners):
+                # the multi-host replay protocol has no grad-offset op
+                raise ValueError(
+                    "scaffold is not supported for multi-host learner "
+                    "worlds (world_size > 1)")
         if (self.secure.enabled and self.secure.scheme == "masking"
                 and self.aggregation.staleness_decay > 0.0):
             # damping re-introduces non-uniform scales AFTER the scaler, and

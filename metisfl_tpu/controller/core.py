@@ -153,6 +153,11 @@ class Controller:
         else:
             self._aggregator = make_aggregation_rule(agg.rule)
         self._scaler = make_scaler(agg.scaler)
+        # SCAFFOLD server control variate c (name -> f32 array) and the
+        # cohort's latest unconsumed control deltas (learner_id -> blob)
+        self._scaffold_c: Optional[Dict[str, np.ndarray]] = None
+        self._scaffold_c_blob: Optional[bytes] = None   # pack cache
+        self._scaffold_deltas: Dict[str, bytes] = {}
         self._selector = make_selector("scheduled_cardinality")
         if config.protocol == "semi_synchronous":
             self._scheduler = make_scheduler(
@@ -367,6 +372,8 @@ class Controller:
             record.completed_batches = result.completed_batches
             record.dispatch_failures = 0  # provably reachable
             record.last_result_round = result.round_id
+            if result.control_delta:
+                self._scaffold_deltas[result.learner_id] = result.control_delta
             if result.processing_ms_per_step > 0:
                 record.ms_per_step = result.processing_ms_per_step
             self._tasks_in_flight.pop(result.task_id, None)
@@ -669,6 +676,9 @@ class Controller:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
 
+        if self._aggregator.name == "scaffold":
+            self._fold_scaffold_controls(ids)
+
         blob = self._community_to_blob(community)
         with self._lock:
             if self.config.secure.enabled:
@@ -710,9 +720,51 @@ class Controller:
         named = [(name, np.asarray(arr)) for name, arr in community.items()]
         return ModelBlob(tensors=named).to_bytes()
 
+    def _pack_scaffold_c(self) -> bytes:
+        """Wire bytes of the server control variate (empty until the first
+        cohort's deltas fold in — learners treat empty as zeros). Cached —
+        c only changes at fold/restore, and re-serializing a model-sized
+        tree per learner per dispatch inside the lock would stall the RPC
+        handlers. Call with ``self._lock`` held (dispatch does)."""
+        if self._scaffold_c is None:
+            return b""
+        if self._scaffold_c_blob is None:
+            from metisfl_tpu.tensor.pytree import ModelBlob
+            self._scaffold_c_blob = ModelBlob(
+                tensors=sorted(self._scaffold_c.items())).to_bytes()
+        return self._scaffold_c_blob
+
+    def _fold_scaffold_controls(self, cohort: Sequence[str]) -> None:
+        """c += (1/N) * sum over the cohort's control deltas (SCAFFOLD
+        server update, |S|/N * mean over S — N = active learners)."""
+        from metisfl_tpu.tensor.pytree import ModelBlob
+        with self._lock:
+            blobs = [self._scaffold_deltas.pop(lid)
+                     for lid in cohort if lid in self._scaffold_deltas]
+            n_active = max(1, len(self._learners))
+        if not blobs:
+            return
+        total: Dict[str, np.ndarray] = {}
+        for raw in blobs:
+            for name, arr in ModelBlob.from_bytes(raw).tensors:
+                arr = np.asarray(arr, np.float32)
+                total[name] = total.get(name, 0.0) + arr
+        with self._lock:
+            if self._scaffold_c is None:
+                self._scaffold_c = {n: np.zeros_like(a)
+                                    for n, a in total.items()}
+            for name, summed in total.items():
+                if name in self._scaffold_c:
+                    self._scaffold_c[name] = (
+                        self._scaffold_c[name] + summed / n_active)
+            self._scaffold_c_blob = None  # invalidate the pack cache
+
     def _scaling_metadata(self, selected: Sequence[str]) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            records = [(lid, self._learners[lid]) for lid in selected]
+            # a learner may leave between cohort selection and aggregation —
+            # skip departed ids instead of KeyErroring the round
+            records = [(lid, self._learners[lid]) for lid in selected
+                       if lid in self._learners]
             return {
                 lid: {
                     "num_train_examples": r.num_train_examples,
@@ -758,6 +810,8 @@ class Controller:
                     global_iteration=self.global_iteration,
                     model=blob,
                     params=params,
+                    scaffold=self._aggregator.name == "scaffold",
+                    control=self._pack_scaffold_c(),
                 )
                 self._tasks_in_flight[task.task_id] = lid
                 self._current_meta.train_submitted_at[lid] = time.time()
@@ -869,6 +923,8 @@ class Controller:
             # server-opt rules persist their moments + step-from model
             if hasattr(self._aggregator, "export_state"):
                 state["agg_state"] = self._aggregator.export_state()
+            if self._scaffold_c is not None:
+                state["scaffold_c"] = self._pack_scaffold_c()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # unique temp per writer: concurrent saves (per-round auto-checkpoint
@@ -916,6 +972,14 @@ class Controller:
             restored = self._aggregator.rehydrate(self._store, agg_scales)
             logger.info("rehydrated %d/%d rolling contributions from store",
                         restored, len(agg_scales))
+        scaffold_c = state.get("scaffold_c")
+        if scaffold_c:
+            from metisfl_tpu.tensor.pytree import ModelBlob
+            with self._lock:
+                self._scaffold_c = {
+                    name: np.asarray(arr, np.float32)
+                    for name, arr in ModelBlob.from_bytes(scaffold_c).tensors}
+                self._scaffold_c_blob = None
         agg_state = state.get("agg_state")
         if agg_state and hasattr(self._aggregator, "restore_state"):
             # server-opt restart-correctness: moments + step counter resume

@@ -91,6 +91,10 @@ class Learner:
         self._shutdown = threading.Event()
         # reference treedef for wire ↔ pytree (captured at construction)
         self._treedef_like = model_ops.get_variables()
+        # SCAFFOLD client control variate c_i (params-shaped, f32; zeros
+        # until the first scaffold task). In-memory only: a restarted
+        # learner restarts its variate at zero, which SCAFFOLD tolerates.
+        self._scaffold_ci = None
 
     # ------------------------------------------------------------------ #
     # membership
@@ -200,8 +204,23 @@ class Learner:
                         self.learner_id or f"port_{self.port}"))
             incoming = self._load_model(task.model)
             self.model_ops.set_variables(incoming)
+            grad_offset = None
+            scaffold_c = None
+            if task.scaffold or task.control:
+                scaffold_c, grad_offset = self._scaffold_offset(task.control)
+            elif self._scaffold_ci is not None:
+                # the federation stopped running scaffold (e.g. controller
+                # restarted under another rule): a stale variate must not
+                # keep correcting gradients
+                self._scaffold_ci = None
+            # grad_offset rides as a kwarg only when present: multi-host
+            # LeaderOps.train has no such parameter (scaffold + multi-host
+            # is rejected at config time)
+            train_kwargs = ({"grad_offset": grad_offset}
+                            if grad_offset is not None else {})
             out = self.model_ops.train(self.datasets["train"], params,
-                                       cancel_event=self._cancel)
+                                       cancel_event=self._cancel,
+                                       **train_kwargs)
             # round-scoped mask derivation (pairwise-masking secure agg)
             if self.secure_backend is not None and hasattr(
                     self.secure_backend, "begin_round"):
@@ -209,6 +228,10 @@ class Learner:
             if self._cancel.is_set():
                 logger.info("%s: task %s cancelled", self.learner_id, task.task_id)
                 return
+            control_delta = b""
+            if scaffold_c is not None:
+                control_delta = self._scaffold_update(
+                    incoming, params, out.completed_steps, scaffold_c)
             ship_vars = None
             if params.dp_clip_norm > 0.0:
                 # client-level DP: clip + noise the update BEFORE any
@@ -231,11 +254,53 @@ class Learner:
                 processing_ms_per_step=out.ms_per_step,
                 train_metrics=out.train_metrics,
                 epoch_metrics=out.epoch_metrics,
+                control_delta=control_delta,
             )
             self.controller.task_completed(result)
         except Exception:
             logger.exception("%s: training task %s failed",
                              self.learner_id, task.task_id)
+
+    def _scaffold_offset(self, control_bytes: bytes):
+        """(c, c - c_i) for this task — both params-shaped f32 trees.
+        An empty control blob means the server variate is still zero
+        (first rounds); c_i initializes to zeros on first use."""
+        import jax
+
+        params_tpl = self._treedef_like["params"]
+        zeros = lambda: jax.tree.map(
+            lambda p: np.zeros(np.shape(p), np.float32), params_tpl)
+        if control_bytes:
+            blob = ModelBlob.from_bytes(control_bytes)
+            c = named_tensors_to_pytree(blob.tensors, params_tpl)
+            c = jax.tree.map(lambda a: np.asarray(a, np.float32), c)
+        else:
+            c = zeros()
+        if self._scaffold_ci is None:
+            self._scaffold_ci = zeros()
+        offset = jax.tree.map(lambda a, b: a - b, c, self._scaffold_ci)
+        return c, offset
+
+    def _scaffold_update(self, incoming, params_cfg, completed_steps: int,
+                         c) -> bytes:
+        """Option-II variate update (Karimireddy et al. eq. 4):
+        c_i+ = c_i - c + (x - y_i) / (K * lr); ships dc = c_i+ - c_i.
+        Assumes SGD local steps (the standard SCAFFOLD setting) — with an
+        adaptive local optimizer the variate is a heuristic."""
+        import jax
+
+        k_lr = max(1, completed_steps) * float(params_cfg.learning_rate)
+        x = incoming["params"]
+        y = self.model_ops.get_variables()["params"]
+        ci = self._scaffold_ci
+        ci_new = jax.tree.map(
+            lambda ci_l, c_l, x_l, y_l: ci_l - c_l
+            + (np.asarray(x_l, np.float32) - np.asarray(y_l, np.float32))
+            / k_lr,
+            ci, c, x, y)
+        dc = jax.tree.map(lambda a, b: a - b, ci_new, ci)
+        self._scaffold_ci = ci_new
+        return ModelBlob(tensors=pytree_to_named_tensors(dc)).to_bytes()
 
     def evaluate(self, task: EvalTask) -> EvalResult:
         """Blocking community-model evaluation over requested datasets."""

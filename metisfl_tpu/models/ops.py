@@ -277,10 +277,18 @@ class FlaxModelOps:
                 loss = loss + 0.5 * mu * prox
             return loss, (logits, new_bs)
 
-        def step(params, batch_stats, opt_state, global_params, x, y, rng):
+        def step(params, batch_stats, opt_state, global_params, grad_offset,
+                 x, y, rng):
             (loss, (logits, new_bs)), grads = jax.value_and_grad(
                 loss_and_aux, has_aux=True)(params, batch_stats, global_params,
                                             x, y, rng)
+            if jax.tree_util.tree_leaves(grad_offset):
+                # control-variate correction (SCAFFOLD: c - c_i); the empty
+                # tree compiles to the uncorrected program — structure is
+                # static at trace time
+                grads = jax.tree.map(
+                    lambda g, o: g + jnp.asarray(o, g.dtype),
+                    grads, grad_offset)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             acc = _accuracy(logits, y)
@@ -304,7 +312,7 @@ class FlaxModelOps:
         _, tx, step = self._make_step(params_cfg)
 
         def scan_steps(params, batch_stats, opt_state, global_params,
-                       rng0, step_ids, xs, ys):
+                       grad_offset, rng0, step_ids, xs, ys):
             # the rng rides the carry and folds with the global step index
             # INSIDE the program — same chained fold_in sequence as the
             # per-step path, but zero extra host dispatches per step
@@ -313,7 +321,8 @@ class FlaxModelOps:
                 x, y, step_id = batch
                 rng = jax.random.fold_in(rng, step_id)
                 params, batch_stats, opt_state, loss, acc = step(
-                    params, batch_stats, opt_state, global_params, x, y, rng)
+                    params, batch_stats, opt_state, global_params,
+                    grad_offset, x, y, rng)
                 return (params, batch_stats, opt_state, rng), (loss, acc)
 
             (params, batch_stats, opt_state, rng), (losses, accs) = (
@@ -326,7 +335,10 @@ class FlaxModelOps:
         return self._step_cache[key]
 
     def train(self, dataset: ArrayDataset, params_cfg: TrainParams,
-              cancel_event=None) -> TrainOutput:
+              cancel_event=None, grad_offset=None) -> TrainOutput:
+        """``grad_offset``: optional params-shaped tree ADDED to every
+        step's gradients (SCAFFOLD control-variate correction c - c_i;
+        None = uncorrected — identical compiled program)."""
         steps_per_epoch = max(1, len(dataset) // max(1, params_cfg.batch_size))
         if params_cfg.local_steps > 0:
             total_steps = params_cfg.local_steps
@@ -341,6 +353,7 @@ class FlaxModelOps:
         # without FedProx an empty tree avoids aliasing the donated params.
         global_params = (jax.tree.map(jnp.copy, params)
                          if params_cfg.proximal_mu > 0 else {})
+        grad_offset = {} if grad_offset is None else grad_offset
         opt_state = tx.init(params)
 
         losses: List[float] = []
@@ -396,7 +409,8 @@ class FlaxModelOps:
                 t0 = time.perf_counter()
                 params, batch_stats, opt_state, rng, c_losses, c_accs = (
                     scan_compiled(params, batch_stats, opt_state,
-                                  global_params, rng, step_ids, xs, ys))
+                                  global_params, grad_offset, rng, step_ids,
+                                  xs, ys))
                 c_losses = np.asarray(c_losses)
                 c_accs = np.asarray(c_accs)       # host sync, once per chunk
                 if chunk_idx > 0 and not profiling:
@@ -437,7 +451,7 @@ class FlaxModelOps:
             rng = jax.random.fold_in(rng, completed)
             t0 = time.perf_counter()
             params, batch_stats, opt_state, loss, acc = compiled(
-                params, batch_stats, opt_state, global_params,
+                params, batch_stats, opt_state, global_params, grad_offset,
                 place(x), place(y), rng)
             per_step_runs += 1
             if per_step_runs > 1 or (remaining == 1 and not step_times):

@@ -1,0 +1,151 @@
+"""SCAFFOLD control-variate federated optimization (Karimireddy et al.):
+engine gradient-offset correctness, the learner's variate update, the
+controller's server-variate fold, and the end-to-end federation."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import TrainParams, TrainTask
+from metisfl_tpu.learner.learner import Learner
+from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+from metisfl_tpu.models.zoo import MLP
+from metisfl_tpu.tensor.pytree import (
+    ModelBlob,
+    named_tensors_to_pytree,
+    pack_model,
+    pytree_to_named_tensors,
+)
+
+
+def _engine(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((32, 6)).astype(np.float32)
+    y = rng.integers(0, 3, (32,)).astype(np.int32)
+    ops = FlaxModelOps(MLP(features=(8,), num_outputs=3), x[:2])
+    return ops, ArrayDataset(x, y, seed=seed)
+
+
+def test_grad_offset_shifts_sgd_update_exactly():
+    """One SGD step with grad_offset o must land at
+    (step without offset) - lr * o."""
+    import jax
+
+    ops_a, ds = _engine()
+    ops_b, _ = _engine()
+    ops_b.set_variables(ops_a.get_variables())
+    lr = 0.1
+    cfg = TrainParams(batch_size=32, local_steps=1, optimizer="sgd",
+                      learning_rate=lr)
+    offset = jax.tree.map(
+        lambda p: np.full(np.shape(p), 0.25, np.float32),
+        ops_a.get_variables()["params"])
+    ops_a.train(ds, cfg)                          # plain step
+    ops_b.train(ds, cfg, grad_offset=offset)      # offset step
+    for a, b in zip(jax.tree.leaves(ops_a.get_variables()["params"]),
+                    jax.tree.leaves(ops_b.get_variables()["params"])):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a) - lr * 0.25,
+                                   atol=1e-5)
+
+
+class _CaptureController:
+    def __init__(self):
+        self.results = []
+
+    def join(self, request):  # pragma: no cover
+        raise AssertionError
+
+    def leave(self, learner_id, auth_token):
+        return True
+
+    def task_completed(self, result):
+        self.results.append(result)
+        return True
+
+
+def test_learner_variate_update_matches_formula():
+    """dc = -c + (x - y) / (K * lr), with x the received model and y the
+    trained one (Option II update); c_i accumulates across tasks."""
+    import jax
+
+    ops, ds = _engine(seed=1)
+    ctl = _CaptureController()
+    learner = Learner(model_ops=ops, train_dataset=ds, controller=ctl)
+    learner.learner_id, learner.auth_token = "L0", "t"
+
+    lr, K = 0.05, 3
+    incoming = ops.get_variables()
+    c_tree = jax.tree.map(
+        lambda p: np.full(np.shape(p), 0.01, np.float32),
+        incoming["params"])
+    task = TrainTask(
+        task_id="t1", learner_id="L0", round_id=0,
+        model=pack_model(incoming),
+        params=TrainParams(batch_size=16, local_steps=K, optimizer="sgd",
+                           learning_rate=lr),
+        control=ModelBlob(
+            tensors=pytree_to_named_tensors(c_tree)).to_bytes())
+    learner._train_and_report(task)
+
+    assert len(ctl.results) == 1
+    result = ctl.results[0]
+    assert result.control_delta
+    dc = named_tensors_to_pytree(
+        ModelBlob.from_bytes(result.control_delta).tensors,
+        incoming["params"])
+    trained = ops.get_variables()["params"]
+    for dc_l, x_l, y_l, c_l in zip(
+            jax.tree.leaves(dc), jax.tree.leaves(incoming["params"]),
+            jax.tree.leaves(trained), jax.tree.leaves(c_tree)):
+        want = -np.asarray(c_l) + (
+            np.asarray(x_l, np.float32) - np.asarray(y_l, np.float32)
+        ) / (K * lr)
+        np.testing.assert_allclose(np.asarray(dc_l), want, atol=1e-5)
+    # c_i advanced: a second identical task now sees a nonzero c_i
+    assert learner._scaffold_ci is not None
+    assert any(np.abs(np.asarray(l)).max() > 0
+               for l in jax.tree.leaves(learner._scaffold_ci))
+
+
+def test_scaffold_federation_learns_and_builds_server_variate():
+    from tests.test_federation_inprocess import _make_federation
+
+    fed, _ = _make_federation(rule="scaffold", local_steps=8)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=180)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        # the server variate materialized from the cohort's deltas
+        c = fed.controller._scaffold_c
+        assert c is not None
+        assert any(np.abs(a).max() > 0 for a in c.values())
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.5
+    finally:
+        fed.shutdown()
+
+
+def test_scaffold_server_variate_checkpoints(tmp_path):
+    from metisfl_tpu.config import (AggregationConfig, CheckpointConfig,
+                                    EvalConfig, FederationConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.controller.core import Controller
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="scaffold",
+                                      scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=2),
+        checkpoint=CheckpointConfig(dir=str(tmp_path)),
+    )
+    ctrl = Controller(config, lambda record: None)
+    ctrl._scaffold_c = {"params/w": np.asarray([1.5, -2.0], np.float32)}
+    ctrl.set_community_model(pack_model({"w": np.zeros((2,), np.float32)}))
+    ctrl.save_checkpoint()
+
+    fresh = Controller(config, lambda record: None)
+    assert fresh.restore_checkpoint()
+    np.testing.assert_allclose(fresh._scaffold_c["params/w"], [1.5, -2.0])
