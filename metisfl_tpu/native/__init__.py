@@ -28,9 +28,36 @@ _lock = threading.Lock()
 _libs: dict = {}
 
 
-def _src_hash(src: str) -> str:
+_BUILD_FLAGS = ["-O3", "-std=c++17", "-march=native", "-shared", "-fPIC",
+                "-fopenmp"]
+
+
+def _host_cpu_id() -> str:
+    """CPU feature identity of THIS host. With -march=native in the flags,
+    a .so built elsewhere (image build host, rsynced tree) may use
+    instructions this CPU lacks — reusing it would SIGILL in the modular
+    hot loops. The feature-flags line identifies compatible hosts."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
+def _cache_key(src: str) -> str:
+    """Source + build flags + host CPU identity: any of the three changing
+    invalidates the cached binary."""
+    h = hashlib.sha256()
     with open(src, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()
+        h.update(f.read())
+    h.update(" ".join(_BUILD_FLAGS).encode())
+    h.update(_host_cpu_id().encode())
+    return h.hexdigest()
 
 
 def _needs_build(src: str, so: str) -> bool:
@@ -39,7 +66,7 @@ def _needs_build(src: str, so: str) -> bool:
         return True
     try:
         with open(hash_path) as f:
-            return f.read().strip() != _src_hash(src)
+            return f.read().strip() != _cache_key(src)
     except OSError:
         return True
 
@@ -47,14 +74,21 @@ def _needs_build(src: str, so: str) -> bool:
 def _build(src: str, so: str) -> None:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
     os.close(fd)
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
-           "-o", tmp, src]
+    # -march=native unlocks mulx/BMI2 for the modular-arithmetic hot loops;
+    # it is safe because the cache key embeds the host CPU identity
+    # (_cache_key) so a binary never outlives the CPU family it targets.
+    # Retried without it for toolchains that reject the flag.
+    cmd = ["g++", *_BUILD_FLAGS, "-o", tmp, src]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError:
+            cmd = [arg for arg in cmd if arg != "-march=native"]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, so)
         fd, tmp_hash = tempfile.mkstemp(dir=_DIR)
         with os.fdopen(fd, "w") as f:
-            f.write(_src_hash(src))
+            f.write(_cache_key(src))
         os.replace(tmp_hash, so + ".srchash")
     except subprocess.CalledProcessError as exc:
         raise RuntimeError(

@@ -80,12 +80,15 @@ class CKKSBackend:
         if written < 0:
             raise RuntimeError(f"CKKS encrypt failed (rc={written}); values "
                                "must satisfy |v| <= 63")
-        return bytes(bytearray(out)[:written])
+        return ctypes.string_at(out, written)
 
     def decrypt(self, payload: bytes, num_values: int) -> np.ndarray:
         if self._ctx is None:
             raise RuntimeError("controller-role CKKS backend cannot decrypt")
-        buf = (ctypes.c_ubyte * len(payload)).from_buffer_copy(payload)
+        # read-only cast straight over the bytes object (the C side never
+        # writes the payload) — skips a full ciphertext copy
+        buf = ctypes.cast(ctypes.c_char_p(payload),
+                          ctypes.POINTER(ctypes.c_ubyte))
         out = np.empty(num_values, np.float64)
         rc = self._lib.ckks_decrypt(
             self._ctx, buf, len(payload),
@@ -112,4 +115,4 @@ class CKKSBackend:
         if written < 0:
             raise RuntimeError(f"CKKS weighted_sum failed (rc={written}); "
                                "payloads must be same-shape fresh ciphertexts")
-        return bytes(bytearray(out)[:written])
+        return ctypes.string_at(out, written)

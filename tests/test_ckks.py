@@ -158,3 +158,32 @@ def test_decrypt_rejects_tampered_scale(learner):
     struct.pack_into("<I", ct, 4, 8)  # scale_bits: header offset 4
     with pytest.raises(RuntimeError):
         learner.decrypt(bytes(ct), 50)
+
+
+def test_noise_budget_at_max_scalar_scale(learner, controller):
+    """docs/SECURITY.md noise-budget bound: at the maximum encryptable value
+    magnitude (|v| = 63) the decrypt error after a weighted sum stays below
+    the fixed-point quantum of the scalar scale (2^-20) in both extremes of
+    the convex-weight worst-case analysis — a single party at full weight
+    (the max-noise case) and a wide uniform cohort."""
+    rng = np.random.default_rng(7)
+    n = 3 * 8192  # a few ring blocks
+    quantum = 2.0 ** -20
+
+    # worst case: one party, full weight (noise scaled by the whole 2^20)
+    vec = rng.uniform(-63.0, 63.0, n)
+    ct = learner.encrypt(vec)
+    out = learner.decrypt(controller.weighted_sum([ct], [1.0]), n)
+    assert np.max(np.abs(out - vec)) < quantum
+
+    # wide cohort: k=128 uniform weights (exactly representable: 2^20/128)
+    k = 128
+    vecs = [rng.uniform(-63.0, 63.0, n) for _ in range(8)]
+    # 8 distinct ciphertexts cycled to k parties keeps the test fast while
+    # still summing k scaled noise terms
+    cts = [learner.encrypt(v) for v in vecs]
+    payloads = [cts[i % 8] for i in range(k)]
+    expect = sum(vecs[i % 8] for i in range(k)) / k
+    out = learner.decrypt(
+        controller.weighted_sum(payloads, [1.0 / k] * k), n)
+    assert np.max(np.abs(out - expect)) < quantum
