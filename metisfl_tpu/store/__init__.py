@@ -13,12 +13,22 @@ from metisfl_tpu.store.memory import InMemoryModelStore
 from metisfl_tpu.store.disk import DiskModelStore
 from metisfl_tpu.store.cached import CachedDiskStore
 
+
+def _remote(**kwargs):
+    from metisfl_tpu.store.remote import RemoteModelStore  # lazy: pulls grpc
+    return RemoteModelStore(**kwargs)
+
+
 STORES = {
     "in_memory": InMemoryModelStore,
     "disk": DiskModelStore,
     # disk persistence + byte-bounded LRU memory cache (the reference's
     # RedisModelStore role without an external service)
     "cached_disk": CachedDiskStore,
+    # model state outside the controller process/host: a ModelStoreServer
+    # (python -m metisfl_tpu.store.server) — the RedisModelStore posture
+    # (redis_model_store.cc:1-307) as a first-party service
+    "remote": _remote,
 }
 
 

@@ -18,7 +18,7 @@ from typing import Any, List, Tuple
 import numpy as np
 
 from metisfl_tpu.store.base import EvictionPolicy
-from metisfl_tpu.store.disk import DiskModelStore
+from metisfl_tpu.store.disk import _MISS, DiskModelStore
 
 
 def _value_nbytes(value: Any) -> int:
@@ -82,16 +82,26 @@ class CachedDiskStore(DiskModelStore):
         self._cache_put((learner_id, seq), model)
         return seq
 
+    def _cache_fetch(self, learner_id: str, seq: int) -> Any:
+        """Hook for the parallel select() in DiskModelStore."""
+        cached = self._cache.get((learner_id, seq))
+        if cached is not None:
+            self._cache.move_to_end((learner_id, seq))
+            self.cache_hits += 1
+            return cached[1]
+        self.cache_misses += 1
+        return _MISS
+
+    def _cache_store(self, learner_id: str, seq: int, value: Any) -> None:
+        self._cache_put((learner_id, seq), value)
+
     def _lineage(self, learner_id: str) -> List[Any]:
         out = []
         for seq, name in reversed(self._entries(learner_id)):
-            cached = self._cache.get((learner_id, seq))
-            if cached is not None:
-                self._cache.move_to_end((learner_id, seq))
-                self.cache_hits += 1
-                out.append(cached[1])
+            hit = self._cache_fetch(learner_id, seq)
+            if hit is not _MISS:
+                out.append(hit)
                 continue
-            self.cache_misses += 1
             value = self._read_entry(learner_id, name)
             self._cache_put((learner_id, seq), value)
             out.append(value)

@@ -17,16 +17,41 @@ from __future__ import annotations
 import os
 import re
 import shutil
-from typing import Any, List
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
 
 from metisfl_tpu.store.base import EvictionPolicy, ModelStore
 from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+
+def pack_store_value(model: Any) -> bytes:
+    """Model → blob bytes with EXACT key preservation for flat dicts.
+
+    The controller stores flat ``{wire_name: array}`` dicts whose keys
+    already contain ``/`` separators ("params/Dense_0/kernel").
+    ``pack_model`` would treat each key as one path component and escape
+    the slashes (``params%2FDense_0%2Fkernel``) — the read-back dict then
+    no longer matches the learners' tensor names and the community blob
+    ships unrecognizable keys. Flat dicts therefore serialize through
+    ``ModelBlob`` verbatim; only genuinely nested pytrees go through
+    ``pack_model``'s path flattening."""
+    if isinstance(model, dict) and model and all(
+            isinstance(k, str) and not isinstance(v, (dict, list, tuple))
+            for k, v in model.items()):
+        return ModelBlob(tensors=[(k, np.asarray(v))
+                                  for k, v in model.items()]).to_bytes()
+    return pack_model(model)
 
 # packed pytrees land as .blob; verbatim byte payloads (ciphertexts) as
 # .opaque — tagged at WRITE time so a corrupt .blob stays a loud parse
 # error instead of being silently misread as an opaque payload
 _BLOB_RE = re.compile(r"^(\d+)\.(blob|opaque)$")
 _SAFE_ID = re.compile(r"[^A-Za-z0-9_.-]")
+
+# cache-miss sentinel for the _cache_fetch hook (None is a valid value)
+_MISS = object()
 
 
 class DiskModelStore(ModelStore):
@@ -35,6 +60,23 @@ class DiskModelStore(ModelStore):
         super().__init__(policy, lineage_length)
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # cold-read pool: select() fans file reads out across learners (the
+        # reference's Redis store got the same effect from MULTI-pipelined
+        # selects, redis_model_store.cc:180-260); lazily built so stores in
+        # fork-spawned processes don't inherit dead threads
+        self._read_pool: Optional[ThreadPoolExecutor] = None
+
+    def _pool(self) -> ThreadPoolExecutor:
+        if self._read_pool is None:
+            self._read_pool = ThreadPoolExecutor(
+                max_workers=min(32, 4 * (os.cpu_count() or 4)),
+                thread_name_prefix="store-read")
+        return self._read_pool
+
+    def shutdown(self) -> None:
+        if self._read_pool is not None:
+            self._read_pool.shutdown(wait=False)
+            self._read_pool = None
 
     def _dir(self, learner_id: str) -> str:
         return os.path.join(self.root, _SAFE_ID.sub("_", learner_id))
@@ -61,7 +103,7 @@ class DiskModelStore(ModelStore):
         if isinstance(model, (bytes, bytearray)):
             data, ext = bytes(model), "opaque"
         else:
-            data, ext = pack_model(model), "blob"
+            data, ext = pack_store_value(model), "blob"
         tmp = os.path.join(path, f".{seq}.tmp")
         with open(tmp, "wb") as f:
             f.write(data)
@@ -69,12 +111,18 @@ class DiskModelStore(ModelStore):
         return seq
 
     def _read_entry(self, learner_id: str, filename: str) -> Any:
-        """Read + decode one stored model file."""
+        """Read + decode one stored model file.
+
+        Decodes zero-copy (``copy=False``): tensors are read-only views over
+        the single read buffer — aggregation only ever reads selected models,
+        and skipping the per-tensor copy halves cold-read cost at the
+        64-learner × MB-model scale."""
         with open(os.path.join(self._dir(learner_id), filename), "rb") as f:
             data = f.read()
         if filename.endswith(".opaque"):
             return data  # verbatim payload, by write-time contract
-        blob = ModelBlob.from_bytes(data)  # corruption raises loudly here
+        # corruption raises loudly here
+        blob = ModelBlob.from_bytes(data, copy=False)
         if blob.opaque and not blob.tensors:
             return data  # encrypted ModelBlob: hand back raw bytes
         return {name: arr for name, arr in blob.tensors}
@@ -82,6 +130,50 @@ class DiskModelStore(ModelStore):
     def _lineage(self, learner_id: str) -> List[Any]:
         return [self._read_entry(learner_id, name)
                 for _, name in reversed(self._entries(learner_id))]
+
+    # -- in-memory cache hooks (no-ops here; CachedDiskStore overrides) ----
+    def _cache_fetch(self, learner_id: str, seq: int) -> Any:
+        return _MISS
+
+    def _cache_store(self, learner_id: str, seq: int, value: Any) -> None:
+        pass
+
+    def select(self, learner_ids: Sequence[str], k: int = 1) -> Dict[str, List[Any]]:
+        """Latest ≤k models per learner, cache-first, cold files read in
+        parallel across learners (cold select_all @64 learners is otherwise
+        ~the whole 2 s round budget — BASELINE.md)."""
+        out: Dict[str, List[Any]] = {}
+        with self._lock:
+            pending = []  # (learner_id, seq, filename, slot_list, slot_idx)
+            for lid in learner_ids:
+                ents = list(reversed(self._entries(lid)))[:k]
+                if not ents:
+                    continue
+                vals: List[Any] = [None] * len(ents)
+                out[lid] = vals
+                for i, (seq, name) in enumerate(ents):
+                    hit = self._cache_fetch(lid, seq)
+                    if hit is not _MISS:
+                        vals[i] = hit
+                    else:
+                        pending.append((lid, seq, name, vals, i))
+            if len(pending) == 1:  # no pool round-trip for a single read
+                lid, seq, name, vals, i = pending[0]
+                vals[i] = self._read_entry(lid, name)
+                self._cache_store(lid, seq, vals[i])
+            elif pending:
+                futures = [(job, self._pool().submit(
+                    self._read_entry, job[0], job[2])) for job in pending]
+                for (lid, seq, name, vals, i), fut in futures:
+                    vals[i] = fut.result()
+                    self._cache_store(lid, seq, vals[i])
+        return out
+
+    def size(self, learner_id: str) -> int:
+        """Entry count without decoding any blob (the base implementation
+        decodes the full lineage just to len() it)."""
+        with self._lock:
+            return len(self._entries(learner_id))
 
     def _erase(self, learner_id: str) -> None:
         shutil.rmtree(self._dir(learner_id), ignore_errors=True)

@@ -1,0 +1,60 @@
+"""Standalone model-store service: ``python -m metisfl_tpu.store.server``.
+
+The process role of the reference's Redis server in its model-store
+deployment (reference redis_model_store.cc:1-307 + ModelStoreConfig in
+fedenv_parser.py:88-100), first-party: hosts a disk-persistent,
+memory-cached store over gRPC for one or many controllers.
+
+    python -m metisfl_tpu.store.server --port 50099 --root /data/models
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from metisfl_tpu.store import make_store
+from metisfl_tpu.store.remote import ModelStoreServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("metisfl_tpu model-store service")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 picks an ephemeral port (printed on start)")
+    parser.add_argument("--store", default="cached_disk",
+                        choices=["in_memory", "disk", "cached_disk"])
+    parser.add_argument("--root", default="/tmp/metisfl_tpu_store",
+                        help="blob directory (disk-backed stores)")
+    parser.add_argument("--lineage-length", type=int, default=2,
+                        help="models retained per learner (2 serves every "
+                             "aggregation rule incl. FedRec)")
+    parser.add_argument("--cache-mb", type=int, default=256)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    kwargs = {"lineage_length": args.lineage_length}
+    if args.store in ("disk", "cached_disk"):
+        kwargs["root"] = args.root
+    if args.store == "cached_disk":
+        kwargs["cache_bytes"] = args.cache_mb << 20
+    server = ModelStoreServer(make_store(args.store, **kwargs),
+                              host=args.host, port=args.port)
+    port = server.start()
+    print(f"METISFL_TPU_STORE_READY port={port}", flush=True)
+
+    def _stop(signum, frame):
+        server.stop()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    server.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -219,6 +219,38 @@ class TestDiskStore:
         assert isinstance(out, bytes)
         assert ModelBlob.from_bytes(out).opaque["w"][0] == b"cipher"
 
+    def test_wire_names_with_slashes_roundtrip_verbatim(self, tmp_path):
+        """Real federation models are flat dicts keyed by wire names with
+        '/' separators (params/Dense_0/kernel). The store must hand back
+        the EXACT keys — escaping them (the old pack_model path) made the
+        community blob unrecognizable to learners."""
+        store = DiskModelStore(str(tmp_path / "store"))
+        model = {"params/Dense_0/kernel": np.ones((2, 3), np.float32),
+                 "params/Dense_0/bias": np.zeros((3,), np.float32),
+                 "batch_stats/BatchNorm_0/mean": np.full((3,), 2.0,
+                                                         np.float32)}
+        store.insert("L0", model)
+        out = store.select(["L0"])["L0"][0]
+        assert set(out) == set(model)
+        np.testing.assert_allclose(out["params/Dense_0/kernel"], 1.0)
+
+    def test_parallel_select_matches_serial_lineage(self, tmp_path):
+        """select() fans reads across a thread pool; values and most-recent-
+        first ordering must match the serial _lineage path exactly."""
+        store = DiskModelStore(str(tmp_path / "store"), lineage_length=3)
+        for i in range(16):
+            for v in (1, 2, 3):
+                store.insert(f"L{i}", _m(v * (i + 1)))
+        ids = [f"L{i}" for i in range(16)] + ["ghost"]
+        out = store.select(ids, k=2)
+        assert "ghost" not in out and len(out) == 16
+        for i in range(16):
+            vals = [float(m["w"][0]) for m in out[f"L{i}"]]
+            assert vals == [3.0 * (i + 1), 2.0 * (i + 1)]
+        # size() counts entries without decoding
+        assert store.size("L0") == 3 and store.size("ghost") == 0
+        store.shutdown()
+
 
 class TestCachedDiskStore:
     """Byte-bounded LRU cache over the disk store (the reference's
