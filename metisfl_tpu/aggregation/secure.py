@@ -61,7 +61,12 @@ class SecureAgg:
         self,
         models: Sequence[Tuple[Sequence[OpaqueModel], float]],
         state=None,
+        correction: Optional[Dict[str, bytes]] = None,
     ) -> OpaqueModel:
+        """``correction`` (masking dropout recovery, secure/masking.py):
+        per-tensor residual-mask bytes a surviving learner computed for the
+        round's dropped parties — forwarded to the backend so a partial
+        cohort still unmasks to the surviving sum."""
         if not models:
             raise ValueError("SecureAgg.aggregate called with no models")
         total = sum(float(scale) for _, scale in models)
@@ -77,7 +82,11 @@ class SecureAgg:
                 if name not in model:
                     raise KeyError(f"encrypted model missing tensor {name!r}")
                 payloads.append(model[name][0])
-            combined = self.backend.weighted_sum(payloads, scales)
+            if correction is not None:
+                combined = self.backend.weighted_sum(
+                    payloads, scales, correction=correction[name])
+            else:
+                combined = self.backend.weighted_sum(payloads, scales)
             out[name] = (combined, TensorSpec(spec.shape, spec.dtype, TensorKind.CIPHERTEXT))
         return out
 

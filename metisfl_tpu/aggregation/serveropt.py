@@ -67,6 +67,11 @@ class ServerOpt:
         self._m: Optional[Pytree] = None
         self._v: Optional[Pytree] = None
         self._step = 0
+        # optimizer state computed by result() but not yet committed; the
+        # controller commits only after the community model is installed, so
+        # an aggregation-failure retry re-runs the round without applying a
+        # second server-optimizer step for one logical round
+        self._staged: Optional[Tuple[Pytree, Pytree, Pytree, int]] = None
         # packed state deferred from restore_state until a tree template
         # exists (wire blobs are name-keyed, structure comes from the model)
         self._pending: Optional[Dict[str, Any]] = None
@@ -91,8 +96,21 @@ class ServerOpt:
         self.reset()
         self.accumulate(models)
         out = self.result()
+        self.commit()
         self.reset()
         return out
+
+    def commit(self) -> None:
+        """Install the state staged by the last :meth:`result` call.
+
+        Called by the controller once the community model is durably
+        installed; until then a retried round recomputes from the same
+        pre-step state (no double-stepped moments).
+        """
+        with self._state_lock:
+            if self._staged is not None:
+                self._prev, self._m, self._v, self._step = self._staged
+                self._staged = None
 
     # -- server step -------------------------------------------------------
     def seed_community(self, community: Pytree) -> None:
@@ -109,16 +127,28 @@ class ServerOpt:
     def _apply_server_step(self, avg: Pytree) -> Pytree:
         self._resolve_pending(avg)
         if self._prev is None:
-            self._prev = jax.tree.map(self._to_f32, avg)
+            self._staged = (jax.tree.map(self._to_f32, avg),
+                            self._m, self._v, self._step)
             return avg
-        if self._m is None:
-            self._m = jax.tree.map(np.zeros_like,
-                                   jax.tree.map(self._to_f32, avg))
-            self._v = jax.tree.map(np.zeros_like, self._m)
-        self._step += 1
+        prev_leaves, treedef = jax.tree.flatten(self._prev)
+        avg_leaves, avg_treedef = jax.tree.flatten(avg)
+        if treedef != avg_treedef:
+            # a restored checkpoint / replacement community model with a
+            # different key set must fail loudly, not silently misalign the
+            # positional leaf zip below
+            raise ValueError(
+                "server-optimizer state tree does not match the aggregated "
+                f"model tree: state {treedef} vs round {avg_treedef}")
+        cur_m = self._m
+        cur_v = self._v
+        if cur_m is None:
+            cur_m = jax.tree.map(np.zeros_like,
+                                 jax.tree.map(self._to_f32, avg))
+            cur_v = jax.tree.map(np.zeros_like, cur_m)
+        step = self._step + 1
         lr, b1, b2, tau = (self.learning_rate, self.beta1, self.beta2,
                            self.tau)
-        opt, step = self.opt, self._step
+        opt = self.opt
 
         def leaf(prev, a, m, v):
             a = np.asarray(a)
@@ -140,22 +170,22 @@ class ServerOpt:
                 new = prev - lr * m_hat / (np.sqrt(v_hat) + tau)
             return new.astype(np.float32), m, v
 
-        prev_leaves, treedef = jax.tree.flatten(self._prev)
-        avg_leaves = jax.tree.leaves(avg)
-        m_leaves = jax.tree.leaves(self._m)
-        v_leaves = jax.tree.leaves(self._v)
+        m_leaves = jax.tree.leaves(cur_m)
+        v_leaves = jax.tree.leaves(cur_v)
         new_leaves, new_m, new_v = [], [], []
         for p, a, m, v in zip(prev_leaves, avg_leaves, m_leaves, v_leaves):
             n, m2, v2 = leaf(p, a, m, v)
             new_leaves.append(n)
             new_m.append(m2)
             new_v.append(v2)
-        self._prev = jax.tree.unflatten(treedef, new_leaves)
-        self._m = jax.tree.unflatten(treedef, new_m)
-        self._v = jax.tree.unflatten(treedef, new_v)
+        new_prev = jax.tree.unflatten(treedef, new_leaves)
+        self._staged = (new_prev,
+                        jax.tree.unflatten(treedef, new_m),
+                        jax.tree.unflatten(treedef, new_v),
+                        step)
         # community keeps each tensor's storage dtype (wire contract)
         return jax.tree.map(
-            lambda n, a: n.astype(np.asarray(a).dtype), self._prev, avg)
+            lambda n, a: n.astype(np.asarray(a).dtype), new_prev, avg)
 
     # -- persistence (controller checkpoint) --------------------------------
     def export_state(self) -> Dict[str, Any]:
@@ -207,3 +237,4 @@ class ServerOpt:
             self._prev = self._m = self._v = None
             self._step = 0
             self._pending = None
+            self._staged = None

@@ -54,9 +54,14 @@ class AggregationConfig:
 @dataclass
 class ModelStoreConfig:
     store: str = "in_memory"                 # in_memory | disk | cached_disk
+                                             # | remote
     lineage_length: int = 0                  # 0 → derive from aggregation rule
     root: str = ""                           # disk store directory
     cache_mb: int = 256                      # cached_disk memory budget
+    # store="remote": endpoint of a python -m metisfl_tpu.store.server
+    # process (the reference's external-Redis posture, SURVEY.md §2.1 C12)
+    host: str = "localhost"
+    port: int = 0
 
 
 @dataclass
@@ -72,6 +77,10 @@ class SecureAggConfig:
     # masks cancel; the driver fills this in (secrets never enter this
     # config — they travel in per-learner secure files only)
     num_parties: int = 0
+    # masking dropout recovery (the Bonawitz threshold t): never unmask a
+    # partial sum of fewer surviving parties than this — at 1 the "sum"
+    # would be a single learner's plaintext update
+    min_recovery_parties: int = 2
 
 
 @dataclass
@@ -189,6 +198,14 @@ class FederationConfig:
                 raise ValueError(
                     "scaffold is not supported for multi-host learner "
                     "worlds (world_size > 1)")
+            if self.train.optimizer.lower() != "sgd":
+                # the Option-II variate update divides by K*lr, which is the
+                # inverse of a plain-SGD step; with an adaptive local
+                # optimizer the variate would be silently mis-scaled
+                raise ValueError(
+                    "scaffold requires optimizer='sgd' (the control-variate "
+                    "update c_i+ = c_i - c + (x - y)/(K*lr) assumes plain "
+                    "SGD local steps)")
         if (self.secure.enabled and self.secure.scheme == "masking"
                 and self.aggregation.staleness_decay > 0.0):
             # damping re-introduces non-uniform scales AFTER the scaler, and

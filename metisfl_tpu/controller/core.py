@@ -90,6 +90,9 @@ class LearnerRecord:
     # round the latest accepted contribution was DISPATCHED from (async
     # staleness: a result computed against an old community model)
     last_result_round: int = -1
+    # masking secure-agg party index (-1: not a masking party) — maps this
+    # learner to its pairwise-mask identity for dropout recovery
+    party_index: int = -1
     # per-learner train overrides (semi-sync step budgets)
     local_steps_override: int = 0
     proxy: Optional[LearnerProxy] = None
@@ -174,6 +177,9 @@ class Controller:
             store_kwargs["root"] = store_cfg.root or "/tmp/metisfl_tpu_store"
         if store_cfg.store == "cached_disk":
             store_kwargs["cache_bytes"] = store_cfg.cache_mb << 20
+        if store_cfg.store == "remote":
+            store_kwargs["host"] = store_cfg.host
+            store_kwargs["port"] = store_cfg.port
         self._store = make_store(store_cfg.store, **store_kwargs)
 
         # community model state
@@ -253,6 +259,7 @@ class Controller:
                 num_train_examples=request.num_train_examples,
                 num_val_examples=request.num_val_examples,
                 num_test_examples=request.num_test_examples,
+                party_index=int(request.capabilities.get("party_index", -1)),
             )
             record.proxy = self._proxy_factory(record)
             self._learners[learner_id] = record
@@ -475,9 +482,10 @@ class Controller:
                 "round deadline (%.1fs) expired; aggregating %d reporter(s), "
                 "dropping stragglers %s", self.config.round_deadline_secs,
                 len(cohort), dropped)
-            # partial-cohort aggregation can legitimately fail (masking
-            # secure-agg needs every party); _complete_round records the
-            # error and re-dispatches a fresh full cohort itself
+            # masking secure-agg recovers partial cohorts via the dropout
+            # correction (_masking_dropout_correction); when recovery is
+            # impossible (< min_recovery_parties survivors) aggregation
+            # fails and _complete_round re-dispatches a fresh full cohort
             self._complete_round(cohort)
         else:
             logger.warning(
@@ -623,6 +631,7 @@ class Controller:
             # (masking sums must cancel across ALL parties), so blocks only
             # bound store-select batching here.
             pairs = []
+            present_ids = []
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 tb = time.time()
@@ -630,12 +639,19 @@ class Controller:
                 for lid in block:
                     if lid in picked:
                         pairs.append((picked[lid], scales[lid]))
+                        present_ids.append(lid)
                 meta_blocks.append(len(block))
                 meta_durations.append((time.time() - tb) * 1e3)
             if not pairs:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
-            community = self._aggregator.aggregate(self._parse_secure(pairs))
+            parsed = self._parse_secure(pairs)
+            correction = None
+            if self.config.secure.scheme == "masking":
+                correction = self._masking_dropout_correction(
+                    present_ids, parsed)
+            community = self._aggregator.aggregate(parsed,
+                                                   correction=correction)
         elif hasattr(self._aggregator, "accumulate"):
             # Fold rules (FedAvg and the ServerOpt family wrapping it):
             # accumulate block-by-block so only one stride block of models is
@@ -659,6 +675,9 @@ class Controller:
                 return
             community = self._aggregator.result()
             self._aggregator.reset()
+            # ServerOpt stages its optimizer step inside result(); it is
+            # committed below only after the community model is installed,
+            # so an aggregation-failure retry does not double-step moments.
         else:
             # rolling rules (fedstride / fedrec): incremental block updates
             for i in range(0, len(ids), stride):
@@ -686,6 +705,8 @@ class Controller:
             else:
                 self._community_flat = community
             self._community_blob = blob
+            if hasattr(self._aggregator, "commit"):
+                self._aggregator.commit()
             meta = self._current_meta
             meta.selected_learners = list(selected)
             meta.scales = {lid: round(float(w), 6)
@@ -700,6 +721,60 @@ class Controller:
                     for key in sizes:
                         sizes[key] += q[key]
                 meta.model_size = sizes
+
+    def _masking_dropout_correction(self, present_ids, parsed):
+        """Masking dropout recovery: when the aggregating cohort is missing
+        registered mask parties (deadline stragglers, crashes), ask ONE
+        surviving learner for the dropped parties' residual-mask correction
+        (secure/masking.py recovery_correction — the Bonawitz unmasking
+        round in this trust model). Returns ``{tensor_name: bytes}`` or
+        None when the full cohort is present (masks cancel on their own).
+        Raises when recovery is impossible so the aggregation-failure
+        full-cohort retry takes over."""
+        cfg = self.config.secure
+        with self._lock:
+            idx_of = {lid: self._learners[lid].party_index
+                      for lid in present_ids if lid in self._learners}
+            registered = {r.party_index for r in self._learners.values()
+                          if r.party_index >= 0}
+        surviving = sorted(idx_of.values())
+        # party count: driver-filled config, else derived from the joined
+        # parties' indices (in-process federations skip the driver)
+        n = cfg.num_parties or (max(registered) + 1 if registered else 0)
+        if n <= 0 or not surviving or -1 in surviving:
+            return None  # party indices unknown: full-cohort semantics
+        if len(surviving) == n:
+            return None  # nobody dropped
+        min_parties = max(2, cfg.min_recovery_parties)
+        if len(surviving) < min_parties:
+            raise RuntimeError(
+                f"masking dropout recovery needs >= {min_parties} surviving "
+                f"parties, have {len(surviving)}")
+        dropped = sorted(set(range(n)) - set(surviving))
+        first_model = parsed[0][0][0]
+        names = list(first_model)
+        lengths = [int(first_model[name][1].size) for name in names]
+        round_id = self.global_iteration
+        last_error = None
+        for lid in present_ids:
+            record = self._learners.get(lid)
+            if record is None or record.proxy is None:
+                continue
+            if not hasattr(record.proxy, "recover_masks"):
+                return None  # transport cannot recover: full-cohort semantics
+            try:
+                corrections = record.proxy.recover_masks(
+                    round_id, surviving, dropped, lengths)
+                logger.warning(
+                    "masking dropout recovery: %s computed residuals for "
+                    "dropped parties %s (surviving %d/%d)", lid, dropped,
+                    len(surviving), n)
+                return dict(zip(names, corrections))
+            except Exception as exc:  # noqa: BLE001 - try the next survivor
+                last_error = exc
+        raise RuntimeError(
+            f"masking dropout recovery failed on every survivor: "
+            f"{last_error!r}")
 
     def _parse_secure(self, pairs):
         parsed = []

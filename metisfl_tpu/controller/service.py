@@ -57,6 +57,18 @@ class RpcLearnerProxy:
             "EvaluateModel", task.to_wire(),
             callback=lambda raw: callback(EvalResult.from_wire(raw)))
 
+    def recover_masks(self, round_id: int, surviving, dropped,
+                      lengths) -> list:
+        """Blocking masking-dropout-recovery request (secure/masking.py):
+        one survivor computes the dropped parties' residual masks."""
+        from metisfl_tpu.comm.codec import dumps, loads
+
+        raw = self._client.call("RecoverMasks", dumps(
+            {"round_id": int(round_id), "surviving": list(surviving),
+             "dropped": list(dropped), "lengths": list(lengths)}),
+            timeout=60.0, wait_ready=False)
+        return loads(raw)["corrections"]
+
     def shutdown(self) -> None:
         try:
             self._client.call_async("ShutDown", b"")

@@ -36,6 +36,11 @@ class _DirectLearnerProxy:
     def run_task(self, task: TrainTask) -> None:
         self._get_learner().run_task(task)
 
+    def recover_masks(self, round_id: int, surviving, dropped,
+                      lengths) -> list:
+        return self._get_learner().recover_masks(round_id, surviving,
+                                                 dropped, lengths)
+
     def evaluate(self, task: EvalTask, callback) -> None:
         learner = self._get_learner()
 

@@ -101,6 +101,13 @@ class Learner:
     # ------------------------------------------------------------------ #
 
     def join_federation(self, previous_id: str = "", auth_token: str = "") -> JoinReply:
+        capabilities = {}
+        party_index = getattr(self.secure_backend, "party_index", None)
+        if party_index is not None and hasattr(self.secure_backend,
+                                               "recovery_correction"):
+            # masking dropout recovery: the controller needs to map learner
+            # ids to mask party indices to request residual corrections
+            capabilities["party_index"] = int(party_index)
         reply = self.controller.join(JoinRequest(
             hostname=self.hostname,
             port=self.port,
@@ -109,6 +116,7 @@ class Learner:
             num_test_examples=len(self.datasets["test"] or []),
             previous_id=previous_id,
             auth_token=auth_token,
+            capabilities=capabilities,
         ))
         self.learner_id = reply.learner_id
         self.auth_token = reply.auth_token
@@ -322,6 +330,18 @@ class Learner:
             evaluations=evaluations,
             duration_ms=(time.time() - t0) * 1e3,
         )
+
+    def recover_masks(self, round_id: int, surviving, dropped,
+                      lengths) -> list:
+        """Masking dropout recovery (secure/masking.py): the residual mask
+        of the round's dropped parties, computable by any survivor because
+        the federation secret is shared. The controller subtracts it from
+        the partial sum — the Bonawitz unmasking round as one RPC."""
+        backend = self.secure_backend
+        if backend is None or not hasattr(backend, "recovery_correction"):
+            raise RuntimeError("this learner has no masking backend")
+        return backend.recovery_correction(round_id, list(surviving),
+                                           list(dropped), list(lengths))
 
     def infer(self, task: InferTask) -> InferResult:
         """Blocking inference on a shipped model (the reference learner's

@@ -11,7 +11,7 @@ import logging
 import threading
 from typing import Optional
 
-from metisfl_tpu.comm.codec import dumps
+from metisfl_tpu.comm.codec import dumps, loads
 from metisfl_tpu.comm.messages import EvalTask, InferTask, TrainTask
 from metisfl_tpu.comm.rpc import BytesService, RpcServer
 from metisfl_tpu.controller.service import LEARNER_SERVICE, ControllerClient
@@ -34,6 +34,7 @@ class LearnerServer:
             "RunTask": self._run_task,
             "EvaluateModel": self._evaluate,
             "RunInference": self._infer,
+            "RecoverMasks": self._recover_masks,
             "GetHealthStatus": self._health,
             "ShutDown": self._shutdown_rpc,
         }))
@@ -51,6 +52,13 @@ class LearnerServer:
 
     def _infer(self, raw: bytes) -> bytes:
         return self.learner.infer(InferTask.from_wire(raw)).to_wire()
+
+    def _recover_masks(self, raw: bytes) -> bytes:
+        req = loads(raw)
+        corrections = self.learner.recover_masks(
+            req["round_id"], req["surviving"], req["dropped"],
+            req["lengths"])
+        return dumps({"corrections": corrections})
 
     def _health(self, raw: bytes) -> bytes:
         return dumps({"status": "SERVING", "tasks_received": self._tasks_received})

@@ -16,9 +16,18 @@ uniform to anyone without the federation secret.
 
 Constraints (enforced):
 - scales must be uniform (1/N) — weighted masking requires learner-side
-  pre-scaling; use the ``participants`` scaler;
-- all registered parties must contribute to every aggregation, else masks
-  don't cancel (classic secure-agg dropout handling is future work).
+  pre-scaling; use the ``participants`` scaler.
+
+**Dropout robustness** (the Bonawitz unmasking round, specialized to this
+trust model): when parties drop mid-round, the partial sum carries the
+un-cancelled residual Σᵢ∈S ±stream(i, d) for each dropped d. Because every
+learner holds the federation secret, ONE surviving learner can recompute
+exactly that residual (:meth:`recovery_correction` — the protocol's "share
+recovery" collapses to a single RPC); the controller subtracts it and
+recovers Σᵢ∈S xᵢ, precisely what full Bonawitz reveals after recovery.
+Individual payloads stay uniformly masked throughout; a minimum-survivor
+threshold (``weighted_sum(..., min_parties=…)``, the Bonawitz ``t``)
+refuses recoveries that would reduce the sum to fewer than 2 parties.
 
 Pair streams derive from a driver-distributed federation secret that the
 controller never receives (the reference likewise withholds the CKKS private
@@ -40,21 +49,30 @@ class MaskingBackend:
     name = "masking"
 
     def __init__(self, federation_secret: str = "", party_index: int = 0,
-                 num_parties: int = 1):
+                 num_parties: int = 1, min_parties: int = 2):
         self.secret = federation_secret
         self.party_index = int(party_index)
         self.num_parties = int(num_parties)
+        # the Bonawitz threshold t, enforced LEARNER-side: this party
+        # refuses to help unmask a sum of fewer than min_parties payloads
+        self.min_parties = max(2, int(min_parties))
         self._round_id = 0
         self._tensor_counter = 0
+        # round_id -> (surviving, dropped) already served: a correction for
+        # a DIFFERENT split of the same round would let a curious controller
+        # intersect partial sums down to individual payloads
+        self._recovery_served: dict = {}
 
     # -- round context (learner calls this per task) ----------------------
     def begin_round(self, round_id: int) -> None:
         self._round_id = int(round_id)
         self._tensor_counter = 0
 
-    def _pair_stream(self, i: int, j: int, tensor_idx: int, n: int) -> np.ndarray:
+    def _pair_stream(self, i: int, j: int, tensor_idx: int, n: int,
+                     round_id: int = None) -> np.ndarray:
+        rid = self._round_id if round_id is None else int(round_id)
         material = (f"metisfl-mask|{self.secret}|{min(i, j)}|{max(i, j)}|"
-                    f"{self._round_id}|{tensor_idx}").encode()
+                    f"{rid}|{tensor_idx}").encode()
         # SHAKE-256 as XOF: one call yields the whole uniform uint64 stream
         stream = hashlib.shake_256(material).digest(8 * n)
         return np.frombuffer(stream, "<u8")
@@ -95,12 +113,67 @@ class MaskingBackend:
             raise ValueError(f"payload has {len(out)} values, need {num_values}")
         return out[:num_values].copy()
 
+    def recovery_correction(self, round_id: int, surviving: Sequence[int],
+                            dropped: Sequence[int],
+                            lengths: Sequence[int]) -> list:
+        """The dropped parties' un-cancelled mask residual, per tensor.
+
+        For the partial sum over surviving set S with dropped set D, the
+        residual is Σ_{d∈D} Σ_{i∈S} sign(i,d)·stream(i,d) with
+        sign(i,d) = +1 iff d > i (the sign party i used when masking).
+        Any learner can compute it (the secret is federation-wide); the
+        controller cannot. Returns one uint64-array ``bytes`` per tensor,
+        to be SUBTRACTED from the masked partial sum."""
+        if not self.secret:
+            raise RuntimeError("recovery requires the federation secret "
+                               "(learner role)")
+        if set(surviving) & set(dropped):
+            raise ValueError("surviving and dropped sets overlap")
+        # Learner-side privacy enforcement (the controller-side checks
+        # constrain the party they are meant to protect against):
+        # (a) never help unmask a sum of < min_parties payloads;
+        if len(set(surviving)) < self.min_parties:
+            raise ValueError(
+                f"refusing recovery for {len(set(surviving))} survivors "
+                f"(< threshold {self.min_parties}: the unmasked sum would "
+                "approach a single party's plaintext)")
+        # (b) one split per round: corrections for two different survivor
+        # sets of the same round intersect to individual payloads.
+        key = (frozenset(surviving), frozenset(dropped))
+        prev = self._recovery_served.get(int(round_id))
+        if prev is not None and prev != key:
+            raise ValueError(
+                f"already served a different recovery split for round "
+                f"{round_id}; refusing (partial-sum intersection attack)")
+        self._recovery_served[int(round_id)] = key
+        while len(self._recovery_served) > 64:
+            self._recovery_served.pop(next(iter(self._recovery_served)))
+        corrections = []
+        for tensor_idx, n in enumerate(lengths):
+            acc = np.zeros(int(n), np.uint64)
+            for d in dropped:
+                for i in surviving:
+                    stream = self._pair_stream(i, d, tensor_idx, int(n),
+                                               round_id=round_id)
+                    acc = acc + stream if d > i else acc - stream
+            corrections.append(acc.tobytes())
+        return corrections
+
     def weighted_sum(self, payloads: Sequence[bytes],
-                     scales: Sequence[float]) -> bytes:
-        if len(payloads) != self.num_parties:
+                     scales: Sequence[float],
+                     correction: bytes = None,
+                     min_parties: int = 2) -> bytes:
+        if correction is None and len(payloads) != self.num_parties:
             raise ValueError(
                 f"masking secure-agg needs all {self.num_parties} parties; "
-                f"got {len(payloads)} (dropout handling not supported)")
+                f"got {len(payloads)} (partial cohorts need a dropout "
+                "recovery correction)")
+        if correction is not None and len(payloads) < max(2, min_parties):
+            # the Bonawitz threshold: never unmask a sum of < min_parties
+            # payloads (at 1 it would be a single learner's plaintext)
+            raise ValueError(
+                f"dropout recovery needs >= {max(2, min_parties)} surviving "
+                f"parties; got {len(payloads)}")
         if len(set(np.round(scales, 9))) != 1:
             raise ValueError(
                 "masking secure-agg requires uniform scales — configure the "
@@ -108,5 +181,7 @@ class MaskingBackend:
         acc = np.zeros(len(payloads[0]) // 8, np.uint64)
         for payload in payloads:
             acc = acc + np.frombuffer(payload, np.uint64)  # wraps mod 2^64
+        if correction is not None:
+            acc = acc - np.frombuffer(correction, np.uint64)
         signed = acc.view(np.int64).astype(np.float64) / _FP_SCALE
         return (signed * float(scales[0])).tobytes()

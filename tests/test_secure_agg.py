@@ -66,6 +66,63 @@ class TestMaskingBackend:
         p1 = backend.encrypt(np.zeros(10))
         assert p0 != p1
 
+    def test_dropout_recovery_unmasks_partial_sum(self):
+        """Parties drop; a survivor's recovery_correction lets the partial
+        sum unmask to EXACTLY the surviving mean (multi-tensor, both drop
+        positions relative to survivors)."""
+        n, length = 5, 40
+        backends = self._backends(n)
+        rng = np.random.default_rng(1)
+        vectors = [rng.standard_normal(2 * length) for _ in range(n)]
+        payloads = {}
+        for backend, vec in zip(backends, vectors):
+            backend.begin_round(7)
+            # two tensors per model (tensor_counter advances)
+            payloads[backend.party_index] = (
+                backend.encrypt(vec[:length]), backend.encrypt(vec[length:]))
+        surviving, dropped = [1, 2, 4], [0, 3]
+        corrections = backends[2].recovery_correction(
+            7, surviving, dropped, [length, length])
+        scales = [1.0 / len(surviving)] * len(surviving)
+        for t in range(2):
+            combined = backends[0].weighted_sum(
+                [payloads[i][t] for i in surviving], scales,
+                correction=corrections[t])
+            out = backends[0].decrypt(combined, length)
+            want = np.mean([vectors[i][t * length:(t + 1) * length]
+                            for i in surviving], axis=0)
+            np.testing.assert_allclose(out, want, atol=1e-9)
+
+    def test_recovery_refuses_below_threshold(self):
+        """LEARNER-side enforcement: a single-survivor recovery request is
+        refused outright (the controller-side check constrains the party it
+        is meant to protect against); the controller-side weighted_sum also
+        refuses partial sums below threshold."""
+        backends = self._backends(3)
+        with pytest.raises(ValueError, match="threshold"):
+            backends[1].recovery_correction(0, [0], [1, 2], [4])
+        backends[0].begin_round(0)
+        payload = backends[0].encrypt(np.ones(4))
+        with pytest.raises(ValueError, match="surviving"):
+            backends[0].weighted_sum([payload], [1.0], correction=b"\0" * 32)
+
+    def test_recovery_refuses_second_split_same_round(self):
+        """One split per round: corrections for two different survivor sets
+        of the same round would intersect to individual payloads."""
+        backends = self._backends(4)
+        backends[1].recovery_correction(5, [0, 1], [2, 3], [4])
+        # identical request (controller retry) is idempotent
+        backends[1].recovery_correction(5, [0, 1], [2, 3], [4])
+        with pytest.raises(ValueError, match="different recovery split"):
+            backends[1].recovery_correction(5, [0, 2], [1, 3], [4])
+        # a NEW round gets a fresh split
+        backends[1].recovery_correction(6, [0, 2], [1, 3], [4])
+
+    def test_recovery_requires_secret(self):
+        keyless = MaskingBackend(num_parties=3)  # controller role
+        with pytest.raises(RuntimeError, match="secret"):
+            keyless.recovery_correction(0, [0, 1], [2], [4])
+
 
 def test_identity_backend_weighted_sum():
     backend = IdentityBackend()
@@ -125,11 +182,10 @@ def test_masked_federation_end_to_end():
 
 
 def test_masking_straggler_deadline_recovers():
-    """Masking + round deadline must not stall the federation: the deadline
-    drops the straggler, partial-cohort aggregation fails (masks only cancel
-    across ALL parties), and the controller abandons the round and
-    re-dispatches the full cohort — which succeeds because the round counter
-    (and hence the mask streams) never advanced."""
+    """Masking + round deadline + dropout RECOVERY: the deadline drops the
+    straggler and the partial cohort aggregates directly — a surviving
+    learner supplies the dropped party's residual-mask correction
+    (secure/masking.py recovery_correction), no full-cohort retry needed."""
     n = 3
     backends = [MaskingBackend(federation_secret="fed", party_index=i,
                                num_parties=n) for i in range(n)]
@@ -153,12 +209,47 @@ def test_masking_straggler_deadline_recovers():
             "federation stalled after masking straggler"
         stats = fed.statistics()
         assert stats["global_iteration"] >= 1
-        # the failed partial aggregation was surfaced into round metadata
+        # round 1 aggregated the PARTIAL cohort (2 survivors) without an
+        # aggregation failure: dropout recovery, not full-cohort retry
+        meta0 = stats["round_metadata"][0]
+        assert len(meta0["selected_learners"]) == n - 1  # the survivors
+        assert not any("aggregation failed" in err
+                       for err in meta0["errors"])
+    finally:
+        fed.shutdown()
+
+
+def test_masking_below_threshold_falls_back_to_full_retry():
+    """With only 1 survivor (< min_recovery_parties), recovery must REFUSE
+    (unmasking would expose a single learner's plaintext) and the round
+    falls back to the abandon-and-redispatch path."""
+    n = 2
+    backends = [MaskingBackend(federation_secret="fed", party_index=i,
+                               num_parties=n) for i in range(n)]
+    fed = _secure_federation(n, backends, MaskingBackend(num_parties=n),
+                             round_deadline_secs=2.0)
+    target = fed.learners[1]
+    orig_run_task = target.run_task
+    seen = []
+
+    def flaky(task):
+        if not seen:
+            seen.append(task.task_id)
+            return
+        orig_run_task(task)
+
+    target.run_task = flaky
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=90), \
+            "federation stalled after sub-threshold dropout"
+        stats = fed.statistics()
+        # the refused recovery surfaced as an aggregation failure, then the
+        # full-cohort retry completed the round
         assert any("aggregation failed" in err
                    for meta in stats["round_metadata"]
                    for err in meta["errors"])
-        # the completed round aggregated the FULL cohort
-        assert len(stats["round_metadata"][0]["selected_learners"]) == n
+        assert stats["global_iteration"] >= 1
     finally:
         fed.shutdown()
 

@@ -141,3 +141,60 @@ def test_fedadam_federation_learns():
         assert last > 0.5
     finally:
         fed.shutdown()
+
+
+def test_result_without_commit_does_not_advance_state():
+    """An aggregation-failure retry (result() ran but the community model was
+    never installed) must not double-step the optimizer: the committed step
+    only happens via commit()."""
+    rule = ServerOpt("fedadam", learning_rate=0.1)
+    w0 = np.ones((3,), np.float32)
+    rule.seed_community({"w": w0})
+    avg = np.zeros((3,), np.float32)
+
+    # simulated failed round: fold + result, but no commit
+    rule.reset()
+    rule.accumulate(_models(avg))
+    first = rule.result()["w"]
+    rule.reset()
+    assert rule._step == 0  # state not committed
+
+    # retried round over the same cohort produces the identical step
+    rule.reset()
+    rule.accumulate(_models(avg))
+    retried = rule.result()["w"]
+    rule.commit()
+    rule.reset()
+    np.testing.assert_allclose(retried, first, atol=1e-6)
+    assert rule._step == 1
+
+    # a third, committed round DOES advance (sanity that commit works)
+    rule.reset()
+    rule.accumulate(_models(avg))
+    third = rule.result()["w"]
+    rule.commit()
+    assert rule._step == 2
+    assert not np.allclose(third, retried)
+
+
+def test_mismatched_tree_rejected():
+    """A community model with a different key set than the restored/seeded
+    optimizer state must raise, not silently misalign the leaf zip."""
+    rule = ServerOpt("fedavgm")
+    rule.seed_community({"w": np.zeros((2,), np.float32)})
+    rule.aggregate(_models(np.ones((2,), np.float32)))  # build moments
+    bad = [([{"other": np.ones((2,), np.float32)}], 1.0)]
+    with pytest.raises(ValueError, match="does not match"):
+        rule.aggregate(bad)
+
+
+def test_scaffold_requires_sgd_optimizer():
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config.federation import (AggregationConfig,
+                                               FederationConfig)
+
+    with pytest.raises(ValueError, match="scaffold requires optimizer"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="scaffold"),
+            train=TrainParams(optimizer="adam"),
+        )
