@@ -233,7 +233,8 @@ class DriverSession:
             learner_files = [
                 {"scheme": "masking", "kwargs": {
                     "federation_secret": secret, "party_index": idx,
-                    "num_parties": cfg.num_parties}}
+                    "num_parties": cfg.num_parties,
+                    "min_parties": cfg.min_recovery_parties}}
                 for idx in range(len(self.learner_recipes))
             ]
         else:  # identity
@@ -407,7 +408,8 @@ class DriverSession:
     # monitoring (reference monitor_federation :423-480)
     # ------------------------------------------------------------------ #
 
-    def monitor_federation(self, poll_every_s: float = 2.0) -> dict:
+    def monitor_federation(self, poll_every_s: float = 2.0,
+                           eval_drain_timeout_s: float = 90.0) -> dict:
         term = self.config.termination
         while True:
             time.sleep(poll_every_s)
@@ -440,7 +442,27 @@ class DriverSession:
                     logger.info("termination: %s=%.4f ≥ cutoff",
                                 term.metric_name, score)
                     break
+        self._drain_evaluations(eval_drain_timeout_s)
         return self.get_statistics()
+
+    def _drain_evaluations(self, timeout_s: float) -> None:
+        """Give in-flight evaluation tasks a bounded grace period before
+        shutdown: rounds terminate on training completion, but the matching
+        eval round trip (which may still be compiling on the learner) lags —
+        without the drain the final statistics ship empty evaluations."""
+        if timeout_s <= 0:
+            return
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            try:
+                evals = self._client.get_evaluation_lineage(tail=2)
+            except Exception:  # noqa: BLE001 - controller already gone
+                return
+            if not evals or evals[-1].get("evaluations"):
+                return
+            time.sleep(1.0)
+        logger.warning("evaluations still pending after %.0fs drain window",
+                       timeout_s)
 
     @staticmethod
     def _latest_mean_metric(stats: dict, metric: str) -> Optional[float]:

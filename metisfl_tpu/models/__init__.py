@@ -12,6 +12,13 @@ from metisfl_tpu.models.ops import FlaxModelOps, TrainOutput
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.generate import generate, init_cache
 from metisfl_tpu.models.optimizers import make_optimizer, fedprox
+from metisfl_tpu.models.interop import (
+    export_npz,
+    from_keras_weights,
+    from_torch_state_dict,
+    import_named_weights,
+    load_npz,
+)
 
 __all__ = [
     "FlaxModelOps",
@@ -21,4 +28,9 @@ __all__ = [
     "init_cache",
     "make_optimizer",
     "fedprox",
+    "import_named_weights",
+    "from_torch_state_dict",
+    "from_keras_weights",
+    "load_npz",
+    "export_npz",
 ]

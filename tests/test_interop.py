@@ -1,0 +1,169 @@
+"""Keras/PyTorch weights-import bridge (models/interop.py) — the migration
+path from the reference's model backends (reference
+metisfl/models/model_ops.py:88-110, keras_model_ops.py, pytorch_model_ops.py)."""
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from metisfl_tpu.models.interop import (
+    export_npz,
+    from_keras_weights,
+    from_torch_state_dict,
+    import_named_weights,
+    load_npz,
+)
+
+
+class _PoolCNN(nn.Module):
+    """Conv stack with a global-average-pool head: pooling before the head
+    makes torch->Flax import exact (no flatten channel-order mixing)."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(8, (3, 3), padding="SAME")(x))
+        x = nn.relu(nn.Conv(16, (3, 3), padding="SAME")(x))
+        x = x.mean(axis=(1, 2))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(10)(x)
+
+
+def _flax_init(model, shape):
+    return model.init(jax.random.PRNGKey(0), np.zeros(shape, np.float32))
+
+
+def test_torch_cnn_forward_parity():
+    """state_dict import: the Flax model must produce the torch model's
+    outputs exactly (fp32 tolerance)."""
+    torch = pytest.importorskip("torch")
+    tnn = torch.nn
+
+    class TorchCNN(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(1, 8, 3, padding=1)
+            self.conv2 = tnn.Conv2d(8, 16, 3, padding=1)
+            self.fc1 = tnn.Linear(16, 32)
+            self.fc2 = tnn.Linear(32, 10)
+
+        def forward(self, x):
+            x = torch.relu(self.conv1(x))
+            x = torch.relu(self.conv2(x))
+            x = x.mean(dim=(2, 3))
+            x = torch.relu(self.fc1(x))
+            return self.fc2(x)
+
+    torch.manual_seed(0)
+    tmodel = TorchCNN().eval()
+    batch = np.random.default_rng(1).standard_normal((4, 12, 12, 1)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(
+            np.transpose(batch, (0, 3, 1, 2)))).numpy()
+
+    fmodel = _PoolCNN()
+    variables = _flax_init(fmodel, (1, 12, 12, 1))
+    imported = from_torch_state_dict(tmodel.state_dict(), variables)
+    got = np.asarray(fmodel.apply(imported, batch))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_keras_style_npz_roundtrip(tmp_path):
+    """Keras-named npz (HWIO kernels, :0 suffixes) imports into the tree,
+    and export_npz/load_npz round-trips the variables exactly."""
+    model = _PoolCNN()
+    variables = _flax_init(model, (1, 12, 12, 1))
+    rng = np.random.default_rng(3)
+
+    leaves = jax.tree.leaves(variables)
+    keras_names = ["conv2d/kernel:0", "conv2d/bias:0",
+                   "conv2d_1/kernel:0", "conv2d_1/bias:0",
+                   "dense/kernel:0", "dense/bias:0",
+                   "dense_1/kernel:0", "dense_1/bias:0"]
+    # same-layout random weights under Keras naming, shapes in tree order
+    # paired role-wise: kernels with kernels, biases with biases
+    from metisfl_tpu.tensor.pytree import pytree_to_named_tensors
+    shapes = dict(pytree_to_named_tensors(variables))
+    src = {}
+    kernels = [n for n in shapes if n.endswith("kernel")]
+    biases = [n for n in shapes if n.endswith("bias")]
+    for kn, tn in zip([k for k in keras_names if "kernel" in k], kernels):
+        src[kn] = rng.standard_normal(shapes[tn].shape).astype(np.float32)
+    for kn, tn in zip([k for k in keras_names if "bias" in k], biases):
+        src[kn] = rng.standard_normal(shapes[tn].shape).astype(np.float32)
+
+    imported = from_keras_weights(src, variables)
+    flat = dict(pytree_to_named_tensors(imported))
+    for kn, tn in zip([k for k in keras_names if "kernel" in k], kernels):
+        np.testing.assert_array_equal(flat[tn], src[kn])
+
+    path = str(tmp_path / "ckpt.npz")
+    export_npz(imported, path)
+    back = import_named_weights(load_npz(path), variables)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool(np.array_equal(a, b)), imported, back))
+
+
+def test_torch_batchnorm_maps_to_scale_and_stats():
+    torch = pytest.importorskip("torch")
+    tnn = torch.nn
+
+    class TorchBN(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(1, 4, 3, padding=1)
+            self.bn = tnn.BatchNorm2d(4)
+
+        def forward(self, x):
+            return self.bn(self.conv(x))
+
+    class FlaxBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), padding="SAME")(x)
+            return nn.BatchNorm(use_running_average=not train)(x)
+
+    torch.manual_seed(1)
+    tmodel = TorchBN()
+    # give the running stats non-trivial values
+    tmodel.train()
+    with torch.no_grad():
+        for _ in range(3):
+            tmodel(torch.randn(8, 1, 6, 6))
+    tmodel.eval()
+
+    fmodel = FlaxBN()
+    variables = _flax_init(fmodel, (1, 6, 6, 1))
+    imported = from_torch_state_dict(tmodel.state_dict(), variables)
+
+    batch = np.random.default_rng(5).standard_normal((2, 6, 6, 1)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(
+            np.transpose(batch, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(fmodel.apply(imported, batch))
+    np.testing.assert_allclose(
+        got, np.transpose(want, (0, 2, 3, 1)), atol=1e-5)
+
+
+def test_shape_mismatch_raises():
+    model = _PoolCNN()
+    variables = _flax_init(model, (1, 12, 12, 1))
+    bad = {"conv2d/kernel:0": np.zeros((5, 5, 1, 8), np.float32)}
+    with pytest.raises(ValueError, match="shape"):
+        from_keras_weights(bad, variables)
+
+
+def test_name_map_pins_target():
+    model = _PoolCNN()
+    variables = _flax_init(model, (1, 12, 12, 1))
+    from metisfl_tpu.tensor.pytree import pytree_to_named_tensors
+    shapes = dict(pytree_to_named_tensors(variables))
+    arr = np.full(shapes["params/Dense_1/bias"].shape, 7.0, np.float32)
+    out = import_named_weights({"my_head_bias": arr}, variables,
+                               framework="keras",
+                               name_map={"my_head_bias":
+                                         "params/Dense_1/bias"})
+    flat = dict(pytree_to_named_tensors(out))
+    np.testing.assert_array_equal(flat["params/Dense_1/bias"], arr)

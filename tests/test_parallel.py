@@ -462,3 +462,92 @@ def test_pipelined_llama_gradients_match_dense():
     for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_dense)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-3)
+
+
+# ------------------------------------------- north-star compile proof
+
+
+def test_llama3_8b_lora_train_step_lowers_on_64_device_topology():
+    """VERDICT r3 #5 / BASELINE.md north star: the Llama-3-8B-LoRA
+    in-learner-sharded train step AOT-lowers (abstract shapes, no memory)
+    under TRANSFORMER_RULES on a 64-device (dp=8 x tp=8) mesh topology —
+    one v5e-64-slice learner — and the sharded parameter bytes fit v5e
+    HBM per device."""
+    from jax.sharding import AbstractMesh, NamedSharding
+
+    from metisfl_tpu.models.zoo.transformer import (
+        TRANSFORMER_RULES,
+        LlamaLite,
+    )
+    from metisfl_tpu.parallel.sharding import tree_shardings
+
+    # Llama-3-8B geometry (vocab 128256, dim 4096, 32 blocks, GQA 32/8;
+    # mlp_ratio=4 lands ~8.8B params) + rank-16 LoRA on q/v, bf16 compute,
+    # remat'd blocks
+    model = LlamaLite(vocab_size=128256, dim=4096, depth=32, heads=32,
+                      kv_heads=8, lora_rank=16, remat=True,
+                      dtype=jnp.bfloat16)
+    B, L = 8, 4096
+    tokens = jax.ShapeDtypeStruct((B, L), jnp.int32)
+
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    n_params = sum(int(np.prod(leaf.shape))
+                   for leaf in jax.tree.leaves(variables))
+    assert n_params > 7.5e9  # it really is an 8B-class model
+
+    mesh = AbstractMesh((8, 8), ("dp", "tp"))
+    param_shardings = tree_shardings(variables, mesh, TRANSFORMER_RULES)
+    token_sharding = NamedSharding(mesh, P("dp", None))
+
+    # per-device parameter residency: fp32 leaf bytes / product of the
+    # mesh-axis sizes its spec shards over (unsharded leaves replicate)
+    axis_size = dict(zip(mesh.axis_names, mesh.axis_sizes))
+
+    def _per_device_bytes(leaf, sharding):
+        ways = 1
+        for entry in sharding.spec:
+            for name in ([entry] if isinstance(entry, str)
+                         else (entry or ())):
+                ways *= axis_size[name]
+        return int(np.prod(leaf.shape)) * 4 / ways
+
+    per_device = sum(
+        _per_device_bytes(leaf, sh) for leaf, sh in zip(
+            jax.tree.leaves(variables),
+            jax.tree.leaves(param_shardings,
+                            is_leaf=lambda x: isinstance(x, NamedSharding))))
+    v5e_hbm = 16e9
+    assert per_device < 0.5 * v5e_hbm, (
+        f"{per_device / 1e9:.1f} GB of parameters per device leaves no "
+        "room for grads/optimizer/activations in 16 GB v5e HBM")
+
+    def train_step(params, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch[:, :-1], train=True)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            tgt = batch[:, 1:]
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            return nll.mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # LoRA fine-tuning: only adapter params step (base stays frozen)
+        flat = jax.tree_util.tree_flatten_with_path(params)
+        g_leaves = jax.tree.leaves(grads)
+        new_leaves = [
+            leaf - 1e-4 * g if "lora_" in jax.tree_util.keystr(path)
+            else leaf
+            for (path, leaf), g in zip(flat[0], g_leaves)
+        ]
+        return jax.tree_util.tree_unflatten(flat[1], new_leaves), loss
+
+    # AbstractMesh has no devices, so the target platform is explicit —
+    # this lowers the step FOR TPU regardless of the host running the test
+    lowered = jax.jit(
+        train_step,
+        in_shardings=(param_shardings, token_sharding),
+        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+    ).trace(variables, tokens).lower(lowering_platforms=("tpu",))
+    hlo = lowered.as_text()
+    assert "sharding" in hlo  # the lowering is actually sharded
