@@ -15,9 +15,14 @@ and model-store scale (64 learners x 1.6M params + 26 MB ciphertexts).
 
 Robustness contract (the whole point after round 2's rc=1): the JSON line is
 ALWAYS printed. Backend init is probed in a subprocess with retries; on
-persistent failure the bench re-execs itself on CPU and records
-``degraded_to_cpu``. Every secondary section is individually guarded and
-failures land in ``details.errors`` instead of killing the run.
+persistent failure the bench degrades to CPU — but keeps re-probing the
+accelerator between sections across the WHOLE bench window (round-4 change:
+round 3's wedged-at-start tunnel turned a recoverable outage into a CPU-only
+run). Sections run headline-first (aggregation @64, LM MFU before anything
+that could wedge), each in a killable child streaming partial JSON; the
+parent additionally persists cumulative partials to ``bench_partial.json``
+after every section, so even a SIGKILL preserves on-chip numbers. Every
+section failure lands in ``details.errors`` instead of killing the run.
 """
 
 from __future__ import annotations
@@ -65,14 +70,33 @@ def _chip_peak_flops(device_kind: str):
     return None
 
 
-def ensure_backend(max_attempts: int = 3):
+# Backend-liveness probe body for all probe subprocesses. JAX_PLATFORMS is
+# applied via jax.config (honor_platform_env semantics): the image's
+# sitecustomize force-registers the axon TPU platform, and a bare
+# ``import jax`` would probe the (possibly wedged) tunnel even when the env
+# says cpu.
+_PROBE_SNIPPET = (
+    "import os, jax; "
+    "p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "import jax.numpy as jnp; "
+    "jnp.ones((8, 8)).sum().block_until_ready(); "
+    "print(jax.default_backend())")
+
+
+def ensure_backend(max_attempts: int = 2):
     """Probe JAX backend init in a subprocess (so a hard failure can't take
     this process down), retrying with backoff; fall back to CPU.
 
     Round 2 died with ``Unable to initialize backend 'axon': UNAVAILABLE`` at
     the first in-process device op — this makes that failure mode recoverable.
+    Degradation is NOT final: the section loop keeps re-probing the original
+    accelerator across the whole bench window (``try_recover_backend``), so a
+    tunnel that wedges at start but recovers mid-run still lands on-chip
+    numbers (round-3 failure mode: 3 up-front probes, then a CPU-only run).
     """
-    info = {"probe_attempts": 0, "degraded_to_cpu": False}
+    info = {"probe_attempts": 0, "degraded_to_cpu": False,
+            "orig_platforms": os.environ.get("JAX_PLATFORMS") or ""}
     plat = (os.environ.get("JAX_PLATFORMS") or "").strip().lower()
     if plat == "cpu":
         return info  # explicit CPU: nothing to probe
@@ -80,12 +104,11 @@ def ensure_backend(max_attempts: int = 3):
     # (the driver env sets axon) — gets probed in a subprocess first: a
     # wedged tunnel hangs the first in-process device op in native code,
     # where not even the SIGALRM watchdog can interrupt it
-    probe = ("import jax, jax.numpy as jnp; "
-             "jnp.ones((8, 8)).sum().block_until_ready(); "
-             "print(jax.default_backend())")
+    probe = _PROBE_SNIPPET
     # first attempt gets the cold-compile budget; a wedged tunnel (init
-    # hangs, round-3 observation) then fails fast on the retries
-    timeouts = [240] + [120] * (max_attempts - 1)
+    # hangs, round-3 observation) then fails fast on the retry — the
+    # opportunistic mid-run probes take over from there
+    timeouts = [240] + [90] * (max_attempts - 1)
     for attempt in range(max_attempts):
         info["probe_attempts"] = attempt + 1
         try:
@@ -102,6 +125,35 @@ def ensure_backend(max_attempts: int = 3):
     os.environ["JAX_PLATFORMS"] = "cpu"
     info["degraded_to_cpu"] = True
     return info
+
+
+def try_recover_backend(info: dict, timeout: int = 75) -> bool:
+    """Opportunistic un-degrade: re-probe the ORIGINAL accelerator platform
+    with a bounded subprocess; on success restore the environment so later
+    sections run on chip. Called between sections while degraded."""
+    if not info.get("degraded_to_cpu"):
+        return True
+    env = dict(os.environ)
+    orig = info.get("orig_platforms") or ""
+    if orig:
+        env["JAX_PLATFORMS"] = orig
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    info["recover_probes"] = info.get("recover_probes", 0) + 1
+    try:
+        alive = subprocess.run([sys.executable, "-c", _PROBE_SNIPPET],
+                               env=env, capture_output=True,
+                               timeout=timeout).returncode == 0
+    except Exception:
+        alive = False
+    if alive:
+        if orig:
+            os.environ["JAX_PLATFORMS"] = orig
+        else:
+            os.environ.pop("JAX_PLATFORMS", None)
+        info["degraded_to_cpu"] = False
+        info["recovered_mid_run"] = True
+    return alive
 
 
 def synth_models(num_learners: int, seed: int = 0):
@@ -612,10 +664,8 @@ def _probe_backend_alive(timeout: int = 90) -> bool:
     """Quick subprocess probe: is the accelerator still reachable?"""
     if (os.environ.get("JAX_PLATFORMS") or "").strip().lower() == "cpu":
         return True
-    probe = ("import jax, jax.numpy as jnp; "
-             "jnp.ones((8, 8)).sum().block_until_ready()")
     try:
-        return subprocess.run([sys.executable, "-c", probe],
+        return subprocess.run([sys.executable, "-c", _PROBE_SNIPPET],
                               capture_output=True,
                               timeout=timeout).returncode == 0
     except Exception:
@@ -637,7 +687,8 @@ def _kill_active_child() -> None:
             pass
 
 
-def _run_section(name: str, quick: bool, timeout: int, errors: dict) -> dict:
+def _run_section(name: str, quick: bool, timeout: int, errors: dict,
+                 info: dict = None) -> dict:
     """Run a section in a subprocess; on timeout the child is SIGKILLed and
     whatever partials it streamed out are kept."""
     import tempfile
@@ -662,10 +713,13 @@ def _run_section(name: str, quick: bool, timeout: int, errors: dict) -> dict:
             proc.wait(timeout=10)
             errors[name] = f"section timed out after {timeout}s (killed)"
             # a wedged tunnel makes every later accelerator section eat its
-            # full timeout too — re-probe, and degrade the REST to CPU if dead
+            # full timeout too — re-probe, and degrade the REST to CPU if
+            # dead (the section loop keeps re-probing for recovery)
             if not _probe_backend_alive():
                 os.environ["JAX_PLATFORMS"] = "cpu"
                 errors[name + "_tunnel"] = "backend unreachable; rest on cpu"
+                if info is not None:
+                    info["degraded_to_cpu"] = True
     except Exception:
         errors[name] = traceback.format_exc(limit=2)[-400:]
     finally:
@@ -746,25 +800,66 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 # remaining sections to CPU.
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 900, "flash": 900, "decode": 600}
+# opportunistic mid-run recovery probes (try_recover_backend): count × timeout
+_MAX_RECOVER_PROBES = 4
+_RECOVER_PROBE_SECS = 75
 # worst case: every section eats its cap AND its post-timeout 90s backend
-# probe, plus slack for child startup — the alarm must sit above that
+# probe, every recovery probe times out, plus slack for child startup —
+# the alarm must sit above that sum or it cuts runs the caps allow
 WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
-                      + 90 * len(_SECTION_TIMEOUTS) + 300)
+                      + 90 * len(_SECTION_TIMEOUTS)
+                      + _MAX_RECOVER_PROBES * _RECOVER_PROBE_SECS + 300)
 
 
-def run_bench(quick: bool, isolate: bool = True):
+# sections that want the accelerator, in HEADLINE-FIRST order: the judged
+# metrics (aggregation @64, LM MFU) land before anything that could wedge
+_DEVICE_SECTIONS = ("agg", "mfu", "train", "flash", "decode")
+# host-only sections — immune to tunnel state; run last on a healthy
+# backend, FIRST while degraded (buys the tunnel minutes to recover)
+_HOST_SECTIONS = ("ckks", "store")
+_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_partial.json")
+
+
+def _persist_partials(details: dict, errors: dict) -> None:
+    """Cumulative on-disk snapshot after every section: even a SIGKILL of
+    this parent (nothing catchable) leaves everything measured so far."""
+    try:
+        tmp = _PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"details": details, "errors": errors,
+                       "ts": time.time()}, fh)
+        os.replace(tmp, _PARTIAL_PATH)
+    except OSError:
+        pass
+
+
+def run_bench(quick: bool, isolate: bool = True, backend_info=None):
     num_learners = 8 if quick else NUM_LEARNERS
     rounds = 2 if quick else ROUNDS
     errors = _PARTIAL["errors"]
     details = _PARTIAL["details"]
+    info = backend_info if backend_info is not None else {}
 
     if not quick and isolate:
         # full mode: every section in its own killable child process; this
         # parent never initializes an accelerator backend itself
-        for name in ("agg", "train", "ckks", "store", "mfu", "flash",
-                     "decode"):
-            details.update(_run_section(name, quick,
-                                        _SECTION_TIMEOUTS[name], errors))
+        if info.get("degraded_to_cpu"):
+            order = _HOST_SECTIONS + _DEVICE_SECTIONS
+        else:
+            order = _DEVICE_SECTIONS + _HOST_SECTIONS
+        for name in order:
+            if (name in _DEVICE_SECTIONS and info.get("degraded_to_cpu")
+                    and info.get("recover_probes", 0) < _MAX_RECOVER_PROBES):
+                try_recover_backend(info, timeout=_RECOVER_PROBE_SECS)
+            out = _run_section(name, quick, _SECTION_TIMEOUTS[name], errors,
+                               info)
+            if "backend" in out:
+                # per-section attribution: a recovered tunnel means early
+                # sections ran on CPU and later ones on chip
+                details[f"{name}_backend"] = out["backend"]
+            details.update(out)
+            _persist_partials(details, errors)
         return _result_from(details, errors, num_learners)
 
     # in-process path: quick CI/CPU smoke (small sizes, CKKS only) or the
@@ -811,7 +906,7 @@ def main():
     _install_watchdog(8 if args.quick else NUM_LEARNERS,
                       budget_secs=600 if args.quick else WATCHDOG_FULL_SECS)
     try:
-        result = run_bench(args.quick)
+        result = run_bench(args.quick, backend_info=backend_info)
     except Exception as exc:
         # In-process backend death after a clean probe (the round-2 failure
         # mode): one retry, whole-process, pinned to CPU.

@@ -1,6 +1,8 @@
 """bench.py harness plumbing — the sweep/guard logic must be CI-covered so
 the driver's one TPU run per round can't be the first execution of it."""
 
+import os
+
 import numpy as np
 
 
@@ -94,3 +96,29 @@ def test_aggregation_headline_correctness():
     expect = np.mean([m["head/bias"] for m in models], axis=0)
     np.testing.assert_allclose(np.asarray(out["head/bias"]), expect,
                                atol=1e-5)
+
+
+def test_opportunistic_backend_recovery_restores_env(monkeypatch):
+    """try_recover_backend: while degraded, a successful re-probe of the
+    original platform restores JAX_PLATFORMS and clears the degraded flag
+    (round-4 bench change: probes span the whole bench window)."""
+    import bench
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    info = {"degraded_to_cpu": True, "orig_platforms": "cpu"}
+    assert bench.try_recover_backend(info, timeout=240)
+    assert info["degraded_to_cpu"] is False
+    assert info["recovered_mid_run"] is True
+    assert info["recover_probes"] == 1
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+
+def test_device_sections_lead_and_host_sections_cover_all():
+    """Headline sections run first on a healthy backend; the two orderings
+    cover exactly the full section set."""
+    import bench
+
+    assert bench._DEVICE_SECTIONS[0] == "agg"      # headline metric first
+    assert bench._DEVICE_SECTIONS[1] == "mfu"      # then the MFU story
+    assert set(bench._DEVICE_SECTIONS + bench._HOST_SECTIONS) == (
+        set(bench._SECTIONS) | {"agg"})
