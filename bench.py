@@ -124,6 +124,7 @@ def ensure_backend(max_attempts: int = 2):
         time.sleep(5 * (attempt + 1))
     os.environ["JAX_PLATFORMS"] = "cpu"
     info["degraded_to_cpu"] = True
+    info["last_dead_ts"] = time.time()
     return info
 
 
@@ -153,6 +154,8 @@ def try_recover_backend(info: dict, timeout: int = 75) -> bool:
             os.environ.pop("JAX_PLATFORMS", None)
         info["degraded_to_cpu"] = False
         info["recovered_mid_run"] = True
+    else:
+        info["last_dead_ts"] = time.time()
     return alive
 
 
@@ -322,6 +325,13 @@ def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
         ("b8_dense_scan8", dict(B=8, flash=False, remat=False, scan=8)),
         ("b8_flash_scan8", dict(B=8, flash=True, remat=False, scan=8)),
         ("b16_flash_remat_scan8", dict(B=16, flash=True, remat=True, scan=8)),
+        # seq-length-routed attention (ops/flash_attention.attention):
+        # dense below FLASH_MIN_SEQ, the pallas kernel above — the default
+        # a user should pick
+        ("b16_auto_remat_scan8", dict(B=16, flash="auto", remat=True,
+                                      scan=8)),
+        ("b32_dense_remat_scan8", dict(B=32, flash=False, remat=True,
+                                       scan=8)),
     ]
     out = {"device_kind": kind,
            "lm_config": f"dim{dim}/depth{depth}/heads{heads}/seq{L}/bf16"}
@@ -457,6 +467,39 @@ def bench_flash(seq: int = 2048, reps: int = 8, on_update=None):
     t_one = timed(chained_fwd(flash, 1), gqa_args)
     out["attn_flash_gqa4of16_fwd_ms"] = round(
         max((t_many - t_one) / (reps - 1), 0.0), 3)
+    if on_update is not None:
+        on_update(out)
+
+    # block-size sweep (VERDICT r3 #1: tune until flash earns its keep or
+    # the crossover is known): per-config fwd per-op time + the best
+    best_blk = None
+    for bq, bk in ((256, 256), (256, 512), (512, 512), (512, 1024),
+                   (1024, 512)):
+        if bq > seq or bk > seq:
+            continue
+        try:
+            def flash_blk(q, k, v, _bq=bq, _bk=bk):
+                return flash_attention(q, k, v, True, _bq, _bk)
+
+            t_many = timed(chained_fwd(flash_blk, reps))
+            t_one = timed(chained_fwd(flash_blk, 1))
+            per_op = max((t_many - t_one) / (reps - 1), 0.0)
+            out[f"attn_flash_blk{bq}x{bk}_fwd_ms"] = round(per_op, 3)
+            if best_blk is None or per_op < best_blk[1]:
+                best_blk = ((bq, bk), per_op)
+        except Exception:
+            out[f"attn_flash_blk{bq}x{bk}_error"] = \
+                traceback.format_exc(limit=1)[-160:]
+        if on_update is not None:
+            on_update(out)
+    if best_blk is not None:
+        out["attn_flash_best_blk"] = f"{best_blk[0][0]}x{best_blk[0][1]}"
+        out["attn_flash_best_blk_fwd_ms"] = round(best_blk[1], 3)
+        dense_fwd = out.get("attn_dense_fwd_ms")
+        if dense_fwd:
+            # the routing decision FLASH_MIN_SEQ encodes, re-measured
+            out["attn_flash_beats_dense_at_seq"] = bool(
+                best_blk[1] < dense_fwd)
     return out
 
 
@@ -720,6 +763,7 @@ def _run_section(name: str, quick: bool, timeout: int, errors: dict,
                 errors[name + "_tunnel"] = "backend unreachable; rest on cpu"
                 if info is not None:
                     info["degraded_to_cpu"] = True
+                    info["last_dead_ts"] = time.time()
     except Exception:
         errors[name] = traceback.format_exc(limit=2)[-400:]
     finally:
@@ -803,6 +847,9 @@ _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
 # opportunistic mid-run recovery probes (try_recover_backend): count × timeout
 _MAX_RECOVER_PROBES = 4
 _RECOVER_PROBE_SECS = 75
+# minimum seconds since the last confirmed-dead probe before spending
+# another recovery probe (tunnel outages last minutes, not seconds)
+_RECOVER_COOLDOWN_SECS = 150
 # worst case: every section eats its cap AND its post-timeout 90s backend
 # probe, every recovery probe times out, plus slack for child startup —
 # the alarm must sit above that sum or it cuts runs the caps allow
@@ -850,7 +897,11 @@ def run_bench(quick: bool, isolate: bool = True, backend_info=None):
             order = _DEVICE_SECTIONS + _HOST_SECTIONS
         for name in order:
             if (name in _DEVICE_SECTIONS and info.get("degraded_to_cpu")
-                    and info.get("recover_probes", 0) < _MAX_RECOVER_PROBES):
+                    and info.get("recover_probes", 0) < _MAX_RECOVER_PROBES
+                    # cooldown: a probe seconds after one just failed is a
+                    # near-certain burn of the bounded probe budget
+                    and time.time() - info.get("last_dead_ts", 0.0)
+                    > _RECOVER_COOLDOWN_SECS):
                 try_recover_backend(info, timeout=_RECOVER_PROBE_SECS)
             out = _run_section(name, quick, _SECTION_TIMEOUTS[name], errors,
                                info)

@@ -171,8 +171,15 @@ class Attention(nn.Module):
                 self.sp_mesh, self.sp_axis, causal=self.causal,
                 block_kernels=self.sp_block_kernels)(q, k, v)
         elif self.use_flash:
-            from metisfl_tpu.ops import flash_attention
-            out = flash_attention(q, k, v, self.causal)
+            if self.use_flash == "auto":
+                # sequence-length routing: dense below the measured
+                # crossover (ops/flash_attention.py FLASH_MIN_SEQ), the
+                # pallas kernel above it
+                from metisfl_tpu.ops import attention
+                out = attention(q, k, v, self.causal)
+            else:
+                from metisfl_tpu.ops import flash_attention
+                out = flash_attention(q, k, v, self.causal)
         else:
             # softmax in fp32 regardless of compute dtype (bf16 exp/normalize
             # loses too much precision), then back to the compute dtype so

@@ -1,5 +1,6 @@
 """Pallas TPU kernels for the hot ops."""
 
-from metisfl_tpu.ops.flash_attention import flash_attention
+from metisfl_tpu.ops.flash_attention import (FLASH_MIN_SEQ, attention,
+                                             flash_attention)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "attention", "FLASH_MIN_SEQ"]

@@ -13,8 +13,16 @@ VMEM stays bounded at any sequence length.
 The backward is the FlashAttention-2 scheme: dQ accumulates over KV blocks,
 dK/dV accumulate over Q blocks, both recomputing probabilities from the
 forward's saved logsumexp — training memory is O(L·D) end to end. Causal
-mode skips fully-masked blocks in all three kernels (~half the FLOPs),
-which is what lets the kernel beat XLA's dense attention.
+mode skips fully-masked blocks in all three kernels (~half the FLOPs).
+
+Where it wins: the kernel's value is O(L·D) memory (the (L, L) score
+matrix never materializes), which is what makes long sequences fit at all;
+on raw speed XLA's fused dense attention is competitive at moderate L
+(measured on v5e at seq 2048: dense 74.2 ms vs flash 77.6 ms fwd —
+bench_results/tpu_v5e_round3b.json), with the kernel's causal block skip
+paying off as L grows past the score-matrix memory wall. Use
+:func:`attention` to route between the two on sequence length instead of
+hand-picking.
 
 Sequence lengths that do not divide the block size are zero-padded up to
 the next block boundary and masked inside the kernels (padded rows are
@@ -195,14 +203,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dense_attention(q, k, v, causal: bool):
-    """XLA reference implementation (tests + oracle)."""
+    """XLA reference implementation (tests oracle + the routed dense path).
+
+    Softmax in fp32 regardless of compute dtype — bf16 exp/normalize loses
+    too much precision (same policy as the flash kernel's fp32 online
+    statistics and the model zoo's dense branch); probabilities cast back
+    so the PV matmul stays on the MXU's native path."""
     D = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * float(1.0 / np.sqrt(D))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * float(
+        1.0 / np.sqrt(D))
     if causal:
         L = q.shape[2]
         mask = jnp.tril(jnp.ones((L, L), bool))
         s = jnp.where(mask, s, _NEG)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 def _pad_len(L: int, blk: int) -> int:
@@ -422,3 +437,29 @@ def _bwd(causal, blk_q, blk_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# Flash-vs-dense crossover (sequence length). Below it XLA's fused dense
+# attention is at least as fast and compiles quicker; at/above it the dense
+# path's (L, L) score matrix starts to dominate memory and the kernel's
+# causal block skip pays off. Seeded from the v5e round-3 capture (dense
+# still ahead at 2048); the bench's block sweep re-measures every round.
+FLASH_MIN_SEQ = 4096
+
+
+def attention(q, k, v, causal: bool = False, *,
+              min_flash_seq: Optional[int] = None,
+              blk_q: Optional[int] = None,
+              blk_k: Optional[int] = None):
+    """Sequence-length-routed attention: the pallas flash kernel at
+    ``L >= min_flash_seq`` (default :data:`FLASH_MIN_SEQ`), XLA's fused
+    dense attention below. GQA inputs (fewer K/V heads) work on both paths
+    — dense broadcasts the KV groups at compute time."""
+    threshold = FLASH_MIN_SEQ if min_flash_seq is None else int(min_flash_seq)
+    if q.shape[2] >= threshold:
+        return flash_attention(q, k, v, causal, blk_q, blk_k)
+    if k.shape[1] != q.shape[1]:
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    return _dense_attention(q, k, v, causal)

@@ -153,3 +153,36 @@ class TestGroupedQueryFlash:
             np.asarray(flash.apply(variables, tokens)),
             np.asarray(plain.apply(variables, tokens)),
             atol=2e-3, rtol=2e-3)
+
+
+def test_attention_routes_on_sequence_length():
+    """ops.attention: dense XLA below the crossover, the pallas kernel at
+    or above it — both numerically the oracle, incl. GQA inputs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metisfl_tpu.ops.flash_attention import (_dense_attention,
+                                                 attention)
+
+    rng = jax.random.PRNGKey(3)
+    B, H, L, D = 2, 4, 64, 32
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (B, H, L, D),
+                                 jnp.float32) for i in range(3))
+    want = _dense_attention(q, k, v, True)
+    # below threshold -> dense path (exact match)
+    np.testing.assert_allclose(
+        np.asarray(attention(q, k, v, True, min_flash_seq=4 * L)),
+        np.asarray(want), rtol=1e-6, atol=1e-6)
+    # at/above threshold -> flash kernel (oracle match within fp tolerance)
+    np.testing.assert_allclose(
+        np.asarray(attention(q, k, v, True, min_flash_seq=L)),
+        np.asarray(want), rtol=2e-2, atol=2e-3)
+
+    # GQA (2 of 4 KV heads) on the dense route broadcasts groups
+    kg, vg = k[:, :2], v[:, :2]
+    want_gqa = _dense_attention(q, jnp.repeat(kg, 2, axis=1),
+                                jnp.repeat(vg, 2, axis=1), True)
+    np.testing.assert_allclose(
+        np.asarray(attention(q, kg, vg, True, min_flash_seq=4 * L)),
+        np.asarray(want_gqa), rtol=1e-6, atol=1e-6)
