@@ -60,4 +60,13 @@ def honor_platform_env() -> None:
     platforms = os.environ.get("JAX_PLATFORMS")
     if platforms:
         import jax
-        jax.config.update("jax_platforms", platforms)
+
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except RuntimeError:
+            # backend already initialized (something touched jax.devices()
+            # first): too late to repin — proceed on whatever initialized
+            # rather than crashing the entry point
+            logger.warning(
+                "JAX backend already initialized; JAX_PLATFORMS=%s not "
+                "applied", platforms)

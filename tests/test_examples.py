@@ -141,3 +141,64 @@ def test_neuroimaging_regression_example(tmp_path):
     evals = [m for entry in experiment["community_evaluations"]
              for m in entry["evaluations"].values()]
     assert any("mae" in m.get("test", {}) for m in evals)
+
+
+def test_yaml_template_loads_to_defaults(tmp_path):
+    """examples/config/template.yaml (the reference's template.yaml role)
+    parses through load_config, every documented default matches the
+    dataclass tree's actual defaults, AND every dataclass field appears in
+    the YAML — a field added to the tree without a template entry fails
+    here, so the template cannot drift by omission either."""
+    import dataclasses
+
+    import yaml
+
+    from metisfl_tpu.config import FederationConfig, load_config
+
+    path = os.path.join(REPO, "examples", "config", "template.yaml")
+    cfg = load_config(path)
+    assert len(cfg.learners) == 2
+    default = FederationConfig(learners=cfg.learners)
+    for f in dataclasses.fields(FederationConfig):
+        assert getattr(cfg, f.name) == getattr(default, f.name), f.name
+
+    # full key coverage, recursively (absent keys load as defaults, so the
+    # equality check above alone cannot catch omissions)
+    with open(path) as fh:
+        raw = yaml.safe_load(fh)
+
+    def assert_covered(cls, mapping, where):
+        import typing
+
+        hints = typing.get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            assert f.name in mapping, f"{where}.{f.name} missing from template"
+            hint = hints[f.name]
+            if dataclasses.is_dataclass(hint):
+                assert_covered(hint, mapping[f.name] or {},
+                               f"{where}.{f.name}")
+
+    assert_covered(FederationConfig, raw, "config")
+    from metisfl_tpu.config import LearnerEndpoint
+
+    assert_covered(LearnerEndpoint, raw["learners"][0], "learners[0]")
+
+    # overrides round-trip (incl. round-4 fields) and validation still bites
+    override = tmp_path / "fed.yaml"
+    override.write_text(
+        "protocol: asynchronous\n"
+        "aggregation: {rule: fedadam, staleness_decay: 0.5}\n"
+        "model_store: {store: remote, host: stores.example, port: 50099}\n"
+        "secure: {min_recovery_parties: 3}\n")
+    cfg2 = load_config(str(override))
+    assert cfg2.aggregation.rule == "fedadam"
+    assert cfg2.model_store.host == "stores.example"
+    assert cfg2.secure.min_recovery_parties == 3
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("aggregation: {rule: scaffold}\n"
+                   "train: {optimizer: adam}\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="scaffold requires optimizer"):
+        load_config(str(bad))
