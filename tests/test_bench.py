@@ -122,3 +122,84 @@ def test_device_sections_lead_and_host_sections_cover_all():
     assert bench._DEVICE_SECTIONS[1] == "mfu"      # then the MFU story
     assert set(bench._DEVICE_SECTIONS + bench._HOST_SECTIONS) == (
         set(bench._SECTIONS) | {"agg"})
+
+
+def test_post_loop_recovery_reruns_headline_sections(monkeypatch):
+    """A degraded run that recovers in the post-loop window re-runs the
+    headline sections (their results overwrite the CPU pass)."""
+    import bench
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def fake_recover(info, timeout=0):
+        info["degraded_to_cpu"] = False
+        info["recovered_mid_run"] = True
+        info["recover_probes"] = info.get("recover_probes", 0) + 1
+        return True
+
+    monkeypatch.setattr(bench, "try_recover_backend", fake_recover)
+    monkeypatch.setattr(bench, "_RECOVER_COOLDOWN_SECS", 0)
+    details, errors = {}, {}
+    info = {"degraded_to_cpu": True, "orig_platforms": "cpu",
+            "last_dead_ts": 0.0}
+    bench._post_loop_recovery(details, errors, info, quick=True)
+    assert details.get("post_loop_recovery") is True
+    assert "ms_per_round_median" in details  # agg really re-ran
+    assert not errors
+
+
+def test_post_loop_recovery_bounded_when_tunnel_stays_dead(monkeypatch):
+    """No recovery: the window spends at most its probe budget and returns
+    without touching the results."""
+    import time as _time
+
+    import bench
+
+    calls = []
+
+    def fake_recover(info, timeout=0):
+        calls.append(_time.time())
+        info["recover_probes"] = info.get("recover_probes", 0) + 1
+        info["last_dead_ts"] = _time.time()
+        return False
+
+    monkeypatch.setattr(bench, "try_recover_backend", fake_recover)
+    monkeypatch.setattr(bench, "_RECOVER_COOLDOWN_SECS", 0)
+    monkeypatch.setattr(bench, "_POST_LOOP_RECOVERY_SECS", 2)
+    details = {}
+    info = {"degraded_to_cpu": True, "last_dead_ts": 0.0}
+    t0 = _time.time()
+    bench._post_loop_recovery(details, {}, info, quick=True)
+    assert _time.time() - t0 < 10
+    assert details == {}
+    assert 1 <= info["recover_probes"] <= bench._MAX_RECOVER_PROBES
+
+
+def test_run_and_record_reconciles_errors_and_preserves_values(monkeypatch):
+    """The shared section bookkeeping: a successful re-run clears the stale
+    first-pass error; a FAILING re-run with keep_existing_on_error only
+    fills gaps instead of clobbering completed values."""
+    import bench
+
+    # successful pass clears prior error + tunnel note, overwrites values
+    monkeypatch.setattr(bench, "_run_section",
+                        lambda *a, **k: {"x": 2, "backend": "tpu"})
+    details = {"x": 1}
+    errors = {"agg": "section timed out", "agg_tunnel": "dead"}
+    bench._run_and_record("agg", False, details, errors, {})
+    assert errors == {}
+    assert details["x"] == 2 and details["agg_backend"] == "tpu"
+
+    # failing re-run (records its error) must not clobber completed values
+    def failing(name, quick, timeout, errors, info):
+        errors[name] = "re-run wedged"
+        return {"x": 99, "partial_only": 7}
+
+    monkeypatch.setattr(bench, "_run_section", failing)
+    details = {"x": 42}
+    errors = {}
+    bench._run_and_record("agg", False, details, errors, {},
+                          keep_existing_on_error=True)
+    assert errors == {"agg": "re-run wedged"}
+    assert details["x"] == 42          # completed value preserved
+    assert details["partial_only"] == 7  # gap filled
