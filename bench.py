@@ -858,16 +858,19 @@ _RECOVER_COOLDOWN_SECS = 150
 # the first pass's results are already persisted/printable throughout.
 _POST_LOOP_RECOVERY_SECS = 600
 _POST_LOOP_SECTIONS = ("agg", "mfu")
-# worst case: every section eats its cap AND its post-timeout 90s backend
-# probe, every recovery probe times out, the post-loop recovery window runs
-# dry and the headline re-runs eat their caps, plus slack for child
-# startup — the alarm must sit above that sum or it cuts runs the caps
-# allow. (A driver SIGTERM at ANY point still prints the partials.)
+# worst case: every section (including the post-loop headline re-runs)
+# eats its cap AND its post-timeout 90s backend probe, every recovery
+# probe times out, the recovery window runs dry — and its final probe may
+# start just before the window deadline and overshoot by a full probe —
+# plus slack for child startup. The alarm must sit above that sum or it
+# cuts runs the caps allow. (A driver SIGTERM at ANY point still prints
+# the partials.)
 WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
                       + 90 * len(_SECTION_TIMEOUTS)
                       + _MAX_RECOVER_PROBES * _RECOVER_PROBE_SECS
-                      + _POST_LOOP_RECOVERY_SECS
-                      + sum(_SECTION_TIMEOUTS[s] for s in _POST_LOOP_SECTIONS)
+                      + _POST_LOOP_RECOVERY_SECS + _RECOVER_PROBE_SECS
+                      + sum(_SECTION_TIMEOUTS[s] + 90
+                            for s in _POST_LOOP_SECTIONS)
                       + 300)
 
 
@@ -919,12 +922,20 @@ def _run_and_record(name: str, quick: bool, details: dict, errors: dict,
 
 def _post_loop_recovery(details: dict, errors: dict, info: dict,
                         quick: bool) -> None:
-    """Still degraded after the CPU pass (which finishes in minutes because
-    accelerator sections no-op on CPU): keep probing the tunnel for a
-    bounded window and, on recovery, re-run the HEADLINE sections on chip —
-    their results overwrite the CPU numbers, with attribution. The full CPU
-    pass stays persisted throughout, so this can only improve the result."""
-    if not info.get("degraded_to_cpu"):
+    """Re-run headline sections on chip when any of them ran degraded.
+
+    Covers both shapes: a mid-loop recovery (later sections got the chip
+    but the earlier headline ones did not), and a run still degraded after
+    the CPU pass — which finishes in minutes because accelerator sections
+    no-op on CPU, so recovery probes continue for a bounded window first.
+    The full CPU pass stays persisted throughout; a failing re-run cannot
+    clobber it (keep_existing_on_error)."""
+    if not (info.get("degraded_to_cpu") or info.get("recovered_mid_run")):
+        return  # backend never changed: whatever ran IS final (incl. a
+        #         genuinely CPU-only environment)
+    needs = [name for name in _POST_LOOP_SECTIONS
+             if details.get(f"{name}_backend") in (None, "cpu")]
+    if not needs:
         return
     deadline = time.time() + _POST_LOOP_RECOVERY_SECS
     while (time.time() < deadline and info.get("degraded_to_cpu")
@@ -939,7 +950,7 @@ def _post_loop_recovery(details: dict, errors: dict, info: dict,
     if info.get("degraded_to_cpu"):
         return
     details["post_loop_recovery"] = True
-    for name in _POST_LOOP_SECTIONS:
+    for name in needs:
         _run_and_record(name, quick, details, errors, info,
                         keep_existing_on_error=True)
 

@@ -21,6 +21,7 @@ import functools
 
 from metisfl_tpu.aggregation.base import AggregationRule, AggState
 from metisfl_tpu.aggregation.fedavg import FedAvg, Scaffold
+from metisfl_tpu.aggregation.robust import CoordinateMedian, Krum, TrimmedMean
 from metisfl_tpu.aggregation.rolling import FedRec, FedStride
 from metisfl_tpu.aggregation.secure import SecureAgg
 from metisfl_tpu.aggregation.serveropt import ServerOpt
@@ -36,6 +37,11 @@ AGGREGATION_RULES = {
     "fedavgm": functools.partial(ServerOpt, "fedavgm"),
     "fedadam": functools.partial(ServerOpt, "fedadam"),
     "fedyogi": functools.partial(ServerOpt, "fedyogi"),
+    # byzantine-robust rules (aggregation/robust.py — beyond the reference)
+    "median": CoordinateMedian,
+    "trimmed_mean": TrimmedMean,
+    "krum": Krum,
+    "multikrum": functools.partial(Krum, name="multikrum"),
 }
 
 

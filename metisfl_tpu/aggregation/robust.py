@@ -1,0 +1,221 @@
+"""Byzantine-robust aggregation rules: coordinate median, trimmed mean,
+(Multi-)Krum.
+
+Beyond the reference's inventory (its rules are all weighted averages —
+FedAvg/FedStride/FedRec/PWA, SURVEY.md §2.1 C3-C7): a single poisoned or
+faulty learner can steer a mean arbitrarily, and federated deployments are
+exactly where that threat lives. These rules bound the influence of up to
+``f`` byzantine learners:
+
+- ``median``       — coordinate-wise median across the cohort's models;
+- ``trimmed_mean`` — coordinate-wise mean after dropping the ``trim_ratio``
+  fraction from each tail (Yin et al., "Byzantine-Robust Distributed
+  Learning");
+- ``krum`` / ``multikrum`` — select the model(s) whose summed squared
+  distance to their n−f−2 nearest neighbors is smallest (Blanchard et al.,
+  "Machine Learning with Adversaries"); MultiKrum averages the best
+  ``n − f`` selections.
+
+TPU-native shape: every rule runs as ONE jit-compiled program over the
+stacked cohort — per-leaf (n, ...) stacks for the coordinate rules
+(vectorized sort/median on device), and a single (n, n) pairwise distance
+matmul (MXU-friendly) for Krum's scores. 64-bit trees under x32 mode take
+the host-numpy path instead (same dtype-preservation contract as the
+folds — ``base.use_numpy_fold``); the cast back to storage dtypes reuses
+``base.finalize``/``np_finalize``.
+
+These rules need the WHOLE cohort in one call (a median cannot fold
+stride-wise), so they set ``requires_full_cohort`` and the controller
+collects all selected models before aggregating — stride then only bounds
+store-select batching, like the secure path. Scales are ignored by
+construction: robustness comes precisely from NOT letting any learner
+claim more weight.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metisfl_tpu.aggregation.base import (
+    Pytree,
+    finalize,
+    np_finalize,
+    use_numpy_fold,
+)
+
+
+@jax.jit
+def _median_tree(stacked: Pytree) -> Pytree:
+    return jax.tree.map(lambda s: jnp.median(s, axis=0), stacked)
+
+
+@functools.partial(jax.jit, static_argnames=("trim",))
+def _trimmed_mean_tree(stacked: Pytree, trim: int) -> Pytree:
+    def leaf(s):
+        s = jnp.sort(s, axis=0)
+        kept = s[trim: s.shape[0] - trim] if trim else s
+        return kept.mean(axis=0)
+
+    return jax.tree.map(leaf, stacked)
+
+
+@functools.partial(jax.jit, static_argnames=("f",))
+def _krum_scores(flat: jnp.ndarray, f: int) -> jnp.ndarray:
+    """flat: (n, d) model vectors → (n,) Krum scores (lower = more
+    central). One Gram matmul gives all pairwise squared distances."""
+    n = flat.shape[0]
+    sq = jnp.sum(flat * flat, axis=1)
+    gram = flat @ flat.T
+    d2 = sq[:, None] + sq[None, :] - 2.0 * gram          # (n, n)
+    d2 = d2 + jnp.diag(jnp.full((n,), jnp.inf, d2.dtype))  # exclude self
+    k = max(1, n - f - 2)
+    nearest = jnp.sort(d2, axis=1)[:, :k]
+    return nearest.sum(axis=1)
+
+
+class _RobustBase:
+    """Common whole-cohort aggregation shell."""
+
+    required_lineage = 1
+    requires_full_cohort = True
+
+    def aggregate(self, models, state=None, learner_ids=None) -> Pytree:
+        cohort = [lineage[0] for lineage, _scale in models]
+        if not cohort:
+            raise ValueError(f"{self.name} called with no models")
+        template = cohort[0]
+        # dtype-preserving contract (base.use_numpy_fold): 64-bit trees
+        # under x32 mode reduce on host numpy — jit would silently truncate
+        if any(use_numpy_fold(m) for m in cohort):
+            result = self._combine_np(cohort)
+            return np_finalize(result, 1.0, like=template)
+        result = self._combine(cohort)
+        return jax.tree.map(np.asarray, finalize(result, 1.0, like=template))
+
+    def reset(self) -> None:
+        pass
+
+    # device (jit) and host (wide-dtype) implementations
+    def _combine(self, cohort: Sequence[Pytree]) -> Pytree:
+        raise NotImplementedError
+
+    def _combine_np(self, cohort: Sequence[Pytree]) -> Pytree:
+        raise NotImplementedError
+
+
+def _stack_jnp(cohort):
+    return jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x, jnp.float32) for x in xs]),
+        *cohort)
+
+
+def _stack_np(cohort):
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x, np.float64) for x in xs]),
+        *cohort)
+
+
+class CoordinateMedian(_RobustBase):
+    name = "median"
+
+    def _combine(self, cohort):
+        return _median_tree(_stack_jnp(cohort))
+
+    def _combine_np(self, cohort):
+        return jax.tree.map(lambda s: np.median(s, axis=0),
+                            _stack_np(cohort))
+
+
+class TrimmedMean(_RobustBase):
+    """Coordinate-wise trimmed mean. At ``n >= 3`` at least ONE model is
+    always trimmed from each tail even when ``floor(n * trim_ratio) == 0``
+    — a robust rule that silently degrades to the plain mean at small
+    cohorts would leave a single poisoner unbounded (and the error
+    compounds round over round as learners retrain from the poisoned
+    community model)."""
+
+    name = "trimmed_mean"
+
+    def __init__(self, trim_ratio: float = 0.1):
+        if not 0.0 <= trim_ratio < 0.5:
+            raise ValueError("trim_ratio must be in [0, 0.5)")
+        self.trim_ratio = float(trim_ratio)
+
+    def _trim(self, n: int) -> int:
+        trim = int(np.floor(n * self.trim_ratio))
+        if n >= 3:
+            trim = max(1, trim)
+        if n - 2 * trim < 1:
+            trim = (n - 1) // 2
+        return trim
+
+    def _combine(self, cohort):
+        return _trimmed_mean_tree(_stack_jnp(cohort),
+                                  self._trim(len(cohort)))
+
+    def _combine_np(self, cohort):
+        trim = self._trim(len(cohort))
+
+        def leaf(s):
+            s = np.sort(s, axis=0)
+            kept = s[trim: s.shape[0] - trim] if trim else s
+            return kept.mean(axis=0)
+
+        return jax.tree.map(leaf, _stack_np(cohort))
+
+
+class Krum(_RobustBase):
+    """``multi=0``: classic Krum (adopt the single most central model).
+    ``multi=m``: MultiKrum — average the ``m`` best-scored models
+    (``m=0`` with ``name='multikrum'`` defaults to ``n − f``)."""
+
+    def __init__(self, byzantine_f: int = 0, multi: int = 0,
+                 name: str = "krum"):
+        self.byzantine_f = int(byzantine_f)
+        self.multi = int(multi)
+        self.name = name
+
+    def _effective_f(self, n: int) -> int:
+        f = self.byzantine_f if self.byzantine_f > 0 else max(0, (n - 3) // 2)
+        return min(f, max(0, n - 3))  # scores need n - f - 2 >= 1
+
+    def _select(self, cohort, scores: np.ndarray):
+        n = len(cohort)
+        f = self._effective_f(n)
+        if self.name == "multikrum" or self.multi > 0:
+            m = self.multi if self.multi > 0 else max(1, n - f)
+            return [cohort[int(i)] for i in np.argsort(scores)[:min(m, n)]]
+        return [cohort[int(np.argmin(scores))]]
+
+    def aggregate(self, models, state=None, learner_ids=None) -> Pytree:
+        cohort = [lineage[0] for lineage, _scale in models]
+        if not cohort:
+            raise ValueError(f"{self.name} called with no models")
+        template = cohort[0]
+        n = len(cohort)
+        wide = any(use_numpy_fold(m) for m in cohort)
+        acc = np.float64 if wide else np.float32
+        flat_np = np.stack([
+            np.concatenate([np.asarray(leaf, acc).ravel()
+                            for leaf in jax.tree.leaves(m)]) for m in cohort])
+        if wide:
+            # host scoring keeps f64 exact under x32 mode
+            d2 = (np.sum(flat_np**2, 1)[:, None]
+                  + np.sum(flat_np**2, 1)[None, :]
+                  - 2.0 * flat_np @ flat_np.T)
+            np.fill_diagonal(d2, np.inf)
+            k = max(1, n - self._effective_f(n) - 2)
+            scores = np.sort(d2, axis=1)[:, :k].sum(axis=1)
+        else:
+            scores = np.asarray(
+                _krum_scores(jnp.asarray(flat_np), self._effective_f(n)))
+        picked = self._select(cohort, scores)
+        if len(picked) == 1:
+            return jax.tree.map(np.asarray, picked[0])
+        mean = jax.tree.map(lambda s: s.mean(axis=0), _stack_np(picked))
+        return np_finalize(mean, 1.0, like=template)

@@ -33,7 +33,9 @@ class TerminationConfig:
 class AggregationConfig:
     rule: str = "fedavg"                     # fedavg | fedstride | fedrec |
                                              # secure_agg | fedavgm |
-                                             # fedadam | fedyogi
+                                             # fedadam | fedyogi | scaffold |
+                                             # median | trimmed_mean |
+                                             # krum | multikrum
     # server-optimizer hyperparameters (fedavgm / fedadam / fedyogi only)
     server_learning_rate: float = 1.0
     server_beta1: float = 0.9
@@ -49,6 +51,11 @@ class AggregationConfig:
     # meaningful under the asynchronous protocol (synchronous barriers
     # have staleness 0 everywhere).
     staleness_decay: float = 0.0
+    # byzantine-robust rules (aggregation/robust.py): tail fraction each
+    # side for trimmed_mean; assumed byzantine count for krum/multikrum
+    # (0 derives the max tolerable (n-3)//2 from the cohort)
+    trim_ratio: float = 0.1
+    byzantine_f: int = 0
 
 
 @dataclass

@@ -153,6 +153,12 @@ class Controller:
                 agg.rule, learning_rate=agg.server_learning_rate,
                 beta1=agg.server_beta1, beta2=agg.server_beta2,
                 tau=agg.server_tau)
+        elif agg.rule.lower() == "trimmed_mean":
+            self._aggregator = make_aggregation_rule(
+                agg.rule, trim_ratio=agg.trim_ratio)
+        elif agg.rule.lower() in ("krum", "multikrum"):
+            self._aggregator = make_aggregation_rule(
+                agg.rule, byzantine_f=agg.byzantine_f)
         else:
             self._aggregator = make_aggregation_rule(agg.rule)
         self._scaler = make_scaler(agg.scaler)
@@ -626,12 +632,11 @@ class Controller:
         meta_blocks: List[int] = []
         meta_durations: List[float] = []
         ids = [lid for lid in selected if lid in scales]
-        if self.config.secure.enabled:
-            # Secure: every party's payload must enter one combine call
-            # (masking sums must cancel across ALL parties), so blocks only
-            # bound store-select batching here.
-            pairs = []
-            present_ids = []
+        def collect_all_pairs():
+            """Whole-cohort collection (secure + robust rules): stride only
+            bounds store-select batching; every selected model enters ONE
+            combine call. Returns (pairs, present_ids)."""
+            pairs, present_ids = [], []
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 tb = time.time()
@@ -642,6 +647,11 @@ class Controller:
                         present_ids.append(lid)
                 meta_blocks.append(len(block))
                 meta_durations.append((time.time() - tb) * 1e3)
+            return pairs, present_ids
+
+        if self.config.secure.enabled:
+            # Secure: masking sums must cancel across ALL parties.
+            pairs, present_ids = collect_all_pairs()
             if not pairs:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
@@ -652,6 +662,14 @@ class Controller:
                     present_ids, parsed)
             community = self._aggregator.aggregate(parsed,
                                                    correction=correction)
+        elif getattr(self._aggregator, "requires_full_cohort", False):
+            # Robust rules (median / trimmed_mean / krum): a median cannot
+            # fold stride-wise.
+            pairs, _ = collect_all_pairs()
+            if not pairs:
+                logger.warning("no stored models for cohort %s", list(selected))
+                return
+            community = self._aggregator.aggregate(pairs)
         elif hasattr(self._aggregator, "accumulate"):
             # Fold rules (FedAvg and the ServerOpt family wrapping it):
             # accumulate block-by-block so only one stride block of models is

@@ -203,3 +203,25 @@ def test_run_and_record_reconciles_errors_and_preserves_values(monkeypatch):
     assert errors == {"agg": "re-run wedged"}
     assert details["x"] == 42          # completed value preserved
     assert details["partial_only"] == 7  # gap filled
+
+
+def test_post_loop_rerun_after_midloop_recovery(monkeypatch):
+    """A tunnel that recovered MID-loop (later sections on chip, headline
+    ones on CPU) still gets its headline re-runs — and a backend that
+    never changed (e.g. a CPU-only environment) re-runs nothing."""
+    import bench
+
+    ran = []
+    monkeypatch.setattr(
+        bench, "_run_and_record",
+        lambda name, quick, details, errors, info, **k: ran.append(name))
+
+    details = {"agg_backend": "cpu", "mfu_backend": "tpu"}
+    info = {"degraded_to_cpu": False, "recovered_mid_run": True}
+    bench._post_loop_recovery(details, {}, info, quick=True)
+    assert ran == ["agg"]  # only the degraded headline section re-runs
+
+    ran.clear()
+    bench._post_loop_recovery({"agg_backend": "cpu", "mfu_backend": "cpu"},
+                              {}, {"degraded_to_cpu": False}, quick=True)
+    assert ran == []  # backend never changed: nothing to re-run

@@ -1,0 +1,141 @@
+"""Byzantine-robust aggregation (aggregation/robust.py): median,
+trimmed mean, (Multi-)Krum — influence of poisoned learners bounded."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.aggregation import make_aggregation_rule
+from metisfl_tpu.aggregation.robust import CoordinateMedian, Krum, TrimmedMean
+
+
+def _model(value, n=32, seed=None):
+    rng = np.random.default_rng(seed if seed is not None else 0)
+    base = rng.standard_normal(n).astype(np.float32) * 0.01
+    return {"w": base + np.float32(value),
+            "step": np.asarray(7, np.int32)}
+
+
+def _pairs(models):
+    return [([m], 1.0 / len(models)) for m in models]
+
+
+def test_median_ignores_a_poisoned_model():
+    honest = [_model(1.0, seed=i) for i in range(4)]
+    poison = _model(1e6, seed=9)
+    out = CoordinateMedian().aggregate(_pairs(honest + [poison]))
+    assert np.all(np.abs(out["w"] - 1.0) < 0.1)
+    assert out["w"].dtype == np.float32
+    assert out["step"] == 7 and out["step"].dtype == np.int32
+
+
+def test_trimmed_mean_drops_tails():
+    honest = [_model(v, seed=i) for i, v in enumerate((0.9, 1.0, 1.1))]
+    low, high = _model(-1e5, seed=7), _model(1e5, seed=8)
+    rule = TrimmedMean(trim_ratio=0.2)  # 5 models -> trim 1 each side
+    out = rule.aggregate(_pairs(honest + [low, high]))
+    assert np.all(np.abs(out["w"] - 1.0) < 0.2)
+
+    with pytest.raises(ValueError, match="trim_ratio"):
+        TrimmedMean(trim_ratio=0.5)
+
+
+def test_trimmed_mean_small_cohort_degrades_to_median_like():
+    # n=2, ratio 0.4 -> trim would erase everything; it clamps instead
+    out = TrimmedMean(trim_ratio=0.4).aggregate(
+        _pairs([_model(0.0), _model(2.0)]))
+    assert np.isfinite(out["w"]).all()
+
+
+def test_krum_selects_an_honest_model():
+    honest = [_model(1.0, seed=i) for i in range(5)]
+    poison = _model(50.0, seed=11)
+    out = Krum(byzantine_f=1).aggregate(_pairs(honest + [poison]))
+    # winner is one of the honest models verbatim
+    assert np.all(np.abs(out["w"] - 1.0) < 0.1)
+
+
+def test_multikrum_averages_best_subset():
+    honest = [_model(1.0, seed=i) for i in range(5)]
+    poisons = [_model(80.0, seed=21), _model(-80.0, seed=22)]
+    rule = make_aggregation_rule("multikrum", byzantine_f=2)
+    out = rule.aggregate(_pairs(honest + poisons))
+    assert np.all(np.abs(out["w"] - 1.0) < 0.1)
+
+
+def test_registry_and_scales_are_ignored():
+    """Robust rules must not honor claimed weights — a byzantine learner
+    would just claim a huge scale."""
+    rule = make_aggregation_rule("median")
+    models = [_model(0.0, seed=1), _model(1.0, seed=2), _model(2.0, seed=3)]
+    pairs = [([models[0]], 0.98), ([models[1]], 0.01), ([models[2]], 0.01)]
+    out = rule.aggregate(pairs)
+    np.testing.assert_allclose(out["w"], models[1]["w"], atol=0.1)
+
+
+def test_median_federation_completes_rounds():
+    """End to end through the controller's full-cohort branch: a median
+    federation with one poisoned learner still completes rounds and the
+    community model stays at honest scale."""
+    import jax
+
+    from tests.test_federation_inprocess import _make_federation
+
+    fed, _ = _make_federation(rule="median", local_steps=4, num_learners=3,
+                              stride=2)  # stride < cohort: batching only
+    poisoned = fed.learners[2]
+    orig_dump = poisoned._dump_model
+
+    def poison_dump(*args, **kwargs):
+        # scale every shipped tensor: a classic model-poisoning attempt
+        blob = orig_dump(*args, **kwargs)
+        from metisfl_tpu.tensor.pytree import ModelBlob
+        parsed = ModelBlob.from_bytes(blob)
+        parsed.tensors = [(n, np.asarray(a) * 100.0)
+                          for n, a in parsed.tensors]
+        return parsed.to_bytes()
+
+    poisoned._dump_model = poison_dump
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        stats = fed.statistics()
+        assert stats["global_iteration"] >= 2
+        # community weights stayed at honest magnitude despite the 100x
+        # poisoned contributions
+        from metisfl_tpu.tensor.pytree import ModelBlob
+        blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
+        max_abs = max(float(np.abs(a).max()) for _, a in blob.tensors)
+        assert max_abs < 50.0, f"poison leaked into the community: {max_abs}"
+    finally:
+        fed.shutdown()
+
+
+def test_trimmed_mean_always_trims_at_small_cohorts():
+    """floor(n*ratio)==0 must still trim one per side at n>=3 — otherwise
+    the 'robust' rule is a plain mean and a single poisoner is unbounded."""
+    honest = [_model(1.0, seed=i) for i in range(3)]
+    poison = _model(-500.0, seed=5)
+    out = TrimmedMean(trim_ratio=0.1).aggregate(_pairs(honest + [poison]))
+    assert np.all(np.abs(out["w"] - 1.0) < 0.2)
+
+
+def test_robust_rules_preserve_float64_exactly(monkeypatch):
+    """64-bit trees under x32 mode must reduce on host (base.use_numpy_fold
+    contract): a value that f32 cannot represent survives every rule. The
+    host path is forced so the test covers it regardless of the process
+    x64 flag (conftest enables x64; production controllers do not)."""
+    from metisfl_tpu.aggregation import robust as robust_mod
+
+    monkeypatch.setattr(robust_mod, "use_numpy_fold", lambda tree: True)
+    exact = np.float64(16_777_217.0)  # 2**24 + 1: not representable in f32
+    models = [{"w": np.full((4,), exact + i, np.float64),
+               "c": np.asarray(2**53 - 1, np.int64)} for i in range(3)]
+    for rule in (CoordinateMedian(), TrimmedMean(0.0),
+                 Krum(byzantine_f=0), make_aggregation_rule("multikrum")):
+        out = rule.aggregate(_pairs(models))
+        assert out["w"].dtype == np.float64
+        assert out["c"].dtype == np.int64
+        # median/krum land on the middle model; trimmed/multikrum on means
+        # — all are exactly representable in f64 and NOT in f32
+        assert float(out["w"][0]) >= exact, (rule.name, out["w"][0])
+        assert int(out["c"]) == 2**53 - 1, rule.name
