@@ -37,7 +37,8 @@ key from the controller, driver_session.py:129-140).
 from __future__ import annotations
 
 import hashlib
-from typing import Sequence
+from collections import OrderedDict
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -58,15 +59,30 @@ class MaskingBackend:
         self.min_parties = max(2, int(min_parties))
         self._round_id = 0
         self._tensor_counter = 0
-        # round_id -> (surviving, dropped) already served: a correction for
-        # a DIFFERENT split of the same round would let a curious controller
-        # intersect partial sums down to individual payloads
-        self._recovery_served: dict = {}
+        # rounds this party actually trained for (begin_round), newest
+        # last, bounded by TRAINING progression — the recovery allowlist.
+        # Recovery requests for any other round id are refused, so the
+        # controller cannot flood dummy ids to evict served-split records.
+        self._rounds_seen: "OrderedDict[int, Optional[tuple]]" = OrderedDict()
+        # per-round ciphertext cache: ONE ciphertext per (round, tensor)
+        # ever leaves this party. A re-dispatched round re-ships the
+        # first attempt's payload verbatim — encrypting fresh values under
+        # the same (deterministic per-round) mask stream would hand the
+        # controller a two-time pad (difference of the two payloads).
+        self._sent: dict = {}
 
     # -- round context (learner calls this per task) ----------------------
     def begin_round(self, round_id: int) -> None:
         self._round_id = int(round_id)
         self._tensor_counter = 0
+        if self.secret:
+            if self._round_id not in self._rounds_seen:
+                self._rounds_seen[self._round_id] = None
+            while len(self._rounds_seen) > 64:
+                old, _ = self._rounds_seen.popitem(last=False)
+                # drop the stale round's ciphertext cache with it
+                self._sent = {k: v for k, v in self._sent.items()
+                              if k[0] != old}
 
     def _pair_stream(self, i: int, j: int, tensor_idx: int, n: int,
                      round_id: int = None) -> np.ndarray:
@@ -94,6 +110,17 @@ class MaskingBackend:
         return 2.0 ** (62 - _FP_BITS) / max(1, self.num_parties)
 
     def encrypt(self, values: np.ndarray) -> bytes:
+        # one-time-pad discipline: the mask stream is deterministic per
+        # (round, tensor), so only ONE ciphertext per (round, tensor) may
+        # ever leave this party — a re-dispatched round (same round id,
+        # possibly retrained values) re-ships the first attempt verbatim
+        # instead of leaking the difference of two payloads
+        idx = self._tensor_counter
+        self._tensor_counter += 1
+        key = (self._round_id, idx)
+        cached = self._sent.get(key)
+        if cached is not None:
+            return cached
         values = np.asarray(values, np.float64).ravel()
         bound = self._max_abs_value()
         if values.size and np.abs(values).max() > bound:
@@ -101,9 +128,10 @@ class MaskingBackend:
                 f"masking fixed-point encoding supports |v| <= {bound:g} "
                 f"for {self.num_parties} parties")
         fixed = np.round(values * _FP_SCALE).astype(np.int64).view(np.uint64)
-        idx = self._tensor_counter
-        self._tensor_counter += 1
-        return (fixed + self._mask(len(values), idx)).tobytes()
+        payload = (fixed + self._mask(len(values), idx)).tobytes()
+        if self.secret:
+            self._sent[key] = payload
+        return payload
 
     def decrypt(self, payload: bytes, num_values: int) -> np.ndarray:
         # aggregated payloads (weighted_sum output) are plain float64 — the
@@ -137,17 +165,23 @@ class MaskingBackend:
                 f"refusing recovery for {len(set(surviving))} survivors "
                 f"(< threshold {self.min_parties}: the unmasked sum would "
                 "approach a single party's plaintext)")
-        # (b) one split per round: corrections for two different survivor
+        # (b) only rounds this party actually trained for are recoverable —
+        # the served-split record below lives as long as the round itself,
+        # so the controller cannot flood dummy round ids to evict it;
+        rid = int(round_id)
+        if rid not in self._rounds_seen:
+            raise ValueError(
+                f"refusing recovery for round {rid}: this party has no "
+                "record of training for it")
+        # (c) one split per round: corrections for two different survivor
         # sets of the same round intersect to individual payloads.
         key = (frozenset(surviving), frozenset(dropped))
-        prev = self._recovery_served.get(int(round_id))
+        prev = self._rounds_seen[rid]
         if prev is not None and prev != key:
             raise ValueError(
                 f"already served a different recovery split for round "
-                f"{round_id}; refusing (partial-sum intersection attack)")
-        self._recovery_served[int(round_id)] = key
-        while len(self._recovery_served) > 64:
-            self._recovery_served.pop(next(iter(self._recovery_served)))
+                f"{rid}; refusing (partial-sum intersection attack)")
+        self._rounds_seen[rid] = key
         corrections = []
         for tensor_idx, n in enumerate(lengths):
             acc = np.zeros(int(n), np.uint64)

@@ -110,13 +110,49 @@ class TestMaskingBackend:
         """One split per round: corrections for two different survivor sets
         of the same round would intersect to individual payloads."""
         backends = self._backends(4)
+        backends[1].begin_round(5)
         backends[1].recovery_correction(5, [0, 1], [2, 3], [4])
         # identical request (controller retry) is idempotent
         backends[1].recovery_correction(5, [0, 1], [2, 3], [4])
         with pytest.raises(ValueError, match="different recovery split"):
             backends[1].recovery_correction(5, [0, 2], [1, 3], [4])
         # a NEW round gets a fresh split
+        backends[1].begin_round(6)
         backends[1].recovery_correction(6, [0, 2], [1, 3], [4])
+
+    def test_recovery_refuses_unknown_rounds_and_eviction_flooding(self):
+        """Round-id allowlist: recovery only for rounds this party trained
+        for, and the served-split record cannot be evicted by dummy round
+        ids (it lives as long as the round's own training record)."""
+        backends = self._backends(4)
+        with pytest.raises(ValueError, match="no record of training"):
+            backends[1].recovery_correction(99, [0, 1], [2, 3], [4])
+        backends[1].begin_round(5)
+        backends[1].recovery_correction(5, [0, 1], [2, 3], [4])
+        # the adversary cannot begin_round (training tasks drive it); even
+        # many recovery attempts with other ids are refused, and the
+        # round-5 split record survives them
+        for rid in range(200, 280):
+            with pytest.raises(ValueError, match="no record"):
+                backends[1].recovery_correction(rid, [0, 1], [2, 3], [4])
+        with pytest.raises(ValueError, match="different recovery split"):
+            backends[1].recovery_correction(5, [0, 2], [1, 3], [4])
+
+    def test_reencryption_same_round_is_idempotent(self):
+        """One-time-pad discipline: a re-dispatched round re-ships the
+        FIRST attempt's ciphertext even if local values changed — two
+        ciphertexts under the same mask stream would leak their
+        difference."""
+        backend = MaskingBackend(federation_secret="s", party_index=0,
+                                 num_parties=2)
+        backend.begin_round(3)
+        first = backend.encrypt(np.ones(16))
+        backend.begin_round(3)  # retry of the same round
+        again = backend.encrypt(np.full(16, 42.0))  # retrained values
+        assert again == first
+        backend.begin_round(4)  # a real new round gets fresh payloads
+        fresh = backend.encrypt(np.ones(16))
+        assert fresh != first
 
     def test_recovery_requires_secret(self):
         keyless = MaskingBackend(num_parties=3)  # controller role
