@@ -117,11 +117,14 @@ class TrainParams(Message):
     # behind a network tunnel). Cancellation is checked between chunks.
     scan_chunk: int = 1
     # Wire dtype for shipped model weights (a DType name: "bf16", "f16",
-    # "f32", ...). "" ships the training dtype unchanged. Casting to bf16
-    # halves federation bandwidth; aggregation still accumulates in f32 and
-    # each learner restores its own training dtypes on receipt, so only the
-    # wire representation is narrowed. Ignored under secure aggregation
-    # (HE/masking payloads have their own fixed-point encoding).
+    # "f32", ..., or "int8q" for int8 absmax quantization with per-tensor
+    # scales — tensor/quantize.py). "" ships the training dtype unchanged.
+    # bf16 halves federation bandwidth; int8q quarters it (the controller
+    # dequantizes before aggregating). Aggregation still accumulates in
+    # f32 and each learner restores its own training dtypes on receipt, so
+    # only the wire representation is narrowed. Ignored under secure
+    # aggregation (HE/masking payloads have their own fixed-point
+    # encoding; int8q+secure is rejected at config time).
     ship_dtype: str = ""
     # Client-level differential privacy on the shipped update
     # (secure/dp.py): the delta vs the received community model is

@@ -232,8 +232,18 @@ class FederationConfig:
         if self.train.ship_dtype:
             # a typo here would otherwise fail only after round 1's full
             # local training, on every learner, every round
+            from metisfl_tpu.tensor.quantize import SHIP_INT8Q
             from metisfl_tpu.tensor.spec import resolve_ship_dtype
-            resolve_ship_dtype(self.train.ship_dtype)
+
+            if self.train.ship_dtype.lower() != SHIP_INT8Q:
+                resolve_ship_dtype(self.train.ship_dtype)
+            if (self.train.ship_dtype.lower() == SHIP_INT8Q
+                    and self.secure.enabled):
+                # secure payloads carry their own fixed-point encoding
+                raise ValueError(
+                    "ship_dtype='int8q' is incompatible with secure "
+                    "aggregation (HE/masking payloads have their own "
+                    "fixed-point encoding)")
 
     # -- wire/launch serialization ----------------------------------------
     def to_wire(self) -> bytes:

@@ -503,7 +503,16 @@ class Controller:
         blob = ModelBlob.from_bytes(result.model)
         if self.config.secure.enabled:
             return result.model if blob.opaque else dict(blob.tensors)
-        return dict(blob.tensors)
+        tensors = dict(blob.tensors)
+        if self.config.train.ship_dtype.lower() == "int8q":
+            # int8q uplink: restore exact f32 before storage/aggregation.
+            # Gated on the CONFIG (not payload sniffing) so a model that
+            # legitimately owns a '#qscale'-suffixed tensor cannot be
+            # silently mangled when quantization is off.
+            from metisfl_tpu.tensor.quantize import dequantize_named
+
+            tensors = dequantize_named(tensors)
+        return tensors
 
     def _complete_round(self, cohort: Sequence[str]) -> None:
         """One ScheduleTasks pass (controller.cc:428-518): select, aggregate,

@@ -170,13 +170,21 @@ class Learner:
                 opaque[name] = (payload, spec)
             return ModelBlob(opaque=opaque).to_bytes()
         if ship_dtype:
-            target = resolve_ship_dtype(ship_dtype)
-            # floats only: casting integer/bool state (step counters,
-            # quantized weights) through a float mantissa would corrupt it
-            named = [(n, np.asarray(a, target)
-                      if np.issubdtype(np.asarray(a).dtype, np.floating)
-                      and np.asarray(a).dtype != target else a)
-                     for n, a in named]
+            from metisfl_tpu.tensor.quantize import SHIP_INT8Q, quantize_named
+
+            if ship_dtype.lower() == SHIP_INT8Q:
+                # int8 absmax quantization: 4x less uplink than f32; the
+                # controller dequantizes before aggregating
+                named = quantize_named(named)
+            else:
+                target = resolve_ship_dtype(ship_dtype)
+                # floats only: casting integer/bool state (step counters,
+                # quantized weights) through a float mantissa would
+                # corrupt it
+                named = [(n, np.asarray(a, target)
+                          if np.issubdtype(np.asarray(a).dtype, np.floating)
+                          and np.asarray(a).dtype != target else a)
+                         for n, a in named]
         return ModelBlob(tensors=named).to_bytes()
 
     # ------------------------------------------------------------------ #
@@ -198,8 +206,11 @@ class Learner:
         try:
             params = task.params
             if params.ship_dtype:
+                from metisfl_tpu.tensor.quantize import SHIP_INT8Q
+
                 # fail a bad dtype name BEFORE paying for local training
-                resolve_ship_dtype(params.ship_dtype)
+                if params.ship_dtype.lower() != SHIP_INT8Q:
+                    resolve_ship_dtype(params.ship_dtype)
             if params.profile_dir:
                 # per-learner trace subdir: same-host learners start traces
                 # within the same second and jax.profiler session dirs are

@@ -73,16 +73,22 @@ class MaskingBackend:
 
     # -- round context (learner calls this per task) ----------------------
     def begin_round(self, round_id: int) -> None:
-        self._round_id = int(round_id)
+        rid = int(round_id)
+        if self.secret and rid != self._round_id:
+            # only the CURRENT round can legitimately re-dispatch (masking
+            # is sync/semi-sync only; the round counter never rewinds), so
+            # previous rounds' ciphertext caches are dead weight — at
+            # 110M-param scale each is ~0.9 GB, so this purge is what
+            # bounds learner memory to one round's payloads
+            self._sent = {k: v for k, v in self._sent.items()
+                          if k[0] == rid}
+        self._round_id = rid
         self._tensor_counter = 0
         if self.secret:
             if self._round_id not in self._rounds_seen:
                 self._rounds_seen[self._round_id] = None
             while len(self._rounds_seen) > 64:
-                old, _ = self._rounds_seen.popitem(last=False)
-                # drop the stale round's ciphertext cache with it
-                self._sent = {k: v for k, v in self._sent.items()
-                              if k[0] != old}
+                self._rounds_seen.popitem(last=False)
 
     def _pair_stream(self, i: int, j: int, tensor_idx: int, n: int,
                      round_id: int = None) -> np.ndarray:
@@ -114,7 +120,9 @@ class MaskingBackend:
         # (round, tensor), so only ONE ciphertext per (round, tensor) may
         # ever leave this party — a re-dispatched round (same round id,
         # possibly retrained values) re-ships the first attempt verbatim
-        # instead of leaking the difference of two payloads
+        # instead of leaking the difference of two payloads. (The retry's
+        # local training is then wasted compute — an accepted cost on a
+        # rare failure path; see docs/SECURITY.md for the restart caveat.)
         idx = self._tensor_counter
         self._tensor_counter += 1
         key = (self._round_id, idx)

@@ -218,10 +218,12 @@ def quantify(array: np.ndarray) -> dict:
 
 def resolve_ship_dtype(name: str) -> np.dtype:
     """A DType name ("bf16", "f16", ...) → numpy dtype, with a clear
-    error listing the valid names (used by TrainParams.ship_dtype)."""
+    error listing the valid names (used by TrainParams.ship_dtype; the
+    quantized "int8q" mode is handled by callers before this resolver,
+    but belongs in the guidance a typo gets back)."""
     try:
         return np_dtype_of(DType[name.upper()])
     except KeyError:
         raise ValueError(
             f"unknown ship_dtype {name!r}; valid names: "
-            f"{[d.name.lower() for d in DType]}") from None
+            f"{[d.name.lower() for d in DType] + ['int8q']}") from None

@@ -1,0 +1,118 @@
+"""int8 absmax uplink quantization (tensor/quantize.py +
+TrainParams.ship_dtype='int8q')."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.tensor.quantize import (
+    QSCALE_SUFFIX,
+    dequantize_named,
+    is_quantized,
+    quantize_named,
+)
+
+
+def test_roundtrip_error_bounded_by_half_step():
+    rng = np.random.default_rng(0)
+    arr = (rng.standard_normal(512) * 3.0).astype(np.float32)
+    named = quantize_named([("w", arr)])
+    assert [n for n, _ in named] == ["w", "w" + QSCALE_SUFFIX]
+    q = dict(named)
+    assert q["w"].dtype == np.int8
+    back = dequantize_named(q)["w"]
+    step = float(np.abs(arr).max()) / 127.0
+    assert np.abs(back - arr).max() <= step / 2 + 1e-7
+    assert back.dtype == np.float32
+
+
+def test_integers_and_zeros_pass_through():
+    named = quantize_named([
+        ("step", np.asarray(7, np.int32)),
+        ("zeros", np.zeros(8, np.float32)),
+    ])
+    d = dict(named)
+    assert d["step"].dtype == np.int32 and "step" + QSCALE_SUFFIX not in d
+    back = dequantize_named(d)
+    np.testing.assert_array_equal(back["zeros"], 0.0)
+    assert back["step"] == 7
+
+
+def test_unquantized_dicts_are_untouched():
+    d = {"w": np.ones(4, np.float32)}
+    assert not is_quantized(d)
+    assert dequantize_named(d) is d
+
+
+def test_name_collision_rejected():
+    with pytest.raises(ValueError, match="collides"):
+        quantize_named([("w" + QSCALE_SUFFIX, np.ones(2, np.float32))])
+
+
+def test_bandwidth_is_quartered():
+    arr = np.random.default_rng(1).standard_normal(4096).astype(np.float32)
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    plain = ModelBlob(tensors=[("w", arr)]).to_bytes()
+    packed = ModelBlob(tensors=quantize_named([("w", arr)])).to_bytes()
+    assert len(packed) < len(plain) / 3.5  # int8 + tiny scale + headers
+
+
+def test_int8q_federation_learns():
+    """End to end: the quantized uplink still converges (the controller
+    dequantizes before aggregation, so the community model is f32)."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.tensor.pytree import ModelBlob
+    from tests.test_federation_inprocess import _shards
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.1,
+                          ship_dtype="int8q"),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=3),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(3)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=120)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        # the community model aggregated from dequantized f32
+        blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
+        assert {np.asarray(a).dtype for _, a in blob.tensors} == {
+            np.dtype(np.float32)}
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.6, f"int8q federation failed to learn: {last}"
+    finally:
+        fed.shutdown()
+
+
+def test_int8q_rejected_with_secure():
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, FederationConfig,
+                                    SecureAggConfig)
+
+    with pytest.raises(ValueError, match="int8q"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="participants"),
+            secure=SecureAggConfig(enabled=True, scheme="ckks"),
+            train=TrainParams(ship_dtype="int8q"))

@@ -296,3 +296,15 @@ def test_masking_value_bound_scales_with_parties():
     small.encrypt(np.full(4, 1000.0))  # fine for 2 parties
     with pytest.raises(ValueError, match="supports"):
         big.encrypt(np.full(4, 1000.0))  # would overflow a 65536-party sum
+
+
+def test_ciphertext_cache_bounded_to_current_round():
+    """The one-ciphertext-per-round cache must not accumulate across
+    rounds (at 110M-param scale each round's payloads are ~0.9 GB)."""
+    backend = MaskingBackend(federation_secret="s", party_index=0,
+                             num_parties=2)
+    for r in range(5):
+        backend.begin_round(r)
+        backend.encrypt(np.ones(16))
+        backend.encrypt(np.zeros(8))
+    assert set(k[0] for k in backend._sent) == {4}
