@@ -295,14 +295,64 @@ def _lm_step_flops(B, L, dim, depth, vocab) -> int:
     return 3 * fwd
 
 
+# MFU sweep variants. Scan variants fuse 8 optimizer steps into one
+# lax.scan program (TrainParams.scan_chunk): per-step dispatch over the
+# tunnel costs more than some of these steps, so unscanned timings
+# under-report the chip. ORDER MATTERS for the per-variant child runs: the
+# cheapest-to-compile variant goes first so a tunnel that wedges minutes in
+# still banks one on-chip MFU number, and the strongest MFU candidate
+# (largest batch, scan-fused) goes second.
+_MFU_VARIANTS = [
+    ("b8_dense", dict(B=8, flash=False, remat=False)),
+    ("b32_dense_remat_scan8", dict(B=32, flash=False, remat=True, scan=8)),
+    ("b8_dense_scan8", dict(B=8, flash=False, remat=False, scan=8)),
+    ("b8_flash_scan8", dict(B=8, flash=True, remat=False, scan=8)),
+    ("b16_flash_remat_scan8", dict(B=16, flash=True, remat=True, scan=8)),
+    # seq-length-routed attention (ops/flash_attention.attention):
+    # dense below FLASH_MIN_SEQ, the pallas kernel above — the default
+    # a user should pick
+    ("b16_auto_remat_scan8", dict(B=16, flash="auto", remat=True, scan=8)),
+]
+
+
+def _mfu_finalize(out: dict, L=1024, dim=1024, depth=8, vocab=32768) -> None:
+    """Compute the best-variant rollup (lm_best_*, mfu) from per-variant
+    fields already in ``out``. Separated from bench_mfu so the parent can
+    recompute it after merging per-variant child results."""
+    peak = _chip_peak_flops(out.get("device_kind", ""))
+    best = None
+    for label, v in _MFU_VARIANTS:
+        ms = out.get(f"lm_{label}_ms_per_step")
+        if not ms:
+            continue
+        flops = _lm_step_flops(v["B"], L, dim, depth, vocab)
+        tps = out.get(f"lm_{label}_tokens_per_sec", 0)
+        if best is None or tps > best[1]:
+            best = (label, tps, flops, ms)
+    if best is None:
+        return
+    label, tps, flops, ms = best
+    out.update({
+        "lm_best_variant": label,
+        "lm_ms_per_step": round(ms, 2),
+        "lm_tokens_per_sec": round(tps),
+        "lm_flops_per_step": flops,
+        "lm_achieved_tflops": round(flops / (ms / 1e3) / 1e12, 1),
+    })
+    if peak:
+        out["mfu"] = round((flops / (ms / 1e3)) / peak, 4)
+
+
 def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
-              require_tpu=True, on_update=None):
+              require_tpu=True, on_update=None, only=None):
     """Causal-LM MFU on an MXU-sized LlamaLite (dim 1024 / depth 8 /
     seq 1024, bf16): a small config sweep (dense/flash attention, batch,
     remat) — each variant individually guarded — reporting every variant's
     step time and the best variant's MFU. This is the perf axis the first
     two rounds never measured (VERDICT r2 #1). The size parameters exist so
-    CI can smoke the sweep plumbing at toy shapes off-TPU."""
+    CI can smoke the sweep plumbing at toy shapes off-TPU. ``only`` runs a
+    single named variant (the parent runs each variant in its own killable
+    child so a mid-sweep tunnel wedge costs one variant, not the section)."""
     import jax
     import jax.numpy as jnp
 
@@ -317,27 +367,12 @@ def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
     peak = _chip_peak_flops(kind)
     rng = np.random.default_rng(4)
 
-    # scan variants fuse 8 optimizer steps into one lax.scan program
-    # (TrainParams.scan_chunk): per-step dispatch over the tunnel costs more
-    # than some of these steps, so unscanned timings under-report the chip.
-    variants = [
-        ("b8_dense", dict(B=8, flash=False, remat=False)),
-        ("b8_dense_scan8", dict(B=8, flash=False, remat=False, scan=8)),
-        ("b8_flash_scan8", dict(B=8, flash=True, remat=False, scan=8)),
-        ("b16_flash_remat_scan8", dict(B=16, flash=True, remat=True, scan=8)),
-        # seq-length-routed attention (ops/flash_attention.attention):
-        # dense below FLASH_MIN_SEQ, the pallas kernel above — the default
-        # a user should pick
-        ("b16_auto_remat_scan8", dict(B=16, flash="auto", remat=True,
-                                      scan=8)),
-        ("b32_dense_remat_scan8", dict(B=32, flash=False, remat=True,
-                                       scan=8)),
-    ]
+    variants = [(lbl, v) for lbl, v in _MFU_VARIANTS
+                if only is None or lbl == only]
     out = {"device_kind": kind,
            "lm_config": f"dim{dim}/depth{depth}/heads{heads}/seq{L}/bf16"}
     if peak:
         out["chip_peak_bf16_tflops"] = round(peak / 1e12)
-    best = None
     for label, v in variants:
         try:
             B = v["B"]
@@ -366,23 +401,12 @@ def bench_mfu(L=1024, dim=1024, depth=8, heads=16, vocab=32768,
             if peak:
                 out[f"lm_{label}_mfu"] = round(
                     (flops / (res.ms_per_step / 1e3)) / peak, 4)
-            if best is None or tps > best[1]:
-                best = (label, tps, flops, res.ms_per_step, tokens)
         except Exception:
             out[f"lm_{label}_error"] = traceback.format_exc(limit=2)[-200:]
         if on_update is not None:
             on_update(out)
-    if best is not None:
-        label, tps, flops, ms, tokens = best
-        out.update({
-            "lm_best_variant": label,
-            "lm_ms_per_step": round(ms, 2),
-            "lm_tokens_per_sec": round(tps),
-            "lm_flops_per_step": flops,
-            "lm_achieved_tflops": round(flops / (ms / 1e3) / 1e12, 1),
-        })
-        if peak:
-            out["mfu"] = round((flops / (ms / 1e3)) / peak, 4)
+    if only is None:
+        _mfu_finalize(out, L=L, dim=dim, depth=depth, vocab=vocab)
     return out
 
 
@@ -677,7 +701,8 @@ _SECTIONS = {
 }
 
 
-def _run_section_child(name: str, out_path: str, quick: bool) -> int:
+def _run_section_child(name: str, out_path: str, quick: bool,
+                       variant: str = None) -> int:
     """Child mode: run ONE section, streaming partial results to
     ``out_path`` (write + atomic rename) so a kill mid-section still leaves
     everything measured so far for the parent."""
@@ -691,6 +716,8 @@ def _run_section_child(name: str, out_path: str, quick: bool) -> int:
         num_learners = 8 if quick else NUM_LEARNERS
         rounds = 2 if quick else ROUNDS
         out = bench_aggregation(num_learners, rounds, STRIDE)
+    elif name == "mfu" and variant:
+        out = bench_mfu(on_update=dump, only=variant)
     else:
         out = _SECTIONS[name](dump)
     try:
@@ -731,16 +758,20 @@ def _kill_active_child() -> None:
 
 
 def _run_section(name: str, quick: bool, timeout: int, errors: dict,
-                 info: dict = None) -> dict:
+                 info: dict = None, variant: str = None,
+                 err_key: str = None) -> dict:
     """Run a section in a subprocess; on timeout the child is SIGKILLed and
     whatever partials it streamed out are kept."""
     import tempfile
 
+    err_key = err_key or name
     fd, out_path = tempfile.mkstemp(suffix=f".bench.{name}.json")
     os.close(fd)
     os.unlink(out_path)
     argv = [sys.executable, os.path.abspath(__file__),
             "--section", name, "--out", out_path]
+    if variant:
+        argv += ["--variant", variant]
     if quick:
         argv.append("--quick")
     try:
@@ -750,22 +781,24 @@ def _run_section(name: str, quick: bool, timeout: int, errors: dict,
         try:
             _, stderr = proc.communicate(timeout=timeout)
             if proc.returncode != 0:
-                errors[name] = (stderr or "")[-400:] or f"rc={proc.returncode}"
+                errors[err_key] = \
+                    (stderr or "")[-400:] or f"rc={proc.returncode}"
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=10)
-            errors[name] = f"section timed out after {timeout}s (killed)"
+            errors[err_key] = f"section timed out after {timeout}s (killed)"
             # a wedged tunnel makes every later accelerator section eat its
             # full timeout too — re-probe, and degrade the REST to CPU if
             # dead (the section loop keeps re-probing for recovery)
             if not _probe_backend_alive():
                 os.environ["JAX_PLATFORMS"] = "cpu"
-                errors[name + "_tunnel"] = "backend unreachable; rest on cpu"
+                errors[err_key + "_tunnel"] = \
+                    "backend unreachable; rest on cpu"
                 if info is not None:
                     info["degraded_to_cpu"] = True
                     info["last_dead_ts"] = time.time()
     except Exception:
-        errors[name] = traceback.format_exc(limit=2)[-400:]
+        errors[err_key] = traceback.format_exc(limit=2)[-400:]
     finally:
         _ACTIVE_CHILD["proc"] = None
     try:
@@ -843,7 +876,12 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 # practice a wedge burns at most ONE cap before the re-probe degrades the
 # remaining sections to CPU.
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
-                     "mfu": 900, "flash": 900, "decode": 600}
+                     "mfu": 1500, "flash": 900, "decode": 600}
+# the MFU sweep runs one child per variant (see _run_mfu_variants); a
+# single variant — one 201M-param compile + a handful of steps — gets this
+# much before it is declared wedged. A wedge therefore burns ~420s + one
+# 90s probe instead of the whole 1500s section budget.
+_MFU_VARIANT_TIMEOUT = 420
 # opportunistic mid-run recovery probes (try_recover_backend): count × timeout
 _MAX_RECOVER_PROBES = 4
 _RECOVER_PROBE_SECS = 75
@@ -867,6 +905,10 @@ _POST_LOOP_SECTIONS = ("agg", "mfu")
 # the partials.)
 WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
                       + 90 * len(_SECTION_TIMEOUTS)
+                      # the MFU sweep runs per-variant children, each of
+                      # which can eat a 90s post-timeout probe (the section
+                      # sum above already budgets one)
+                      + 90 * (len(_MFU_VARIANTS) - 1) * 2
                       + _MAX_RECOVER_PROBES * _RECOVER_PROBE_SECS
                       + _POST_LOOP_RECOVERY_SECS + _RECOVER_PROBE_SECS
                       + sum(_SECTION_TIMEOUTS[s] + 90
@@ -905,8 +947,14 @@ def _run_and_record(name: str, quick: bool, details: dict, errors: dict,
     with ``keep_existing_on_error`` a failing pass only fills gaps instead
     of overwriting completed values (a re-run that wedges must not clobber
     the finished CPU pass with a killed child's partials)."""
-    errors.pop(name, None)
-    errors.pop(name + "_tunnel", None)
+    for key in [k for k in errors
+                if k == name or k.startswith(name + "_")
+                or k.startswith(name + ".")]:
+        errors.pop(key, None)
+    if name == "mfu" and not quick:
+        _run_mfu_variants(quick, details, errors, info,
+                          keep_existing_on_error)
+        return
     out = _run_section(name, quick, _SECTION_TIMEOUTS[name], errors, info)
     if keep_existing_on_error and name in errors:
         for key, value in out.items():
@@ -918,6 +966,81 @@ def _run_and_record(name: str, quick: bool, details: dict, errors: dict,
             details[f"{name}_backend"] = out["backend"]
         details.update(out)
     _persist_partials(details, errors)
+
+
+def _run_mfu_variants(quick: bool, details: dict, errors: dict, info: dict,
+                      keep_existing_on_error: bool = False) -> None:
+    """The MFU sweep, one killable child per variant (round-4 observation:
+    the tunnel wedged on the sweep's FIRST big compile, the single
+    900s-capped child died with nothing, and the whole section was lost —
+    per-variant children bound a wedge to one variant and bank every
+    variant measured before it). The section budget _SECTION_TIMEOUTS['mfu']
+    caps the sweep cumulatively; each variant gets at most
+    _MFU_VARIANT_TIMEOUT of it."""
+    deadline = time.time() + _SECTION_TIMEOUTS["mfu"]
+    for label, _ in _MFU_VARIANTS:
+        if label not in _mfu_pending_variants(details):
+            continue  # already measured (or terminally errored) by an
+            #            earlier pass — a re-run only fills the gaps
+        remaining = deadline - time.time()
+        if remaining <= 30:
+            errors["mfu"] = "section budget exhausted before all variants"
+            break
+        if info is not None and info.get("degraded_to_cpu"):
+            # a wedge mid-sweep (or inherited from an earlier section):
+            # keep what landed, stop burning caps — but leave a breadcrumb
+            # so a report with no lm_ keys is attributable
+            if "mfu_backend" not in details:
+                errors.setdefault("mfu", "skipped: backend degraded")
+            break
+        out = _run_section("mfu", quick,
+                           int(min(_MFU_VARIANT_TIMEOUT, remaining)),
+                           errors, info, variant=label,
+                           err_key=f"mfu.{label}")
+        failed = f"mfu.{label}" in errors
+        for key, value in out.items():
+            if key == "backend":
+                if keep_existing_on_error and failed:
+                    details.setdefault("mfu_backend", value)
+                else:
+                    details["mfu_backend"] = value
+            elif keep_existing_on_error and failed:
+                details.setdefault(key, value)
+            else:
+                details[key] = value
+        err = errors.get(f"mfu.{label}")
+        unmeasured = f"lm_{label}_ms_per_step" not in details
+        # a failure with no measurement can be the tunnel dying FAST
+        # (raising UNAVAILABLE instead of hanging — as an in-child
+        # lm_error or an rc!=0 child death): probe before classifying,
+        # else the sweep burns through every variant in seconds without
+        # ever degrading and recovery sees nothing to retry. Timeouts
+        # skip this: _run_section's kill path already probed.
+        fail_fast = unmeasured and (
+            f"lm_{label}_error" in details
+            or (err is not None
+                and not err.startswith("section timed out")))
+        if fail_fast and not (info is not None
+                              and info.get("degraded_to_cpu")):
+            if not _probe_backend_alive():
+                details.pop(f"lm_{label}_error", None)
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                errors[f"mfu.{label}_tunnel"] = \
+                    "backend unreachable; rest on cpu"
+                if info is not None:
+                    info["degraded_to_cpu"] = True
+                    info["last_dead_ts"] = time.time()
+        _persist_partials(details, errors)
+    _mfu_finalize(details)
+    _persist_partials(details, errors)
+
+
+def _mfu_pending_variants(details: dict):
+    """Sweep variants with neither a measurement nor a terminal in-child
+    error — what a (re-)run of the sweep still needs to produce."""
+    return [label for label, _ in _MFU_VARIANTS
+            if f"lm_{label}_ms_per_step" not in details
+            and f"lm_{label}_error" not in details]
 
 
 def _post_loop_recovery(details: dict, errors: dict, info: dict,
@@ -933,8 +1056,13 @@ def _post_loop_recovery(details: dict, errors: dict, info: dict,
     if not (info.get("degraded_to_cpu") or info.get("recovered_mid_run")):
         return  # backend never changed: whatever ran IS final (incl. a
         #         genuinely CPU-only environment)
+    # mfu is variant-granular: one banked variant sets mfu_backend='tpu',
+    # but a mid-sweep wedge can still have left later (stronger) variants
+    # unmeasured — those gaps, not the section flag, are what a re-run fills
     needs = [name for name in _POST_LOOP_SECTIONS
-             if details.get(f"{name}_backend") in (None, "cpu")]
+             if (details.get(f"{name}_backend") in (None, "cpu")
+                 or (name == "mfu" and not quick
+                     and _mfu_pending_variants(details)))]
     if not needs:
         return
     deadline = time.time() + _POST_LOOP_RECOVERY_SECS
@@ -1010,10 +1138,13 @@ def main():
     parser.add_argument("--section", choices=["agg", *_SECTIONS],
                         help="internal: run ONE section (child mode)")
     parser.add_argument("--out", help="internal: child-mode output path")
+    parser.add_argument("--variant",
+                        help="internal: single MFU sweep variant")
     args, _ = parser.parse_known_args()
 
     if args.section:
-        return _run_section_child(args.section, args.out, args.quick)
+        return _run_section_child(args.section, args.out, args.quick,
+                                  args.variant)
 
     backend_info = ensure_backend()
     if backend_info.get("degraded_to_cpu"):

@@ -1,0 +1,157 @@
+"""Tunnel watcher: probe the axon TPU tunnel until it serves, then capture
+the device bench sections (headline MFU first), riding out mid-capture
+wedges by falling back to probing and resuming the remaining work.
+
+The axon tunnel in this environment serves in windows of minutes between
+long outages (rounds 1-3 never landed a driver-channel TPU number because
+of it). bench.py's own run probes opportunistically within one bench
+window; this watcher turns that into a standing hunt so a revival at ANY
+point lands the on-chip numbers. Work items are fine-grained — each MFU
+sweep variant is its own item — so a second wedge never forfeits what a
+brief serving window already measured.
+
+Usage: python scripts/tpu_watch.py [hours] [out.json]
+State: writes {"phase": "waiting"|"capturing"|"done", ...} to
+scripts/tpu_watch_state.json after every transition so the build loop can
+see where it is without attaching.
+"""
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.chdir(_REPO)
+
+import bench  # noqa: E402
+
+_STATE_PATH = os.path.join(_REPO, "scripts", "tpu_watch_state.json")
+_PROBE_SECS = 90
+_PROBE_INTERVAL = 150
+_MAX_ATTEMPTS = 2
+
+
+def _state(phase, **kw):
+    kw.update({"phase": phase, "ts": time.time()})
+    tmp = _STATE_PATH + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(kw, fh)
+    os.replace(tmp, _STATE_PATH)
+
+
+def _items():
+    # headline first: MFU is the round's missing number, cheapest/most
+    # likely-to-win variants leading; agg re-captures cheaply after
+    items = [f"mfu:{label}" for label, _ in bench._MFU_VARIANTS]
+    items += ["agg", "flash", "train", "decode"]
+    return items
+
+
+def _run_item(item, details, errors, info):
+    """Run one work item; return True when it needs no further attempts."""
+    if item.startswith("mfu:"):
+        label = item.split(":", 1)[1]
+        err_key = f"mfu.{label}"
+        errors.pop(err_key, None)  # stale error from a prior attempt
+        errors.pop(err_key + "_tunnel", None)
+        out = bench._run_section(
+            "mfu", False, bench._MFU_VARIANT_TIMEOUT, errors, info,
+            variant=label, err_key=err_key)
+        for key, value in out.items():
+            details["mfu_backend" if key == "backend" else key] = value
+        # measured, or failed with a real in-child error (a retry through
+        # the same code will fail the same way)
+        return (f"lm_{label}_ms_per_step" in details
+                or f"lm_{label}_error" in details)
+    # _run_and_record owns stale-error clearing, backend attribution, and
+    # the keep-partials-on-failure merge
+    bench._run_and_record(item, False, details, errors, info,
+                          keep_existing_on_error=True)
+    return details.get(f"{item}_backend") == "tpu"
+
+
+def main():
+    hours = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    out_path = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        _REPO, "bench_results", "tpu_v5e_round4_watch.json")
+    deadline = time.time() + hours * 3600
+    info = {"orig_platforms": os.environ.get("JAX_PLATFORMS") or "axon",
+            "degraded_to_cpu": True, "last_dead_ts": 0.0}
+    details = bench._PARTIAL["details"]
+    errors = bench._PARTIAL["errors"]
+    pending = _items()
+    attempts = {}
+    probes = 0
+    while pending and time.time() < deadline:
+        # --- probe until the tunnel serves -----------------------------
+        while info.get("degraded_to_cpu") and time.time() < deadline:
+            probes += 1
+            _state("waiting", probes=probes, pending=pending)
+            if bench.try_recover_backend(info, timeout=_PROBE_SECS):
+                break
+            time.sleep(_PROBE_INTERVAL)
+        if info.get("degraded_to_cpu"):
+            break  # deadline hit while waiting
+        # --- capture until done or wedged again ------------------------
+        while pending and not info.get("degraded_to_cpu") \
+                and time.time() < deadline:
+            item = pending[0]
+            _state("capturing", item=item, probes=probes, pending=pending)
+            done = _run_item(item, details, errors, info)
+            if not _measured(item, details) \
+                    and not info.get("degraded_to_cpu"):
+                # a failure with no measurement can be the tunnel dying
+                # FAST (raising instead of hanging — _run_section only
+                # probes on timeouts): confirm it is alive before charging
+                # an attempt, else a dead tunnel drains the whole pending
+                # list in minutes and the hunt ends with hours left
+                if not bench._probe_backend_alive():
+                    info["degraded_to_cpu"] = True
+                    info["last_dead_ts"] = time.time()
+            if info.get("degraded_to_cpu") and not _measured(item, details):
+                if item.startswith("mfu:"):
+                    # an UNAVAILABLE recorded as a terminal variant error
+                    # is outage noise, not a code error — retry on revival
+                    details.pop(f"lm_{item.split(':', 1)[1]}_error", None)
+                # leave at the FRONT, attempt uncharged: the next serving
+                # window resumes exactly here
+            else:
+                attempts[item] = attempts.get(item, 0) + 1
+                if done or attempts[item] >= _MAX_ATTEMPTS:
+                    pending.remove(item)
+                else:
+                    # failed while the tunnel is confirmed alive: rotate
+                    # to the back
+                    pending.remove(item)
+                    pending.append(item)
+            _finalize(details)
+            _dump(out_path, details, errors, probes)
+    _state("done", pending=pending, probes=probes)
+    _finalize(details)
+    _dump(out_path, details, errors, probes)
+    print(json.dumps({"pending": pending, "probes": probes}))
+    return 0 if not pending else 1
+
+
+def _measured(item, details):
+    """True when the item has banked an on-chip number."""
+    if item.startswith("mfu:"):
+        return f"lm_{item.split(':', 1)[1]}_ms_per_step" in details
+    return details.get(f"{item}_backend") == "tpu"
+
+
+def _finalize(details):
+    bench._mfu_finalize(details)
+
+
+def _dump(out_path, details, errors, probes):
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"details": details, "errors": errors,
+                   "watch_probes": probes, "ts": time.time()}, fh)
+    os.replace(tmp, out_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
