@@ -26,6 +26,11 @@ os.chdir(_REPO)
 
 import bench  # noqa: E402
 
+# the watcher and a concurrently-running `python bench.py` must not fight
+# over the same crash-recovery snapshot file
+bench._PARTIAL_PATH = os.path.join(_REPO, "scripts",
+                                   "tpu_watch_partial.json")
+
 _STATE_PATH = os.path.join(_REPO, "scripts", "tpu_watch_state.json")
 _PROBE_SECS = 90
 _PROBE_INTERVAL = 150
@@ -99,13 +104,17 @@ def main():
             item = pending[0]
             _state("capturing", item=item, probes=probes, pending=pending)
             done = _run_item(item, details, errors, info)
+            err = errors.get(_err_key(item))
+            timed_out = err is not None and \
+                err.startswith("section timed out")
             if not _measured(item, details) \
-                    and not info.get("degraded_to_cpu"):
+                    and not info.get("degraded_to_cpu") and not timed_out:
                 # a failure with no measurement can be the tunnel dying
-                # FAST (raising instead of hanging — _run_section only
-                # probes on timeouts): confirm it is alive before charging
-                # an attempt, else a dead tunnel drains the whole pending
-                # list in minutes and the hunt ends with hours left
+                # FAST (raising instead of hanging): confirm it is alive
+                # before charging an attempt, else a dead tunnel drains
+                # the whole pending list in minutes and the hunt ends
+                # with hours left. Timeouts skip this — _run_section's
+                # kill path already probed.
                 if not bench._probe_backend_alive():
                     info["degraded_to_cpu"] = True
                     info["last_dead_ts"] = time.time()
@@ -114,8 +123,12 @@ def main():
                     # an UNAVAILABLE recorded as a terminal variant error
                     # is outage noise, not a code error — retry on revival
                     details.pop(f"lm_{item.split(':', 1)[1]}_error", None)
-                # leave at the FRONT, attempt uncharged: the next serving
-                # window resumes exactly here
+                # attempt uncharged — but ROTATE to the back: if this
+                # item's own compile is what wedges the tunnel, keeping it
+                # at the front would burn every future serving window on
+                # it and never reach the rest of the list
+                pending.remove(item)
+                pending.append(item)
             else:
                 attempts[item] = attempts.get(item, 0) + 1
                 if done or attempts[item] >= _MAX_ATTEMPTS:
@@ -132,6 +145,11 @@ def main():
     _dump(out_path, details, errors, probes)
     print(json.dumps({"pending": pending, "probes": probes}))
     return 0 if not pending else 1
+
+
+def _err_key(item):
+    return f"mfu.{item.split(':', 1)[1]}" if item.startswith("mfu:") \
+        else item
 
 
 def _measured(item, details):

@@ -225,3 +225,116 @@ def test_post_loop_rerun_after_midloop_recovery(monkeypatch):
     bench._post_loop_recovery({"agg_backend": "cpu", "mfu_backend": "cpu"},
                               {}, {"degraded_to_cpu": False}, quick=True)
     assert ran == []  # backend never changed: nothing to re-run
+
+
+def test_mfu_pending_variants_classification():
+    """Measured and terminally-errored variants need no re-run; the rest do."""
+    import bench
+
+    labels = [lbl for lbl, _ in bench._MFU_VARIANTS]
+    assert bench._mfu_pending_variants({}) == labels
+    d = {f"lm_{labels[0]}_ms_per_step": 1.0, f"lm_{labels[1]}_error": "x"}
+    pending = bench._mfu_pending_variants(d)
+    assert labels[0] not in pending and labels[1] not in pending
+    assert pending == labels[2:]
+
+
+def test_mfu_variant_children_merge_and_rollup(monkeypatch):
+    """The parent merges each variant child's fields, attributes the
+    backend per-section, and computes the best-variant rollup itself
+    (children see only their own variant)."""
+    import bench
+
+    def fake_section(name, quick, timeout, errors, info, variant=None,
+                     err_key=None):
+        assert name == "mfu" and variant
+        ms = {"b8_dense": 100.0}.get(variant, 50.0)
+        return {f"lm_{variant}_ms_per_step": ms,
+                f"lm_{variant}_tokens_per_sec": 1000.0 / ms,
+                "device_kind": "TPU v5 lite", "backend": "tpu"}
+
+    monkeypatch.setattr(bench, "_run_section", fake_section)
+    details, errors = {}, {}
+    bench._run_mfu_variants(False, details, errors, {})
+    assert errors == {}
+    assert details["mfu_backend"] == "tpu"
+    for label, _ in bench._MFU_VARIANTS:
+        assert details[f"lm_{label}_ms_per_step"] > 0
+    # best = highest tokens/sec = any 50ms variant, not the 100ms one
+    assert details["lm_best_variant"] != "b8_dense"
+    assert details["lm_ms_per_step"] == 50.0
+    assert details["mfu"] > 0  # v5e peak known -> real MFU computed
+
+
+def test_mfu_wedge_costs_one_variant_and_rerun_fills_gaps(monkeypatch):
+    """A timeout+dead-probe on variant N degrades and stops the sweep,
+    keeping variants < N; a later re-run (recovery) runs ONLY the missing
+    variants and the rollup then covers the union."""
+    import bench
+
+    ran = []
+
+    def wedge_on_second(name, quick, timeout, errors, info, variant=None,
+                        err_key=None):
+        ran.append(variant)
+        if len(ran) == 2:
+            errors[err_key] = f"section timed out after {timeout}s (killed)"
+            info["degraded_to_cpu"] = True
+            return {}
+        return {f"lm_{variant}_ms_per_step": 10.0,
+                f"lm_{variant}_tokens_per_sec": 100.0,
+                "device_kind": "TPU v5 lite", "backend": "tpu"}
+
+    monkeypatch.setattr(bench, "_run_section", wedge_on_second)
+    details, errors, info = {}, {}, {"degraded_to_cpu": False}
+    bench._run_mfu_variants(False, details, errors, info)
+    first = [lbl for lbl, _ in bench._MFU_VARIANTS][0]
+    assert ran == [lbl for lbl, _ in bench._MFU_VARIANTS][:2]
+    assert f"lm_{first}_ms_per_step" in details   # banked before the wedge
+    assert "mfu.b32_dense_remat_scan8" in errors
+    # something banked -> no "skipped" breadcrumb masking real results
+    assert errors.get("mfu") is None
+    pending = bench._mfu_pending_variants(details)
+    assert pending == [lbl for lbl, _ in bench._MFU_VARIANTS][1:]
+
+    # recovery re-run: only the gaps run, measured variants are not redone
+    ran.clear()
+    info["degraded_to_cpu"] = False
+
+    def healthy(name, quick, timeout, errors, info, variant=None,
+                err_key=None):
+        ran.append(variant)
+        return {f"lm_{variant}_ms_per_step": 10.0,
+                f"lm_{variant}_tokens_per_sec": 100.0,
+                "device_kind": "TPU v5 lite", "backend": "tpu"}
+
+    monkeypatch.setattr(bench, "_run_section", healthy)
+    bench._run_and_record("mfu", False, details, errors, info,
+                          keep_existing_on_error=True)
+    assert ran == pending                       # gaps only
+    assert not bench._mfu_pending_variants(details)
+    assert errors == {}                         # stale variant error cleared
+
+
+def test_mfu_fail_fast_dead_tunnel_degrades(monkeypatch):
+    """A variant child that dies FAST (rc!=0, no measurement) triggers a
+    backend probe; a dead probe degrades the run instead of letting the
+    sweep burn through every variant against a dead tunnel."""
+    import bench
+
+    def fast_death(name, quick, timeout, errors, info, variant=None,
+                   err_key=None):
+        errors[err_key] = "RuntimeError: Unable to initialize backend"
+        return {}
+
+    monkeypatch.setattr(bench, "_run_section", fast_death)
+    monkeypatch.setattr(bench, "_probe_backend_alive", lambda *a, **k: False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    details, errors, info = {}, {}, {"degraded_to_cpu": False}
+    bench._run_mfu_variants(False, details, errors, info)
+    assert info["degraded_to_cpu"] is True
+    first = [lbl for lbl, _ in bench._MFU_VARIANTS][0]
+    assert f"mfu.{first}_tunnel" in errors
+    # only the first variant burned a child; the rest were skipped
+    assert "mfu.b8_dense_scan8" not in errors
+    assert errors.get("mfu") == "skipped: backend degraded"
