@@ -679,6 +679,26 @@ def bench_store(num_learners: int = 64):
                                     + cached.cache_misses), 3)
         out["store_cached_resident_mb"] = round(
             cached._cached_total / 1e6, 1)
+
+    # wire-size ladder: the same 1.4M-param model blob under each uplink
+    # encoding (ship_dtype) — quantifies the compression story end to end
+    from metisfl_tpu.tensor.pytree import ModelBlob
+    from metisfl_tpu.tensor.quantize import quantize_named
+    from metisfl_tpu.tensor.sparse import sparsify_update
+    from metisfl_tpu.tensor.spec import narrow_named, resolve_ship_dtype
+
+    named = [(name, np.asarray(arr)) for name, arr in models[0].items()]
+    ref = {name: np.zeros_like(arr) for name, arr in named}
+    out["wire_f32_mb"] = round(
+        len(ModelBlob(tensors=named).to_bytes()) / 1e6, 2)
+    out["wire_bf16_mb"] = round(len(ModelBlob(tensors=narrow_named(
+        named, resolve_ship_dtype("bf16"))).to_bytes()) / 1e6, 2)
+    out["wire_int8q_mb"] = round(len(ModelBlob(
+        tensors=quantize_named(named)).to_bytes()) / 1e6, 2)
+    for denom in (16, 64):
+        out[f"wire_topk{denom}_mb"] = round(len(ModelBlob(
+            tensors=sparsify_update(named, ref, denom, {})).to_bytes())
+            / 1e6, 2)
     return out
 
 

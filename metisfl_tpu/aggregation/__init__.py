@@ -21,6 +21,7 @@ import functools
 
 from metisfl_tpu.aggregation.base import AggregationRule, AggState
 from metisfl_tpu.aggregation.fedavg import FedAvg, Scaffold
+from metisfl_tpu.aggregation.fednova import FedNova
 from metisfl_tpu.aggregation.robust import CoordinateMedian, Krum, TrimmedMean
 from metisfl_tpu.aggregation.rolling import FedRec, FedStride
 from metisfl_tpu.aggregation.secure import SecureAgg
@@ -32,6 +33,9 @@ AGGREGATION_RULES = {
     "fedrec": FedRec,
     "secure_agg": SecureAgg,
     "scaffold": Scaffold,
+    # normalized averaging for heterogeneous local step counts
+    # (aggregation/fednova.py — beyond the reference's inventory)
+    "fednova": FedNova,
     # server-side adaptive optimization over the FedAvg fold
     # (aggregation/serveropt.py — beyond the reference's inventory)
     "fedavgm": functools.partial(ServerOpt, "fedavgm"),
@@ -59,6 +63,7 @@ __all__ = [
     "AggregationRule",
     "AggState",
     "FedAvg",
+    "FedNova",
     "FedStride",
     "FedRec",
     "Scaffold",

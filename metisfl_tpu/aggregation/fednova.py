@@ -1,0 +1,179 @@
+"""FedNova — normalized averaging for heterogeneous local work.
+
+FedNova (Wang et al., "Tackling the Objective Inconsistency Problem in
+Heterogeneous Federated Optimization", NeurIPS 2020) fixes plain FedAvg's
+bias when learners complete different numbers of local steps τᵢ (stragglers
+cut short by a deadline, uneven shard sizes, semi-sync step reassignment):
+naively averaging weights over-weights whoever stepped the most, silently
+optimizing a τ-weighted surrogate objective instead of the true one. The
+cure is to average per-step *normalized* updates and rescale by the
+cohort's effective step count:
+
+    x⁺ = x + τ_eff · Σᵢ pᵢ (wᵢ - x)/τᵢ,     τ_eff = Σᵢ pᵢ τᵢ
+
+With data weights pᵢ and uniform τᵢ = τ this reduces exactly to FedAvg —
+the rule only changes behavior when local work actually diverges.
+
+Implementation: the update rewrites as a q-weighted FedAvg fold plus one
+affine correction against the previous community model —
+
+    qᵢ = pᵢ/τᵢ,  Q = Σ qᵢ,  avg_q = Σ qᵢ wᵢ / Q
+    x⁺ = x + (τ_eff · Q) · (avg_q - x)
+
+so the same stride-blocked, one-block-resident :class:`FedAvg` fold does
+all the tensor math (the reference's bounded-memory aggregation shape,
+/root/reference/metisfl/controller/core/controller.cc:842-936 — the
+reference itself has no normalized rule, SURVEY.md §2.1 C3-C7), and the
+correction touches the model once per round on the host. Like
+:class:`ServerOpt`, the previous community model stages inside ``result()``
+and commits only after the round installs, so an aggregation-failure retry
+cannot double-apply; ``export_state``/``restore_state`` persist x across
+controller restarts.
+
+The per-learner step counts arrive from the controller (it tracks each
+learner's ``completed_batches`` — one optimizer step per batch in this
+engine) via the ``steps=`` argument that ``needs_local_steps`` opts into.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from metisfl_tpu.aggregation.base import Pytree
+from metisfl_tpu.aggregation.fedavg import FedAvg
+
+
+class FedNova:
+    name = "fednova"
+    required_lineage = 1
+    # the controller passes per-learner local step counts to accumulate()
+    needs_local_steps = True
+
+    def __init__(self):
+        self._fold = FedAvg()
+        self._state_lock = threading.Lock()
+        self._prev: Optional[Pytree] = None   # f32 host community model
+        self._staged: Optional[Pytree] = None
+        self._pending: Optional[Dict[str, Any]] = None
+        self._sum_q = 0.0      # Σ pᵢ/τᵢ
+        self._tau_eff = 0.0    # Σ pᵢτᵢ
+        self.reset()
+
+    # -- fold interface ----------------------------------------------------
+    def reset(self) -> None:
+        self._fold.reset()
+        self._sum_q = 0.0
+        self._tau_eff = 0.0
+
+    def accumulate(
+        self,
+        models: Sequence[Tuple[Sequence[Pytree], float]],
+        steps: Optional[Sequence[float]] = None,
+    ) -> None:
+        if steps is None or len(steps) != len(models):
+            raise ValueError(
+                "fednova requires one local-step count per model "
+                f"(got {None if steps is None else len(steps)} for "
+                f"{len(models)} models)")
+        adjusted = []
+        for (lineage, p), tau in zip(models, steps):
+            tau = max(1.0, float(tau))
+            adjusted.append((lineage, float(p) / tau))
+            self._sum_q += float(p) / tau
+            self._tau_eff += float(p) * tau
+        self._fold.accumulate(adjusted)
+
+    def result(self) -> Pytree:
+        avg_q = self._fold.result()
+        with self._state_lock:
+            return self._apply_correction(avg_q)
+
+    def aggregate(self, models, steps=None, state=None) -> Pytree:
+        """One-shot path (tests / direct use)."""
+        self.reset()
+        self.accumulate(models, steps=steps)
+        out = self.result()
+        self.commit()
+        self.reset()
+        return out
+
+    def commit(self) -> None:
+        with self._state_lock:
+            if self._staged is not None:
+                self._prev = self._staged
+                self._staged = None
+
+    # -- the normalized step -----------------------------------------------
+    def seed_community(self, community: Pytree) -> None:
+        with self._state_lock:
+            self._prev = jax.tree.map(self._to_f32, community)
+
+    @staticmethod
+    def _to_f32(x):
+        x = np.asarray(x)
+        return x if np.issubdtype(x.dtype, np.integer) \
+            else np.asarray(x, np.float32)
+
+    def _apply_correction(self, avg_q: Pytree) -> Pytree:
+        self._resolve_pending(avg_q)
+        if self._prev is None:
+            # cold start with no seeded model: adopt the q-average (the
+            # first real round steps from it)
+            self._staged = jax.tree.map(self._to_f32, avg_q)
+            return avg_q
+        prev_leaves, treedef = jax.tree.flatten(self._prev)
+        avg_leaves, avg_treedef = jax.tree.flatten(avg_q)
+        if treedef != avg_treedef:
+            raise ValueError(
+                "fednova state tree does not match the aggregated model "
+                f"tree: state {treedef} vs round {avg_treedef}")
+        eff = self._tau_eff * self._sum_q
+
+        def leaf(prev, a):
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.integer):
+                return a  # discrete state: adopt the average
+            return (prev + eff * (np.asarray(a, np.float32) - prev)) \
+                .astype(np.float32)
+
+        new_prev = jax.tree.unflatten(
+            treedef, [leaf(p, a) for p, a in zip(prev_leaves, avg_leaves)])
+        self._staged = new_prev
+        # community keeps each tensor's storage dtype (wire contract)
+        return jax.tree.map(
+            lambda n, a: np.asarray(n).astype(np.asarray(a).dtype),
+            new_prev, avg_q)
+
+    # -- persistence (controller checkpoint) --------------------------------
+    def export_state(self) -> Dict[str, Any]:
+        from metisfl_tpu.tensor.pytree import pack_model
+
+        with self._state_lock:
+            if self._pending is not None:
+                return dict(self._pending, rule=self.name)
+            out: Dict[str, Any] = {"rule": self.name}
+            if self._prev is not None:
+                out["prev"] = pack_model(self._prev)
+            return out
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        if state.get("rule") not in (None, self.name):
+            raise ValueError(
+                f"checkpoint aggregation state is for {state.get('rule')!r},"
+                f" this rule is {self.name!r}")
+        with self._state_lock:
+            self._pending = state
+
+    def _resolve_pending(self, template: Pytree) -> None:
+        if self._pending is None:
+            return
+        from metisfl_tpu.tensor.pytree import unpack_model
+
+        state, self._pending = self._pending, None
+        if "prev" in state:
+            self._prev = jax.tree.map(
+                self._to_f32, unpack_model(state["prev"], template))

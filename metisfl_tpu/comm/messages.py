@@ -126,6 +126,16 @@ class TrainParams(Message):
     # aggregation (HE/masking payloads have their own fixed-point
     # encoding; int8q+secure is rejected at config time).
     ship_dtype: str = ""
+    # Wire dtype for the DOWNLINK (controller → learner community-model
+    # broadcast): a float DType name, typically "bf16" to halve broadcast
+    # bandwidth across the cohort. "" ships the stored dtype unchanged.
+    # Like ship_dtype, only the wire narrows — the controller's own
+    # community state stays f32 and each learner restores its training
+    # dtypes on receipt. Learners also evaluate the narrowed weights (the
+    # model they actually received). Rejected with secure aggregation
+    # (opaque payloads) and with ship_dtype='topk...' (sparse updates
+    # reconstruct against the controller's exact f32 model).
+    downlink_dtype: str = ""
     # Client-level differential privacy on the shipped update
     # (secure/dp.py): the delta vs the received community model is
     # L2-clipped to dp_clip_norm (> 0 enables; also a robustness tool on

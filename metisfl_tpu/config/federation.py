@@ -229,21 +229,55 @@ class FederationConfig:
             raise ValueError(
                 "dp_noise_multiplier > 0 requires dp_clip_norm > 0 "
                 "(noise scales with the clip bound)")
+        from metisfl_tpu.tensor.quantize import SHIP_INT8Q
+        from metisfl_tpu.tensor.sparse import parse_topk
+        from metisfl_tpu.tensor.spec import resolve_ship_dtype
+
         if self.train.ship_dtype:
             # a typo here would otherwise fail only after round 1's full
             # local training, on every learner, every round
-            from metisfl_tpu.tensor.quantize import SHIP_INT8Q
-            from metisfl_tpu.tensor.spec import resolve_ship_dtype
-
-            if self.train.ship_dtype.lower() != SHIP_INT8Q:
+            topk_denom = parse_topk(self.train.ship_dtype)
+            if (self.train.ship_dtype.lower() != SHIP_INT8Q
+                    and topk_denom is None):
                 resolve_ship_dtype(self.train.ship_dtype)
-            if (self.train.ship_dtype.lower() == SHIP_INT8Q
-                    and self.secure.enabled):
+            if ((self.train.ship_dtype.lower() == SHIP_INT8Q
+                 or topk_denom is not None) and self.secure.enabled):
                 # secure payloads carry their own fixed-point encoding
                 raise ValueError(
-                    "ship_dtype='int8q' is incompatible with secure "
-                    "aggregation (HE/masking payloads have their own "
-                    "fixed-point encoding)")
+                    f"ship_dtype={self.train.ship_dtype!r} is incompatible "
+                    "with secure aggregation (HE/masking payloads have "
+                    "their own fixed-point encoding)")
+            if (topk_denom is not None
+                    and self.protocol.lower() == "asynchronous"):
+                # the controller densifies a topk update against ITS
+                # community model; under async that model advances between
+                # dispatch and completion, so the reconstruction reference
+                # would be wrong
+                raise ValueError(
+                    "ship_dtype='topk...' requires a synchronous or "
+                    "semi_synchronous protocol (async advances the "
+                    "community model mid-task, breaking sparse-update "
+                    "reconstruction)")
+        if self.train.downlink_dtype:
+            import numpy as _np
+
+            target = _np.dtype(resolve_ship_dtype(self.train.downlink_dtype))
+            # bf16/f8 are ml_dtypes extension types (not np.floating
+            # subtypes) — reject only genuinely non-float wire dtypes
+            if _np.issubdtype(target, _np.integer) or target == _np.bool_:
+                raise ValueError(
+                    f"downlink_dtype {self.train.downlink_dtype!r} must be "
+                    "a float dtype (integer state never narrows)")
+            if self.secure.enabled:
+                raise ValueError(
+                    "downlink_dtype is incompatible with secure aggregation "
+                    "(the broadcast is an opaque ciphertext payload)")
+            if parse_topk(self.train.ship_dtype or "") is not None:
+                raise ValueError(
+                    "downlink_dtype cannot combine with ship_dtype='topk...'"
+                    ": sparse updates reconstruct against the controller's "
+                    "exact f32 community model, and a narrowed downlink "
+                    "changes the learner's reference")
 
     # -- wire/launch serialization ----------------------------------------
     def to_wire(self) -> bytes:

@@ -30,7 +30,8 @@ import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence
+from typing import (Any, Callable, Dict, List, Optional, Protocol, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -192,6 +193,8 @@ class Controller:
         self._community_flat: Optional[Dict[str, np.ndarray]] = None
         self._community_blob: Optional[bytes] = None
         self._community_opaque = None      # secure path
+        # (full-width blob, narrowed bytes) — see _dispatch_blob
+        self._downlink_cache: Optional[Tuple[bytes, bytes]] = None
         self.global_iteration = 0
 
         # lineage / statistics
@@ -399,8 +402,33 @@ class Controller:
             if not stale:
                 self._current_meta.train_received_at[result.learner_id] = start
 
-        model = self._parse_result_model(result)
-        self._store.insert(result.learner_id, model)
+        if stale and self._topk_uplink():
+            # a topk payload is a delta against the community model AT
+            # DISPATCH; the deadline path has since advanced it, so the
+            # reconstruction reference is gone — storing the densification
+            # would poison any later aggregation that selects it
+            logger.info("late topk completion from %s for expired task %s "
+                        "dropped (reconstruction reference advanced)",
+                        result.learner_id, result.task_id)
+            return
+        try:
+            model = self._parse_result_model(result)
+        except ValueError as exc:
+            # A malformed payload (bad sparse indices, missing companions,
+            # codec garbage) must cost its OWN contribution, not the round:
+            # the learner already got its ack and the task left
+            # _tasks_in_flight, so raising here would stall a sync barrier
+            # forever (no deadline by default). Drop the model, keep the
+            # barrier moving; aggregation proceeds with whatever lineage
+            # exists for this learner.
+            logger.warning("dropping malformed result from %s for task %s: "
+                           "%s", result.learner_id, result.task_id, exc)
+            with self._lock:
+                self._current_meta.errors.append(
+                    f"malformed result from {result.learner_id}: {exc}")
+            model = None
+        if model is not None:
+            self._store.insert(result.learner_id, model)
         if not stale:
             with self._lock:
                 self._current_meta.model_insertion_duration_ms[result.learner_id] = (
@@ -499,6 +527,12 @@ class Controller:
                 "re-dispatching", self.config.round_deadline_secs, dropped)
             self._dispatch_train(self._sample_cohort())
 
+    def _topk_uplink(self) -> bool:
+        from metisfl_tpu.tensor.sparse import parse_topk
+
+        return (not self.config.secure.enabled
+                and parse_topk(self.config.train.ship_dtype) is not None)
+
     def _parse_result_model(self, result: TaskResult):
         blob = ModelBlob.from_bytes(result.model)
         if self.config.secure.enabled:
@@ -512,6 +546,18 @@ class Controller:
             from metisfl_tpu.tensor.quantize import dequantize_named
 
             tensors = dequantize_named(tensors)
+        else:
+            from metisfl_tpu.tensor.sparse import densify_named, parse_topk
+
+            if parse_topk(self.config.train.ship_dtype) is not None:
+                # topk uplink: dense weights = dispatched community model
+                # + scatter(sparse update). Valid because sync/semi-sync
+                # (config-enforced) guarantees the community model has not
+                # advanced since this task's dispatch. Same config gating
+                # rationale as int8q above.
+                with self._lock:
+                    community = dict(self._community_flat or {})
+                tensors = densify_named(tensors, community)
         return tensors
 
     def _complete_round(self, cohort: Sequence[str]) -> None:
@@ -687,13 +733,24 @@ class Controller:
             # once, inside result().
             self._aggregator.reset()
             accumulated = 0
+            needs_steps = getattr(self._aggregator, "needs_local_steps",
+                                  False)
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 tb = time.time()
                 picked = self._store.select(block, k=lineage_k)
                 pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
                 if pairs:
-                    self._aggregator.accumulate(pairs)
+                    if needs_steps:
+                        # fednova: per-learner completed local steps (one
+                        # optimizer step per batch in this engine)
+                        steps = [
+                            max(1.0, float(metadata.get(lid, {}).get(
+                                "completed_batches", 0.0)) or 1.0)
+                            for lid in block if lid in picked]
+                        self._aggregator.accumulate(pairs, steps=steps)
+                    else:
+                        self._aggregator.accumulate(pairs)
                     accumulated += len(pairs)
                 meta_blocks.append(len(block))
                 meta_durations.append((time.time() - tb) * 1e3)
@@ -881,11 +938,34 @@ class Controller:
 
     # -- dispatch ---------------------------------------------------------
 
+    def _dispatch_blob(self) -> Optional[bytes]:
+        """The community blob as dispatched: downlink_dtype narrows the
+        broadcast wire width (e.g. bf16 halves it across the cohort); the
+        narrowed encoding is cached per community model so N dispatches
+        re-encode once. Internal state (_community_flat, checkpoints,
+        stores) stays full-width."""
+        with self._lock:
+            blob = self._community_blob
+            target_name = self.config.train.downlink_dtype
+            if blob is None or not target_name or self.config.secure.enabled:
+                return blob
+            cached = self._downlink_cache
+            if cached is not None and cached[0] is blob:
+                return cached[1]
+        from metisfl_tpu.tensor.pytree import ModelBlob
+        from metisfl_tpu.tensor.spec import narrow_named, resolve_ship_dtype
+
+        parsed = ModelBlob.from_bytes(blob)
+        narrowed = ModelBlob(tensors=narrow_named(
+            parsed.tensors, resolve_ship_dtype(target_name))).to_bytes()
+        with self._lock:
+            self._downlink_cache = (blob, narrowed)
+        return narrowed
+
     def _dispatch_train(self, learner_ids: Sequence[str],
                         restart_deadline: bool = True) -> None:
         """SendRunTasks (controller.cc:696-759)."""
-        with self._lock:
-            blob = self._community_blob
+        blob = self._dispatch_blob()
         if blob is None:
             logger.warning("no community model yet; cannot dispatch train tasks")
             return
@@ -957,8 +1037,8 @@ class Controller:
             return
         if (self.global_iteration + 1) % cfg.every_n_rounds != 0:
             return
+        blob = self._dispatch_blob()
         with self._lock:
-            blob = self._community_blob
             learners = list(self._learners.values())
             iteration = self.global_iteration
             # bind eval timestamps to the SUBMITTING round's metadata — the

@@ -227,3 +227,14 @@ def resolve_ship_dtype(name: str) -> np.dtype:
         raise ValueError(
             f"unknown ship_dtype {name!r}; valid names: "
             f"{[d.name.lower() for d in DType] + ['int8q']}") from None
+
+
+def narrow_named(named, target: np.dtype):
+    """[(name, arr)] with float tensors cast to ``target``; integer/bool
+    state (step counters, token ids) passes through — casting it through a
+    float mantissa would corrupt it. Shared by the uplink (ship_dtype) and
+    downlink (downlink_dtype) wire-narrowing paths."""
+    return [(n, np.asarray(a, target)
+             if np.issubdtype(np.asarray(a).dtype, np.floating)
+             and np.asarray(a).dtype != target else a)
+            for n, a in named]

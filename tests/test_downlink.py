@@ -1,0 +1,139 @@
+"""bf16 downlink narrowing (TrainParams.downlink_dtype)."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                FederationConfig, SecureAggConfig,
+                                TerminationConfig)
+
+
+def _controller(**train_kw):
+    from metisfl_tpu.controller.core import Controller
+
+    class _NopProxy:
+        def run_task(self, task):
+            pass
+
+        def evaluate(self, task, callback):
+            pass
+
+        def shutdown(self):
+            pass
+
+    cfg = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(**train_kw),
+        termination=TerminationConfig(federation_rounds=1),
+    )
+    return Controller(cfg, lambda record: _NopProxy())
+
+
+def test_dispatch_blob_narrows_and_caches():
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    ctl = _controller(downlink_dtype="bf16")
+    try:
+        w = np.random.default_rng(0).standard_normal(4096).astype(np.float32)
+        full = ModelBlob(tensors=[("w", w),
+                                  ("step", np.asarray(3, np.int64))])
+        ctl.set_community_model(full.to_bytes())
+        out = ctl._dispatch_blob()
+        assert len(out) < len(full.to_bytes()) * 0.6  # halved (plus headers)
+        parsed = dict(ModelBlob.from_bytes(out).tensors)
+        import jax.numpy as jnp
+
+        assert np.asarray(parsed["w"]).dtype == jnp.bfloat16
+        assert np.asarray(parsed["step"]).dtype == np.int64  # ints intact
+        np.testing.assert_allclose(
+            np.asarray(parsed["w"], np.float32), w, atol=0.02, rtol=0.01)
+        # the internal community blob stays full-width
+        internal = dict(ModelBlob.from_bytes(
+            ctl.community_model_bytes()).tensors)
+        assert np.asarray(internal["w"]).dtype == np.float32
+        # cache: same community model -> the same narrowed bytes object
+        assert ctl._dispatch_blob() is out
+        # a new community model invalidates it
+        ctl.set_community_model(ModelBlob(tensors=[
+            ("w", w * 2), ("step", np.asarray(4, np.int64))]).to_bytes())
+        assert ctl._dispatch_blob() is not out
+    finally:
+        ctl.shutdown()
+
+
+def test_downlink_off_is_passthrough():
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    ctl = _controller()
+    try:
+        blob = ModelBlob(tensors=[
+            ("w", np.ones(128, np.float32))]).to_bytes()
+        ctl.set_community_model(blob)
+        assert ctl._dispatch_blob() == blob
+    finally:
+        ctl.shutdown()
+
+
+def test_downlink_config_rejections():
+    with pytest.raises(ValueError, match="secure"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="participants"),
+            secure=SecureAggConfig(enabled=True, scheme="ckks"),
+            train=TrainParams(downlink_dtype="bf16"))
+    with pytest.raises(ValueError, match="topk"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(ship_dtype="topk16", downlink_dtype="bf16"))
+    with pytest.raises(ValueError, match="float"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(downlink_dtype="i32"))
+    with pytest.raises(ValueError, match="unknown ship_dtype"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(downlink_dtype="bf17"))
+
+
+def test_bf16_downlink_federation_learns():
+    """End to end: learners train from (and evaluate) the narrowed
+    broadcast; the federation still converges."""
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from tests.test_federation_inprocess import _shards
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.1,
+                          ship_dtype="bf16", downlink_dtype="bf16"),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=3),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(3)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=120)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.6, f"bf16-downlink federation failed to learn: {last}"
+    finally:
+        fed.shutdown()

@@ -1,0 +1,171 @@
+"""FedNova normalized averaging (aggregation/fednova.py)."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.aggregation import FedAvg, FedNova, make_aggregation_rule
+
+
+def _models(n, seed=0, d=6):
+    rng = np.random.default_rng(seed)
+    return [{"w": rng.standard_normal(d).astype(np.float32),
+             "b": rng.standard_normal(2).astype(np.float32),
+             "step": np.asarray(seed + i, np.int64)} for i in range(n)]
+
+
+def test_uniform_steps_reduce_to_fedavg():
+    """With equal τ and normalized weights FedNova IS FedAvg — the rule
+    only changes behavior when local work diverges."""
+    models = _models(4)
+    pairs = [([m], 0.25) for m in models]
+    nova = FedNova()
+    nova.seed_community(models[0])
+    got = nova.aggregate(pairs, steps=[5.0] * 4)
+    want = FedAvg().aggregate([([m], 0.25) for m in models])
+    np.testing.assert_allclose(got["w"], want["w"], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got["b"], want["b"], rtol=1e-5, atol=1e-6)
+
+
+def test_heterogeneous_steps_match_paper_formula():
+    """x+ = x + tau_eff * sum_i p_i (w_i - x)/tau_i."""
+    models = _models(3, seed=7)
+    x = {"w": np.zeros(6, np.float32), "b": np.zeros(2, np.float32),
+         "step": np.asarray(0, np.int64)}
+    p = [0.5, 0.3, 0.2]
+    tau = [10.0, 2.0, 1.0]
+    nova = FedNova()
+    nova.seed_community(x)
+    got = nova.aggregate([([m], pi) for m, pi in zip(models, p)], steps=tau)
+    tau_eff = sum(pi * ti for pi, ti in zip(p, tau))
+    for key in ("w", "b"):
+        want = x[key] + tau_eff * sum(
+            pi * (m[key] - x[key]) / ti
+            for m, pi, ti in zip(models, p, tau))
+        np.testing.assert_allclose(got[key], want, rtol=1e-4, atol=1e-5)
+    # integer leaves adopt the (q-weighted) average, not a float step
+    assert np.issubdtype(np.asarray(got["step"]).dtype, np.integer)
+
+
+def test_fednova_downweights_overstepping_learner():
+    """A learner that ran 10x the steps must NOT dominate the round the
+    way it does under plain FedAvg."""
+    base = np.zeros(4, np.float32)
+    small = {"w": base + 1.0}   # 1 step of progress
+    big = {"w": base + 10.0}    # 10 steps of progress (same per-step rate)
+    pairs = [([small], 0.5), ([big], 0.5)]
+    nova = FedNova()
+    nova.seed_community({"w": base})
+    got = nova.aggregate(pairs, steps=[1.0, 10.0])
+    fedavg = FedAvg().aggregate(pairs)
+    # fedavg lands at 5.5; fednova's per-step normalization gives both
+    # learners unit direction: x+ = tau_eff * (0.5*1 + 0.5*1) = 5.5... so
+    # use different per-step rates to separate: big's per-step progress is
+    # 1.0/step like small's, so fednova == fedavg here is fine; instead
+    # check the canonical inconsistency case: same TOTAL displacement.
+    big2 = {"w": base + 1.0}    # same displacement, 10x the steps
+    nova2 = FedNova()
+    nova2.seed_community({"w": base})
+    got2 = nova2.aggregate([([small], 0.5), ([big2], 0.5)],
+                           steps=[1.0, 10.0])
+    # normalized directions: 0.5*1 + 0.5*0.1 = 0.55; tau_eff = 5.5 -> 3.025
+    np.testing.assert_allclose(got2["w"], base + 3.025, rtol=1e-5)
+    # plain fedavg would land at 1.0 regardless of steps
+    fedavg2 = FedAvg().aggregate([([small], 0.5), ([big2], 0.5)])
+    np.testing.assert_allclose(fedavg2["w"], base + 1.0, rtol=1e-5)
+    assert not np.allclose(got2["w"], fedavg2["w"])
+    del got, fedavg
+
+
+def test_missing_steps_rejected():
+    nova = FedNova()
+    with pytest.raises(ValueError, match="step count"):
+        nova.accumulate([([{"w": np.ones(2, np.float32)}], 1.0)])
+    with pytest.raises(ValueError, match="step count"):
+        nova.accumulate([([{"w": np.ones(2, np.float32)}], 1.0)],
+                        steps=[1.0, 2.0])
+
+
+def test_retry_does_not_double_step():
+    """result() stages; only commit() advances the step-from point — an
+    aggregation-failure retry recomputes from the same x."""
+    models = _models(2, seed=3)
+    pairs = [([m], 0.5) for m in models]
+    x = {"w": np.zeros(6, np.float32), "b": np.zeros(2, np.float32),
+         "step": np.asarray(0, np.int64)}
+    nova = FedNova()
+    nova.seed_community(x)
+    nova.reset()
+    nova.accumulate(pairs, steps=[3.0, 5.0])
+    first = nova.result()
+    # simulated failure: no commit; retry the same round
+    nova.reset()
+    nova.accumulate(pairs, steps=[3.0, 5.0])
+    second = nova.result()
+    np.testing.assert_allclose(first["w"], second["w"], rtol=1e-6)
+    nova.commit()
+    # after commit the NEXT round steps from the new x
+    nova.reset()
+    nova.accumulate(pairs, steps=[3.0, 5.0])
+    third = nova.result()
+    assert not np.allclose(third["w"], second["w"])
+
+
+def test_state_roundtrip_through_checkpoint():
+    models = _models(2, seed=11)
+    pairs = [([m], 0.5) for m in models]
+    x = {"w": np.ones(6, np.float32), "b": np.ones(2, np.float32),
+         "step": np.asarray(0, np.int64)}
+    nova = FedNova()
+    nova.seed_community(x)
+    state = nova.export_state()
+
+    fresh = make_aggregation_rule("fednova")
+    fresh.restore_state(state)
+    got = fresh.aggregate(pairs, steps=[2.0, 4.0])
+    want = nova.aggregate(pairs, steps=[2.0, 4.0])
+    np.testing.assert_allclose(got["w"], want["w"], rtol=1e-6)
+    # rule mismatch fails loudly
+    with pytest.raises(ValueError, match="fednova"):
+        fresh.restore_state({"rule": "fedavgm"})
+
+
+def test_fednova_federation_learns():
+    """End to end through the controller's fold branch (steps plumbing)."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from tests.test_federation_inprocess import _shards
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fednova",
+                                      scaler="train_dataset_size"),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.1),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=3),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(3)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(3, timeout_s=120)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.6, f"fednova federation failed to learn: {last}"
+    finally:
+        fed.shutdown()

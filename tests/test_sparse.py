@@ -1,0 +1,334 @@
+"""Top-k sparsified uplink with error feedback (tensor/sparse.py +
+TrainParams.ship_dtype='topk<D>')."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.tensor.sparse import (
+    IDX_SUFFIX,
+    SHAPE_SUFFIX,
+    VAL_SUFFIX,
+    densify_named,
+    is_sparse,
+    parse_topk,
+    sparsify_update,
+)
+
+
+def test_parse_topk_spellings():
+    assert parse_topk("topk16") == 16
+    assert parse_topk("TopK4") == 4
+    assert parse_topk("topk") == 16       # default denominator
+    assert parse_topk("bf16") is None
+    assert parse_topk("int8q") is None
+    with pytest.raises(ValueError, match="denominator"):
+        parse_topk("topk0")
+
+
+def test_sparsify_keeps_largest_and_densify_reconstructs():
+    rng = np.random.default_rng(0)
+    ref = {"w": rng.standard_normal(256).astype(np.float32)}
+    update = np.zeros(256, np.float32)
+    update[[3, 100, 200, 255]] = [5.0, -4.0, 3.0, -2.0]
+    # plus small noise everywhere that must NOT displace the big entries
+    update += rng.standard_normal(256).astype(np.float32) * 1e-3
+    new = {"w": ref["w"] + update}
+    residual = {}
+    named = sparsify_update(list(new.items()), ref, 64, residual)
+    names = [n for n, _ in named]
+    assert names == ["w" + IDX_SUFFIX, "w" + VAL_SUFFIX, "w" + SHAPE_SUFFIX]
+    assert is_sparse(names)
+    d = dict(named)
+    assert d["w" + IDX_SUFFIX].size == 4  # ceil(256/64)
+    assert set(np.asarray(d["w" + IDX_SUFFIX])) == {3, 100, 200, 255}
+    dense = densify_named(d, ref)
+    # the four shipped coordinates are exact; the rest equal the reference
+    np.testing.assert_allclose(dense["w"][[3, 100, 200, 255]],
+                               new["w"][[3, 100, 200, 255]], rtol=1e-6)
+    # everything dropped went into the residual, not the void
+    assert residual["w"].shape == (256,)
+    np.testing.assert_allclose(
+        np.asarray(dense["w"]) + residual["w"].reshape(256),
+        np.asarray(new["w"]), rtol=1e-5, atol=1e-7)
+
+
+def test_error_feedback_ships_deferred_coordinates_later():
+    """A persistent small coordinate dropped in round 1 accumulates in the
+    residual and wins a top-k slot in a later round."""
+    n, denom = 128, 128  # k=1: only the single largest entry ships
+    ref = {"w": np.zeros(n, np.float32)}
+    residual = {}
+    big, small = 7, 42
+    shipped_small = 0.0
+    community = np.zeros(n, np.float32)
+    for _ in range(4):
+        update = np.zeros(n, np.float32)
+        update[big] = 1.0
+        update[small] = 0.6  # persistent but never the max in round 1
+        new = {"w": community + update}
+        named = sparsify_update(list(new.items()), {"w": community},
+                                denom, residual)
+        dense = densify_named(dict(named), {"w": community})
+        community = dense["w"]
+        shipped_small = community[small]
+        if shipped_small > 0:
+            break
+    # 0.6 + 0.6 > 1.0: the residual pushed the small coordinate past the
+    # big one by round 2
+    assert shipped_small >= 1.0
+
+
+def test_passthrough_ints_tiny_and_shape_drift():
+    ref = {"w": np.zeros((4, 4), np.float32)}
+    residual = {"gone": np.ones(8, np.float32)}
+    named = sparsify_update(
+        [("step", np.asarray(3, np.int64)),       # integer
+         ("w", np.ones((4, 4), np.float32)),      # tiny (< MIN_SPARSE_SIZE)
+         ("gone", np.ones(8, np.float32))],       # no ref -> dense + reset
+        ref, 4, residual)
+    d = dict(named)
+    assert set(d) == {"step", "w", "gone"}
+    assert "gone" not in residual  # residual reset on drift
+    back = densify_named(d, ref)
+    np.testing.assert_array_equal(back["w"], 1.0)
+    assert back["step"] == 3
+
+
+def test_densify_rejects_bad_payloads():
+    ref = {"w": np.zeros(128, np.float32)}
+    residual = {}
+    named = dict(sparsify_update(
+        [("w", np.arange(128, dtype=np.float32))], ref, 8, residual))
+    with pytest.raises(ValueError, match="no community tensor"):
+        densify_named(named, {})
+    evil = dict(named)
+    evil["w" + IDX_SUFFIX] = np.asarray([999999], np.int32)
+    evil["w" + VAL_SUFFIX] = np.asarray([1.0], np.float32)
+    with pytest.raises(ValueError, match="out of range"):
+        densify_named(evil, ref)
+    missing = {"w" + VAL_SUFFIX: named["w" + VAL_SUFFIX]}
+    with pytest.raises(ValueError, match="companion"):
+        densify_named(missing, ref)
+
+
+def test_name_collision_rejected():
+    with pytest.raises(ValueError, match="collides"):
+        sparsify_update([("w" + VAL_SUFFIX, np.ones(128, np.float32))],
+                        {}, 4, {})
+
+
+def test_bandwidth_shrinks_by_about_half_denom():
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    arr = np.random.default_rng(1).standard_normal(65536).astype(np.float32)
+    ref = {"w": np.zeros(65536, np.float32)}
+    plain = ModelBlob(tensors=[("w", arr)]).to_bytes()
+    sparse = ModelBlob(tensors=sparsify_update(
+        [("w", arr)], ref, 16, {})).to_bytes()
+    # idx(int32) + val(f32) per kept entry: 16/2 = 8x smaller (minus headers)
+    assert len(sparse) < len(plain) / 7
+
+
+def test_topk_federation_learns():
+    """End to end: sparse uplink + controller-side densification still
+    converges (error feedback carries the dropped mass across rounds)."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.tensor.pytree import ModelBlob
+    from tests.test_federation_inprocess import _shards
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.1,
+                          ship_dtype="topk4"),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=4),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(3)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(4, timeout_s=180)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        # the community model is dense f32 (densified before aggregation)
+        blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
+        assert not is_sparse([n for n, _ in blob.tensors])
+        assert {np.asarray(a).dtype for _, a in blob.tensors} == {
+            np.dtype(np.float32)}
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.6, f"topk federation failed to learn: {last}"
+    finally:
+        fed.shutdown()
+
+
+def test_topk_rejected_with_secure_and_async():
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, FederationConfig,
+                                    SecureAggConfig)
+
+    with pytest.raises(ValueError, match="topk"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="participants"),
+            secure=SecureAggConfig(enabled=True, scheme="ckks"),
+            train=TrainParams(ship_dtype="topk16"))
+    with pytest.raises(ValueError, match="synchronous"):
+        FederationConfig(
+            protocol="asynchronous",
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(ship_dtype="topk16"))
+    # a bad denominator fails at config time, not after round 1
+    with pytest.raises(ValueError, match="denominator"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(ship_dtype="topk0"))
+
+
+def test_densify_rejects_duplicate_indices():
+    ref = {"w": np.zeros(128, np.float32)}
+    named = dict(sparsify_update(
+        [("w", np.arange(128, dtype=np.float32))], ref, 8, {}))
+    evil = dict(named)
+    evil["w" + IDX_SUFFIX] = np.asarray([5, 5], np.int32)
+    evil["w" + VAL_SUFFIX] = np.asarray([1.0, 2.0], np.float32)
+    with pytest.raises(ValueError, match="duplicate"):
+        densify_named(evil, ref)
+
+
+def test_residuals_pruned_for_renamed_tensors():
+    residual = {"old_layer": np.ones(1 << 20, np.float32)}
+    sparsify_update([("new_layer", np.ones(128, np.float32))],
+                    {"new_layer": np.zeros(128, np.float32)}, 4, residual)
+    assert "old_layer" not in residual
+    assert "new_layer" in residual
+
+
+def test_stale_topk_completion_dropped_not_stored(monkeypatch):
+    """A post-deadline topk completion must NOT be densified against the
+    advanced community model and stored (it would poison later rounds);
+    dense-uplink stale completions keep the store-for-later behavior."""
+    from metisfl_tpu.comm.messages import TaskResult, TrainParams
+    from metisfl_tpu.config import (AggregationConfig, FederationConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.controller.core import Controller
+
+    class _NopProxy:
+        def run_task(self, task):
+            pass
+
+        def evaluate(self, task, callback):
+            pass
+
+        def shutdown(self):
+            pass
+
+    def make(ship):
+        cfg = FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(ship_dtype=ship),
+            termination=TerminationConfig(federation_rounds=1),
+        )
+        return Controller(cfg, lambda record: _NopProxy())
+
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    for ship, expect_stored in (("topk4", False), ("", True)):
+        ctl = make(ship)
+        reply = ctl.join(__import__("metisfl_tpu.comm.messages",
+                                    fromlist=["JoinRequest"]).JoinRequest(
+            hostname="h", port=1, num_train_examples=10))
+        lid = reply.learner_id
+        # seed a community model so densify would have a reference
+        ctl.set_community_model(ModelBlob(tensors=[
+            ("w", np.zeros(128, np.float32))]).to_bytes())
+        # mark the task expired (deadline fired before completion)
+        task_id = "t1"
+        ctl._expired_tasks[task_id] = None
+        if ship:
+            payload = ModelBlob(tensors=sparsify_update(
+                [("w", np.ones(128, np.float32))],
+                {"w": np.zeros(128, np.float32)}, 4, {})).to_bytes()
+        else:
+            payload = ModelBlob(tensors=[
+                ("w", np.ones(128, np.float32))]).to_bytes()
+        ctl._handle_completed(TaskResult(
+            task_id=task_id, learner_id=lid, auth_token=reply.auth_token,
+            round_id=0, model=payload, num_train_examples=10,
+            completed_steps=1, completed_epochs=1, completed_batches=1))
+        stored = ctl._store.select({lid: 1})
+        assert bool(stored.get(lid)) == expect_stored, (ship, stored)
+        ctl.shutdown()
+
+
+def test_malformed_topk_payload_drops_contribution_not_round():
+    """A bad sparse payload (dup indices etc.) must not stall the sync
+    barrier: the contribution is dropped, the handler does not raise, and
+    the round error trail records it."""
+    from metisfl_tpu.comm.messages import (JoinRequest, TaskResult,
+                                           TrainParams)
+    from metisfl_tpu.config import (AggregationConfig, FederationConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.controller.core import Controller
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    class _NopProxy:
+        def run_task(self, task):
+            pass
+
+        def evaluate(self, task, callback):
+            pass
+
+        def shutdown(self):
+            pass
+
+    cfg = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(ship_dtype="topk4"),
+        termination=TerminationConfig(federation_rounds=1),
+    )
+    ctl = Controller(cfg, lambda record: _NopProxy())
+    try:
+        reply = ctl.join(JoinRequest(hostname="h", port=1,
+                                     num_train_examples=10))
+        ctl.set_community_model(ModelBlob(tensors=[
+            ("w", np.zeros(128, np.float32))]).to_bytes())
+        evil = ModelBlob(tensors=[
+            ("w" + IDX_SUFFIX, np.asarray([5, 5], np.int32)),
+            ("w" + VAL_SUFFIX, np.asarray([1.0, 2.0], np.float32)),
+            ("w" + SHAPE_SUFFIX, np.asarray([128], np.int64)),
+        ]).to_bytes()
+        ctl._handle_completed(TaskResult(
+            task_id="t1", learner_id=reply.learner_id,
+            auth_token=reply.auth_token, round_id=0, model=evil,
+            num_train_examples=10, completed_steps=1, completed_epochs=1,
+            completed_batches=1))  # must not raise
+        assert not ctl._store.select({reply.learner_id: 1}).get(
+            reply.learner_id)
+        # the barrier advanced (the handler completed the round rather
+        # than stalling), so the error landed in the archived round's
+        # metadata lineage
+        all_errors = [e for m in ctl.round_metadata for e in m.errors]
+        all_errors += list(ctl._current_meta.errors)
+        assert any("malformed" in e for e in all_errors), all_errors
+    finally:
+        ctl.shutdown()
