@@ -136,6 +136,16 @@ class TrainParams(Message):
     # (opaque payloads) and with ship_dtype='topk...' (sparse updates
     # reconstruct against the controller's exact f32 model).
     downlink_dtype: str = ""
+    # FedBN-style personalization (Li et al., ICLR 2021): tensors whose
+    # flattened name matches this regex stay LOCAL to each learner — they
+    # never ship to the controller, drop out of the community model after
+    # round 1, and each learner retains (and evaluates with) its own
+    # values. The canonical use is BatchNorm under feature-shift non-IID:
+    # local_tensor_regex="batch_stats|/bn" keeps running stats AND the
+    # learnable scale/bias per-learner. Incompatible with secure
+    # aggregation and with stateful server rules (fedavgm/fedadam/
+    # fedyogi/fednova/scaffold track a full model tree) — config-checked.
+    local_tensor_regex: str = ""
     # Client-level differential privacy on the shipped update
     # (secure/dp.py): the delta vs the received community model is
     # L2-clipped to dp_clip_norm (> 0 enables; also a robustness tool on
@@ -214,6 +224,11 @@ class EvalTask(Message):
     batch_size: int = 256
     datasets: List[str] = field(default_factory=lambda: ["test"])
     metrics: List[str] = field(default_factory=lambda: ["loss", "accuracy"])
+    # FedBN (TrainParams.local_tensor_regex): round-2+ community blobs
+    # omit the local tensors, and a learner that has never trained (not
+    # yet sampled, or crash-rejoined) must still be able to reconstruct
+    # the model — the regex rides every eval/infer task too
+    local_tensor_regex: str = ""
 
 
 @dataclass
@@ -245,6 +260,8 @@ class InferTask(Message):
     # engine (models/generate.py): inputs are token prompts, the result
     # packs the generated continuations instead of logits
     generate_tokens: int = 0
+    # FedBN merge for partial community blobs (see EvalTask)
+    local_tensor_regex: str = ""
     temperature: float = 0.0    # 0 = greedy
     top_k: int = 0
     eos_id: int = -1            # < 0 = no early stop

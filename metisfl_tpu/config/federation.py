@@ -258,6 +258,34 @@ class FederationConfig:
                     "semi_synchronous protocol (async advances the "
                     "community model mid-task, breaking sparse-update "
                     "reconstruction)")
+        if self.train.local_tensor_regex:
+            import re as _re
+
+            try:
+                _re.compile(self.train.local_tensor_regex)
+            except _re.error as exc:
+                raise ValueError(
+                    f"local_tensor_regex does not compile: {exc}") from None
+            if self.secure.enabled:
+                raise ValueError(
+                    "local_tensor_regex is incompatible with secure "
+                    "aggregation (partial trees break the uniform-shape "
+                    "masking/HE payload contract)")
+            stateful = ("fedavgm", "fedadam", "fedyogi", "fednova",
+                        "scaffold")
+            if self.aggregation.rule.lower() in stateful:
+                raise ValueError(
+                    f"local_tensor_regex is incompatible with rule="
+                    f"{self.aggregation.rule!r}: stateful server rules "
+                    "track a full model tree, but local tensors drop out "
+                    "of the aggregate after round 1")
+            if self.train.dp_clip_norm > 0.0:
+                raise ValueError(
+                    "local_tensor_regex is incompatible with client-level "
+                    "DP: the clip norm is computed over the full update, "
+                    "so never-shipped local tensors (e.g. BatchNorm "
+                    "running stats) would consume the sensitivity budget "
+                    "and silently crush the shipped update")
         if self.train.downlink_dtype:
             import numpy as _np
 

@@ -1061,6 +1061,7 @@ class Controller:
                 batch_size=cfg.batch_size,
                 datasets=list(cfg.datasets),
                 metrics=list(cfg.metrics),
+                local_tensor_regex=self.config.train.local_tensor_regex,
             )
             with self._lock:
                 meta.eval_submitted_at[record.learner_id] = time.time()

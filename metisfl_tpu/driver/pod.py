@@ -59,6 +59,13 @@ class PodFederationDriver:
             raise ValueError(
                 "pod transport does not implement client-level DP "
                 "(dp_clip_norm); use the host path for DP federations")
+        if config.train.local_tensor_regex:
+            # same rule: the on-device psum averages EVERY variable —
+            # silently aggregating tensors the config says stay local
+            # would be the opposite of the FedBN guarantee
+            raise ValueError(
+                "pod transport does not implement FedBN local tensors "
+                "(local_tensor_regex); use the host path")
         self.config = config
         self.datasets = list(train_datasets)
         self.test_dataset = test_dataset

@@ -531,6 +531,7 @@ class DriverSession:
             temperature=temperature,
             top_k=top_k,
             eos_id=-1 if eos_id is None else int(eos_id),
+            local_tensor_regex=self.config.train.local_tensor_regex,
         )
         client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,
                            ssl=self.config.ssl)

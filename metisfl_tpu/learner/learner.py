@@ -100,6 +100,22 @@ class Learner:
         # deferred coordinates, which error feedback tolerates (they were
         # never acknowledged anywhere).
         self._ef_residual: Dict[str, np.ndarray] = {}
+        # FedBN-style local parameters (TrainParams.local_tensor_regex):
+        # matching tensors never ship and are retained at their local
+        # values when absent from an incoming community model. Remembered
+        # from the last train task so eval-task model loads merge too.
+        # _local_values holds the learner's current copies: evals run on
+        # fire-and-forget threads CONCURRENTLY with training, and the
+        # engine's variable slot points at donated (deleted) buffers while
+        # a train step is in flight — merging must never read it from an
+        # eval thread. The dict is rebound atomically on the serialized
+        # train thread only.
+        self._local_regex: str = ""
+        self._local_values: Dict[str, np.ndarray] = {}
+        # the regex _local_values was snapshotted under: a widened regex
+        # (controller reconfigured mid-run) must trigger a re-snapshot or
+        # merges miss the newly-local names
+        self._snapshot_regex: str = ""
 
     # ------------------------------------------------------------------ #
     # membership
@@ -154,16 +170,93 @@ class Learner:
                               .reshape(spec.shape)))
         else:
             named = blob.tensors
+        named = self._merge_local(named)
         tree = named_tensors_to_pytree(named, self._treedef_like)
         return jax.tree.map(
             lambda a, t: a if a.dtype == t.dtype else np.asarray(a, t.dtype),
             tree, self._treedef_like)
 
+    def _merge_local(self, named):
+        """FedBN merge (Li et al., ICLR 2021): tensors the federation
+        leaves local (local_tensor_regex) are absent from community blobs
+        after round 1 — fill them from this learner's own snapshot copies
+        (_local_values) so the reconstructed tree is complete and
+        personalized. Reads only the snapshot dict, never the live engine
+        slot (see the field comment: concurrent evals vs donation)."""
+        if not self._local_regex:
+            return named
+        have = {n for n, _ in named}
+        out = list(named)
+        for name, arr in self._local_values.items():
+            if name not in have:
+                out.append((name, arr))
+        return out
+
+    def _adopt_local_regex(self, regex: str) -> None:
+        """Adopt the FedBN regex from an eval/infer task (a learner that
+        has never trained — not yet sampled, crash-rejoined — still
+        receives partial round-2+ blobs; a reconfigured controller can
+        also widen the regex mid-run). Snapshots from the live engine only
+        when no train is in flight — the engine slot holds donated buffers
+        mid-step — falling back to the construction-time initial values
+        (never donated: every train replaces the slot via set_variables
+        first), which the in-flight train's own post-run snapshot then
+        supersedes."""
+        if regex:
+            self._local_regex = regex
+        if not self._local_regex or self._snapshot_regex == self._local_regex:
+            return
+        with self._task_lock:
+            fut = self._current_future
+            busy = fut is not None and not fut.done()
+        if not busy:
+            self._snapshot_local()
+            return
+        import re
+
+        self._local_values = {
+            name: np.array(arr)
+            for name, arr in pytree_to_named_tensors(self._treedef_like)
+            if re.search(self._local_regex, name)
+        }
+        self._snapshot_regex = self._local_regex
+
+    def _snapshot_local(self) -> None:
+        """Refresh _local_values from the engine. Call ONLY on the
+        serialized train-executor thread with no train step in flight."""
+        if not self._local_regex:
+            self._local_values = {}
+            self._snapshot_regex = ""
+            return
+        import re
+
+        self._local_values = {
+            name: np.array(arr)
+            for name, arr in pytree_to_named_tensors(
+                self.model_ops.get_variables())
+            if re.search(self._local_regex, name)
+        }
+        self._snapshot_regex = self._local_regex
+
+    def _drop_local(self, named):
+        """Uplink filter: local tensors never ship."""
+        if not self._local_regex:
+            return named
+        import re
+
+        kept = [(n, a) for n, a in named
+                if not re.search(self._local_regex, n)]
+        if not kept:
+            raise ValueError(
+                f"local_tensor_regex {self._local_regex!r} matches every "
+                "tensor — nothing would ever be aggregated")
+        return kept
+
     def _dump_model(self, ship_dtype: str = "",
                     variables=None) -> bytes:
         if variables is None:
             variables = self.model_ops.get_variables()
-        named = pytree_to_named_tensors(variables)
+        named = self._drop_local(pytree_to_named_tensors(variables))
         if self.secure_backend is not None:
             from metisfl_tpu.tensor.spec import TensorSpec, wire_dtype_of, TensorKind
             opaque = {}
@@ -195,7 +288,7 @@ class Learner:
 
         variables = (ship_vars if ship_vars is not None
                      else self.model_ops.get_variables())
-        named = pytree_to_named_tensors(variables)
+        named = self._drop_local(pytree_to_named_tensors(variables))
         ref = dict(pytree_to_named_tensors(incoming))
         return ModelBlob(tensors=sparsify_update(
             named, ref, denom, self._ef_residual)).to_bytes()
@@ -218,6 +311,27 @@ class Learner:
         self._cancel.clear()
         try:
             params = task.params
+            # set BEFORE _load_model: round-2+ community blobs omit the
+            # local tensors and the load must merge them back (snapshot
+            # refreshes whenever the effective regex differs from the one
+            # the current snapshot was taken under — no train step is in
+            # flight on this serialized thread)
+            self._local_regex = params.local_tensor_regex
+            if self._local_regex != self._snapshot_regex:
+                self._snapshot_local()
+            if params.local_tensor_regex:
+                # fail BEFORE paying for local training (and before the
+                # round stalls to its deadline): a regex that localizes
+                # every tensor means nothing would ever aggregate
+                import re as _re
+                names = [n for n, _ in
+                         pytree_to_named_tensors(self._treedef_like)]
+                if all(_re.search(params.local_tensor_regex, n)
+                       for n in names):
+                    raise ValueError(
+                        f"local_tensor_regex "
+                        f"{params.local_tensor_regex!r} matches every "
+                        "tensor — nothing would ever be aggregated")
             if params.ship_dtype:
                 from metisfl_tpu.tensor.quantize import SHIP_INT8Q
                 from metisfl_tpu.tensor.sparse import parse_topk
@@ -255,6 +369,9 @@ class Learner:
             out = self.model_ops.train(self.datasets["train"], params,
                                        cancel_event=self._cancel,
                                        **train_kwargs)
+            # training updated the local tensors (e.g. BatchNorm stats):
+            # refresh the snapshot evals and later merges read from
+            self._snapshot_local()
             # round-scoped mask derivation (pairwise-masking secure agg)
             if self.secure_backend is not None and hasattr(
                     self.secure_backend, "begin_round"):
@@ -348,6 +465,7 @@ class Learner:
     def evaluate(self, task: EvalTask) -> EvalResult:
         """Blocking community-model evaluation over requested datasets."""
         t0 = time.time()
+        self._adopt_local_regex(task.local_tensor_regex)
         # Evaluate on an explicit variables tree so a concurrently running
         # training task never races on the engine's model slot.
         variables = self._load_model(task.model)
@@ -383,6 +501,7 @@ class Learner:
         third task type, learner.py:311-330): predictions over explicit
         inputs or a named local split."""
         t0 = time.time()
+        self._adopt_local_regex(task.local_tensor_regex)
         variables = self._load_model(task.model) if task.model else None
         if task.inputs:
             blob = ModelBlob.from_bytes(task.inputs)

@@ -1,0 +1,217 @@
+"""FedBN-style local parameters (TrainParams.local_tensor_regex)."""
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                FederationConfig, SecureAggConfig,
+                                TerminationConfig)
+from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+from metisfl_tpu.models.zoo import MLP
+from metisfl_tpu.tensor.pytree import ModelBlob, pytree_to_named_tensors
+
+
+class _BNNet(nn.Module):
+    """Tiny Conv+BatchNorm+Dense classifier for FedBN tests."""
+
+    classes: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(8, (3,))(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.5)(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.classes)(x)
+
+
+def _learner(engine):
+    from metisfl_tpu.learner.learner import Learner
+
+    ds = ArrayDataset(np.zeros((4, 8), np.float32),
+                      np.zeros((4,), np.int32))
+    return Learner(engine, ds, controller=None)
+
+
+def test_drop_and_merge_local_tensors():
+    engine = FlaxModelOps(MLP(features=(8,), num_outputs=3),
+                          np.zeros((2, 8), np.float32))
+    ln = _learner(engine)
+    full_names = [n for n, _ in
+                  pytree_to_named_tensors(engine.get_variables())]
+    target = [n for n in full_names if n.endswith("bias")]
+    assert target
+
+    # no regex: everything ships
+    blob = ModelBlob.from_bytes(ln._dump_model())
+    assert [n for n, _ in blob.tensors] == full_names
+
+    ln._local_regex = "bias"
+    ln._snapshot_local()
+    blob = ModelBlob.from_bytes(ln._dump_model())
+    shipped = [n for n, _ in blob.tensors]
+    assert all("bias" not in n for n in shipped)
+    assert len(shipped) == len(full_names) - len(target)
+
+    # a partial community blob loads: missing local tensors come from the
+    # learner's own current values
+    local_before = {
+        n: np.asarray(a).copy()
+        for n, a in pytree_to_named_tensors(engine.get_variables())
+        if "bias" in n}
+    tree = ln._load_model(blob.to_bytes())
+    for n, a in pytree_to_named_tensors(tree):
+        if "bias" in n:
+            np.testing.assert_array_equal(a, local_before[n])
+
+    # matching everything is a loud error, not a silent no-op federation
+    ln._local_regex = "."
+    with pytest.raises(ValueError, match="matches every"):
+        ln._dump_model()
+
+
+def test_fedbn_config_rejections():
+    base = dict(aggregation=AggregationConfig(rule="fedavg",
+                                              scaler="participants"))
+    with pytest.raises(ValueError, match="compile"):
+        FederationConfig(train=TrainParams(local_tensor_regex="["), **base)
+    with pytest.raises(ValueError, match="secure"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="participants"),
+            secure=SecureAggConfig(enabled=True, scheme="ckks"),
+            train=TrainParams(local_tensor_regex="bn"))
+    with pytest.raises(ValueError, match="stateful"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedadam",
+                                          scaler="participants"),
+            train=TrainParams(local_tensor_regex="bn"))
+
+
+def test_fedbn_federation_personalizes_and_learns():
+    """Feature-shifted non-IID: each learner's inputs have a different
+    scale. With BatchNorm kept local (params + running stats), the
+    federation converges and each learner ends with its own stats."""
+    from metisfl_tpu.driver import InProcessFederation
+
+    rng = np.random.default_rng(0)
+    centers = np.eye(3, 8, dtype=np.float32) * 3
+
+    def shard(scale, n=150):
+        y = rng.integers(0, 3, n).astype(np.int32)
+        x = (centers[y] + rng.standard_normal((n, 8)).astype(np.float32))
+        return ArrayDataset((x * scale)[:, :, None], y)
+
+    scales = [0.5, 1.0, 2.0]
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.05,
+                          local_tensor_regex="batch_stats|BatchNorm"),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=4),
+    )
+    fed = InProcessFederation(config)
+    engines = []
+    template = None
+    for s in scales:
+        ds = shard(s)
+        engine = FlaxModelOps(_BNNet(), ds.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        engines.append(engine)
+        fed.add_learner(engine, ds, test_dataset=shard(s, 90))
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(4, timeout_s=180)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        # community model lost the local tensors after round 1
+        blob = ModelBlob.from_bytes(fed.controller.community_model_bytes())
+        names = [n for n, _ in blob.tensors]
+        assert names and all("batch_stats" not in n
+                             and "BatchNorm" not in n for n in names)
+        # and the federation learned
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        last = np.mean([v["test"]["accuracy"]
+                        for v in evals[-1]["evaluations"].values()])
+        assert last > 0.6, f"fedbn federation failed to learn: {last}"
+    finally:
+        fed.shutdown()
+    # each learner kept ITS OWN BatchNorm state (feature shift makes the
+    # running means genuinely different). Read engines only AFTER
+    # shutdown: mid-training the engine slot references donated buffers
+    # by design (training is in flight on the learner executor).
+    stats = []
+    for engine in engines:
+        bs = {n: np.asarray(a) for n, a in pytree_to_named_tensors(
+            engine.get_variables())
+            if "batch_stats" in n and "mean" in n}
+        assert bs
+        stats.append(np.concatenate([bs[k].ravel() for k in sorted(bs)]))
+    assert not np.allclose(stats[0], stats[2], atol=1e-3)
+
+
+def test_never_trained_learner_evaluates_partial_blob():
+    """A learner that was never sampled for training still evaluates a
+    round-2+ community blob: the regex rides the EvalTask and missing
+    local tensors come from the learner's initial values."""
+    from metisfl_tpu.comm.messages import EvalTask
+
+    engine = FlaxModelOps(_BNNet(), np.zeros((2, 8, 1), np.float32))
+    ln = _learner(engine)
+    ln.datasets["test"] = ArrayDataset(
+        np.random.default_rng(0).standard_normal((16, 8, 1)).astype(
+            np.float32),
+        np.zeros((16,), np.int32))
+    full = pytree_to_named_tensors(engine.get_variables())
+    partial = [(n, a) for n, a in full
+               if "batch_stats" not in n and "BatchNorm" not in n]
+    assert len(partial) < len(full)
+    task = EvalTask(task_id="e1", model=ModelBlob(tensors=partial).to_bytes(),
+                    datasets=["test"], batch_size=8,
+                    local_tensor_regex="batch_stats|BatchNorm")
+    result = ln.evaluate(task)  # must not raise KeyError
+    assert "test" in result.evaluations
+
+
+def test_fedbn_rejected_with_dp_and_pod():
+    with pytest.raises(ValueError, match="DP"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="fedavg",
+                                          scaler="participants"),
+            train=TrainParams(local_tensor_regex="bn", dp_clip_norm=1.0,
+                              dp_noise_multiplier=0.1))
+    # the pod transport psum-averages every variable: it must refuse the
+    # config instead of silently ignoring the FedBN guarantee
+    from metisfl_tpu.driver.pod import PodFederationDriver
+
+    cfg = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1,
+                          local_tensor_regex="bn"))
+    ds = ArrayDataset(np.zeros((8, 8), np.float32),
+                      np.zeros((8,), np.int32))
+    with pytest.raises(ValueError, match="local_tensor_regex"):
+        PodFederationDriver(cfg, MLP(features=(4,), num_outputs=3),
+                            [ds, ds])
+
+
+def test_adopt_widened_regex_resnapshots():
+    """A controller reconfigured with a wider regex mid-run: the eval-path
+    adoption must re-snapshot, or merges miss the newly-local names."""
+    engine = FlaxModelOps(MLP(features=(8,), num_outputs=3),
+                          np.zeros((2, 8), np.float32))
+    ln = _learner(engine)
+    ln._adopt_local_regex("bias")
+    assert ln._local_values and all("bias" in n for n in ln._local_values)
+    ln._adopt_local_regex("bias|kernel")
+    assert any("kernel" in n for n in ln._local_values)
+    # unchanged regex: no-op (snapshot identity preserved)
+    before = ln._local_values
+    ln._adopt_local_regex("bias|kernel")
+    assert ln._local_values is before
