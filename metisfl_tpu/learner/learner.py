@@ -207,11 +207,15 @@ class Learner:
         if not self._local_regex or self._snapshot_regex == self._local_regex:
             return
         with self._task_lock:
+            # check AND snapshot under the task lock: run_task also
+            # submits under it, so no train can start (and begin donating
+            # the engine buffers) between the busy check and the engine
+            # read — and a train submitted after our snapshot will
+            # re-snapshot itself post-run, so ordering stays correct
             fut = self._current_future
-            busy = fut is not None and not fut.done()
-        if not busy:
-            self._snapshot_local()
-            return
+            if fut is None or fut.done():
+                self._snapshot_local()
+                return
         import re
 
         self._local_values = {
@@ -322,16 +326,10 @@ class Learner:
             if params.local_tensor_regex:
                 # fail BEFORE paying for local training (and before the
                 # round stalls to its deadline): a regex that localizes
-                # every tensor means nothing would ever aggregate
-                import re as _re
-                names = [n for n, _ in
-                         pytree_to_named_tensors(self._treedef_like)]
-                if all(_re.search(params.local_tensor_regex, n)
-                       for n in names):
-                    raise ValueError(
-                        f"local_tensor_regex "
-                        f"{params.local_tensor_regex!r} matches every "
-                        "tensor — nothing would ever be aggregated")
+                # every tensor means nothing would ever aggregate.
+                # _drop_local raises on exactly that condition.
+                self._drop_local(
+                    pytree_to_named_tensors(self._treedef_like))
             if params.ship_dtype:
                 from metisfl_tpu.tensor.quantize import SHIP_INT8Q
                 from metisfl_tpu.tensor.sparse import parse_topk

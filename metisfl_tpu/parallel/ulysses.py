@@ -1,0 +1,87 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``sp`` axis.
+
+The second canonical long-context strategy next to ring attention
+(parallel/ringattn.py — the reference has neither, SURVEY.md §5.7).
+DeepSpeed-Ulysses (Jacobs et al.) re-shards INSIDE the attention op: the
+inputs arrive sequence-sharded (each device holds L/sp of every head);
+all_to_alls scatter heads and gather sequence so each device holds the
+FULL sequence for H/sp heads, attention runs entirely locally (no
+per-step communication), and a final all_to_all restores sequence
+sharding. Exact attention, four collectives per call (q/k/v scatters +
+the output gather), each moving one activation's worth of data once.
+
+Trade-offs vs the ring:
+- communication: 4 single-shot all-to-alls (q, k, v, o) vs ``sp - 1``
+  ppermute hops of K/V — Ulysses moves less total data once
+  ``2·(sp - 1) > 4`` per-activation transfers, i.e. sp ≥ 4 for MHA; the
+  ring wins for GQA long-context (its K/V hops ride at kv-head size,
+  while Ulysses' q/o legs are always full-width).
+- memory: Ulysses holds the full L per device (O(L·D·H/sp)) — the local
+  attention still avoids the (L, L) matrix via the routed flash kernel —
+  while the ring keeps O(L/sp) activations end to end.
+- parallel degree: Ulysses caps at the head count (sp must divide H);
+  the ring scales with the sequence itself.
+
+The local attention reuses :func:`metisfl_tpu.ops.flash_attention
+.attention` (seq-length-routed dense/flash, GQA-native), so its FA2
+accumulator and causal DMA elision apply here too. Grouped-query inputs
+scatter at kv-head size when ``Hkv % sp == 0`` (the head ranges align with
+the query groups); otherwise K/V are broadcast to query-head count first.
+
+Differentiation is plain autodiff: all_to_all transposes to all_to_all and
+the local attention brings its own VJP — no custom ring backward needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metisfl_tpu.ops.flash_attention import attention
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = False,
+                           min_flash_seq: Optional[int] = None):
+    """shard_map-wrapped Ulysses attention over GLOBAL (B, H, L, D) arrays
+    with the L dimension sharded over ``axis_name``. Same calling contract
+    as :func:`parallel.ringattn.make_ring_attention` — the two strategies
+    are drop-in alternatives."""
+    sp = mesh.shape[axis_name]
+    spec = P(None, None, axis_name, None)
+
+    def fn(q, k, v):
+        H, Hkv = q.shape[1], k.shape[1]
+        if H % sp:
+            raise ValueError(
+                f"ulysses parallelism degree ({axis_name}={sp}) must "
+                f"divide the query head count ({H}); use ring attention "
+                "to scale past the head count")
+        if Hkv % sp:
+            # head ranges would not align with the query groups after the
+            # scatter: broadcast K/V to query-head count (costs the GQA
+            # bandwidth saving on this path; the ring keeps it)
+            group = H // Hkv
+            k_full = jnp.repeat(k, group, axis=1)
+            v_full = jnp.repeat(v, group, axis=1)
+        else:
+            k_full, v_full = k, v
+
+        def scatter_heads(x):
+            # (B, H', L/sp, D) -> (B, H'/sp, L, D)
+            return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qh = scatter_heads(q)
+        kh = scatter_heads(k_full)
+        vh = scatter_heads(v_full)
+        o = attention(qh, kh, vh, causal, min_flash_seq=min_flash_seq)
+        # (B, H/sp, L, D) -> (B, H, L/sp, D)
+        return jax.lax.all_to_all(o, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)

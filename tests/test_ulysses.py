@@ -1,0 +1,90 @@
+"""Ulysses all-to-all sequence parallelism (parallel/ulysses.py) on the
+virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from metisfl_tpu.parallel.ringattn import reference_attention
+from metisfl_tpu.parallel.ulysses import make_ulysses_attention
+
+
+def _mesh(n, axis="sp"):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def _qkv(B=1, H=8, Hkv=None, L=64, D=16, seed=0):
+    rng = jax.random.PRNGKey(seed)
+    q = jax.random.normal(jax.random.fold_in(rng, 0), (B, H, L, D),
+                          jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(rng, 1),
+                          (B, Hkv or H, L, D), jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(rng, 2),
+                          (B, Hkv or H, L, D), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full_attention(causal):
+    q, k, v = _qkv()
+    got = make_ulysses_attention(_mesh(4), causal=causal)(q, k, v)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_ulysses_gqa_aligned_and_broadcast_paths():
+    # Hkv % sp == 0: K/V scatter at kv-head size (GQA-local attention)
+    q, k, v = _qkv(H=8, Hkv=4)
+    got = make_ulysses_attention(_mesh(4), causal=True)(q, k, v)
+    want = reference_attention(q, jnp.repeat(k, 2, 1), jnp.repeat(v, 2, 1),
+                               causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+    # Hkv % sp != 0: broadcast path
+    q, k, v = _qkv(H=8, Hkv=2)
+    got = make_ulysses_attention(_mesh(4), causal=True)(q, k, v)
+    want = reference_attention(q, jnp.repeat(k, 4, 1), jnp.repeat(v, 4, 1),
+                               causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
+def test_ulysses_gradients_match_oracle():
+    q, k, v = _qkv(H=4, L=32, D=8)
+    ul = make_ulysses_attention(_mesh(4), causal=True)
+
+    def loss_ul(q, k, v):
+        return jnp.sum(ul(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    gu = jax.grad(loss_ul, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    q, k, v = _qkv(H=6, L=32)
+    with pytest.raises(ValueError, match="head count"):
+        make_ulysses_attention(_mesh(4))(q, k, v)
+
+
+def test_ulysses_under_jit_with_sharded_inputs():
+    """The shard_map island composes under jit with explicitly sharded
+    global arrays (the way a training step would use it)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh(4)
+    q, k, v = _qkv(H=4, L=64)
+    sharding = NamedSharding(mesh, P(None, None, "sp", None))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    ul = jax.jit(make_ulysses_attention(mesh, causal=True))
+    got = ul(q, k, v)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
