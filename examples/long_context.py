@@ -34,6 +34,11 @@ def main() -> int:
                         help="0 = absorb remaining devices")
     parser.add_argument("--no-ring", action="store_true",
                         help="plain full attention baseline")
+    parser.add_argument("--strategy", choices=["ring", "ulysses"],
+                        default="ring",
+                        help="sequence-parallel schedule: ring (ppermute "
+                             "rotation, O(L/sp) memory) or ulysses "
+                             "(all-to-all head scatter)")
     parser.add_argument("--block-kernels", action="store_true",
                         help="run each ring hop on the pallas flash "
                              "kernels (no (Lc, Lc) score matrix, ever)")
@@ -41,6 +46,10 @@ def main() -> int:
     if args.no_ring and args.block_kernels:
         parser.error("--block-kernels selects the ring hop kernel; it "
                      "cannot combine with --no-ring (dense baseline)")
+    if args.strategy == "ulysses" and args.block_kernels:
+        parser.error("--block-kernels is ring-specific (per-hop block "
+                     "kernels); the ulysses local attention routes to "
+                     "the flash kernel on its own")
 
     from metisfl_tpu.platform import honor_platform_env
     honor_platform_env()
@@ -65,6 +74,7 @@ def main() -> int:
     module = LlamaLite(vocab_size=args.vocab, dim=args.dim, depth=args.depth,
                        heads=args.heads,
                        sp_mesh=None if args.no_ring else mesh,
+                       sp_strategy=args.strategy,
                        sp_block_kernels=args.block_kernels)
     ops = FlaxModelOps(module, ds.x[:2], mesh=mesh,
                        partition_rules=TRANSFORMER_RULES)
@@ -74,7 +84,7 @@ def main() -> int:
                                     learning_rate=0.01, optimizer="adam"))
     wall = time.time() - t0
     tokens = args.steps * args.batch_size * args.seq_len
-    print(f"{'ring' if not args.no_ring else 'full'} attention: "
+    print(f"{args.strategy if not args.no_ring else 'full'} attention: "
           f"{out.completed_steps} steps, loss {out.train_metrics['loss']:.3f}, "
           f"{tokens / wall:.0f} tok/s incl. compile, "
           f"{out.ms_per_step:.1f} ms/step steady")

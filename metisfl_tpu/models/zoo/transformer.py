@@ -102,8 +102,13 @@ class Attention(nn.Module):
     lora_rank: int = 0
     sp_mesh: object = None
     sp_axis: str = "sp"
+    # "ring" (blockwise ppermute rotation, O(L/sp) memory, scales with L)
+    # or "ulysses" (all-to-all head scatter, fewer collectives when
+    # sp <= heads) — see parallel/{ringattn,ulysses}.py for the trade-off
+    sp_strategy: str = "ring"
     # run each ring hop's block attention on the pallas flash kernels
-    # (ringattn.make_ring_attention(block_kernels=True))
+    # (ringattn.make_ring_attention(block_kernels=True)); ring-only — the
+    # ulysses local attention routes to the flash kernel on its own
     sp_block_kernels: bool = False
     use_flash: bool = False
     dtype: Any = None
@@ -166,10 +171,29 @@ class Attention(nn.Module):
             k = jnp.repeat(k, group, axis=1)
             v = jnp.repeat(v, group, axis=1)
         if self.sp_mesh is not None:
-            from metisfl_tpu.parallel.ringattn import make_ring_attention
-            out = make_ring_attention(
-                self.sp_mesh, self.sp_axis, causal=self.causal,
-                block_kernels=self.sp_block_kernels)(q, k, v)
+            if self.sp_strategy == "ulysses":
+                if self.sp_block_kernels:
+                    raise ValueError(
+                        "sp_block_kernels is ring-specific (per-hop block "
+                        "kernels); the ulysses local attention already "
+                        "routes to the flash kernel by sequence length")
+                from metisfl_tpu.parallel.ulysses import (
+                    make_ulysses_attention,
+                )
+                out = make_ulysses_attention(
+                    self.sp_mesh, self.sp_axis,
+                    causal=self.causal)(q, k, v)
+            elif self.sp_strategy == "ring":
+                from metisfl_tpu.parallel.ringattn import (
+                    make_ring_attention,
+                )
+                out = make_ring_attention(
+                    self.sp_mesh, self.sp_axis, causal=self.causal,
+                    block_kernels=self.sp_block_kernels)(q, k, v)
+            else:
+                raise ValueError(
+                    f"unknown sp_strategy {self.sp_strategy!r}; "
+                    "have 'ring' | 'ulysses'")
         elif self.use_flash:
             if self.use_flash == "auto":
                 # sequence-length routing: dense below the measured
@@ -391,6 +415,7 @@ class DecoderBlock(nn.Module):
     mlp_ratio: int = 4
     lora_rank: int = 0
     sp_mesh: object = None
+    sp_strategy: str = "ring"
     sp_block_kernels: bool = False
     use_flash: bool = False
     # > 0 replaces the SwiGLU FFN with a Switch MoE of this many experts
@@ -403,6 +428,7 @@ class DecoderBlock(nn.Module):
     def __call__(self, x, train: bool = False, cache=None, position=None):
         attn = Attention(self.dim, self.heads, causal=True, rotary=True,
                          lora_rank=self.lora_rank, sp_mesh=self.sp_mesh,
+                         sp_strategy=self.sp_strategy,
                          sp_block_kernels=self.sp_block_kernels,
                          use_flash=self.use_flash, dtype=self.dtype,
                          kv_heads=self.kv_heads,
@@ -498,9 +524,12 @@ class LlamaLite(nn.Module):
     heads: int = 4
     lora_rank: int = 0
     # sequence parallelism: a Mesh with an "sp" axis routes every block's
-    # attention through the ring schedule (long-context configs);
-    # sp_block_kernels runs each hop on the pallas flash kernels
+    # attention through the chosen schedule (long-context configs) —
+    # sp_strategy "ring" (ppermute rotation) or "ulysses" (all-to-all
+    # head scatter); sp_block_kernels runs each ring hop on the pallas
+    # flash kernels
     sp_mesh: object = None
+    sp_strategy: str = "ring"
     sp_block_kernels: bool = False
     # single-chip pallas flash-attention kernel (ops/flash_attention.py)
     use_flash: bool = False
@@ -532,6 +561,7 @@ class LlamaLite(nn.Module):
             block = block_cls(self.dim, self.heads,
                               lora_rank=self.lora_rank,
                               sp_mesh=self.sp_mesh,
+                              sp_strategy=self.sp_strategy,
                               sp_block_kernels=self.sp_block_kernels,
                               use_flash=self.use_flash,
                               moe_experts=self.moe_experts,

@@ -88,3 +88,28 @@ def test_ulysses_under_jit_with_sharded_inputs():
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5)
+
+
+def test_llamalite_trains_with_ulysses_strategy():
+    """The model zoo routes attention through ulysses when selected."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import TRANSFORMER_RULES, LlamaLite
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 64, (8, 32)).astype(np.int32)
+    ds = ArrayDataset(x, np.roll(x, -1, axis=1))
+    ops = FlaxModelOps(
+        LlamaLite(vocab_size=64, dim=32, depth=1, heads=4, sp_mesh=mesh,
+                  sp_strategy="ulysses"),
+        ds.x[:2], mesh=mesh, partition_rules=TRANSFORMER_RULES)
+    out = ops.train(ds, TrainParams(batch_size=4, local_steps=2,
+                                    optimizer="sgd", learning_rate=0.05))
+    assert np.isfinite(out.train_metrics["loss"])
+    # unknown strategy fails loudly
+    with pytest.raises(ValueError, match="sp_strategy"):
+        FlaxModelOps(
+            LlamaLite(vocab_size=64, dim=32, depth=1, heads=4,
+                      sp_mesh=mesh, sp_strategy="spiral"),
+            ds.x[:2], mesh=mesh, partition_rules=TRANSFORMER_RULES)
