@@ -133,8 +133,11 @@ def test_neuroimaging_regression_example(tmp_path):
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     # >= 2: training keeps running during the bounded eval-drain window,
-    # so an extra round may complete before shutdown
-    assert re.search(r"completed [2-9] rounds", proc.stdout)
+    # so ANY number of extra rounds may complete before shutdown (under
+    # load the drain can fit 8+ tiny rounds — a [2-9] single-digit match
+    # here flaked when the counter hit double digits)
+    m = re.search(r"completed (\d+) rounds", proc.stdout)
+    assert m and int(m.group(1)) >= 2, proc.stdout[-500:]
     assert "community test MAE" in proc.stdout
     with open(tmp_path / "experiment.json") as f:
         experiment = json.load(f)
