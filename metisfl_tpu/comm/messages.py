@@ -264,6 +264,7 @@ class InferTask(Message):
     local_tensor_regex: str = ""
     temperature: float = 0.0    # 0 = greedy
     top_k: int = 0
+    top_p: float = 0.0          # nucleus sampling mass; 0/1 = off
     eos_id: int = -1            # < 0 = no early stop
 
 

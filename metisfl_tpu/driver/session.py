@@ -493,7 +493,8 @@ class DriverSession:
                       dataset: str = "test", batch_size: int = 256,
                       max_examples: int = 0, timeout_s: float = 120.0,
                       generate_tokens: int = 0, temperature: float = 0.0,
-                      top_k: int = 0, eos_id: Optional[int] = None):
+                      top_k: int = 0, top_p: float = 0.0,
+                      eos_id: Optional[int] = None):
         """Run the community model's inference on one learner and return its
         predictions as a numpy array (the reference driver's counterpart to
         the learner's third task type, reference learner.py:311-330).
@@ -530,6 +531,7 @@ class DriverSession:
             generate_tokens=generate_tokens,
             temperature=temperature,
             top_k=top_k,
+            top_p=top_p,
             eos_id=-1 if eos_id is None else int(eos_id),
             local_tensor_regex=self.config.train.local_tensor_regex,
         )

@@ -530,6 +530,7 @@ class Learner:
                     prompts[i : i + bs], task.generate_tokens,
                     variables=variables,
                     temperature=task.temperature, top_k=task.top_k,
+                    top_p=task.top_p,
                     eos_id=None if task.eos_id < 0 else task.eos_id)
                 for i in range(0, len(prompts), bs)
             ]

@@ -46,28 +46,56 @@ def init_cache(module, batch: int, max_len: int):
         for _ in range(module.depth))
 
 
-def _sampler(temperature: float, top_k: int):
-    """logits (B, V), rng → tokens (B,). temperature 0 = greedy."""
+def _sampler(temperature: float, top_k: int, top_p: float = 0.0):
+    """logits (B, V), rng → tokens (B,). temperature 0 = greedy; top_k
+    truncates to the k most likely tokens, top_p (nucleus, Holtzman et
+    al.) to the smallest set whose probability mass reaches p — both may
+    combine (top_k applies first)."""
     def sample(logits, rng):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / float(temperature)
+        if top_k > 0 or 0.0 < top_p < 1.0:
+            # ONE descending sort serves both filters (a vocab-sized sort
+            # per decoded token is the sampler's dominant cost)
+            sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
         if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            kth = sorted_desc[:, top_k - 1][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if 0.0 < top_p < 1.0:
+            # nucleus: drop tokens outside the smallest probability-mass-p
+            # prefix of the sorted distribution. The token that CROSSES
+            # the p threshold stays in (cumulative mass up to and
+            # including it first reaches p), matching the standard
+            # formulation. Under a combined top_k, the nucleus operates on
+            # the already-truncated distribution: masking the sorted array
+            # by POSITION >= top_k equals re-sorting the masked logits.
+            if top_k > 0:
+                sorted_desc = jnp.where(
+                    jnp.arange(sorted_desc.shape[-1])[None, :] < top_k,
+                    sorted_desc, -jnp.inf)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep[i] = True while the mass BEFORE token i is < p
+            keep = (cum - probs) < float(top_p)
+            # per-row cutoff logit = smallest kept sorted logit
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_desc, jnp.inf), axis=-1)[:, None]
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
         return jax.random.categorical(rng, logits).astype(jnp.int32)
     return sample
 
 
 def generate(module, variables: Pytree, prompt, max_new_tokens: int, *,
-             temperature: float = 0.0, top_k: int = 0,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              eos_id: Optional[int] = None, pad_id: int = 0,
              rng=None, max_len: Optional[int] = None):
     """Generate ``max_new_tokens`` continuations of ``prompt`` (B, L_p).
 
     Returns (B, max_new_tokens) int32 tokens; after a row emits ``eos_id``
     the remainder of that row is ``pad_id``. Greedy by default;
-    ``temperature > 0`` samples (optionally top-k truncated) using ``rng``.
+    ``temperature > 0`` samples (optionally top-k and/or nucleus top-p
+    truncated) using ``rng``.
 
     The returned function of this call is fully jit-compiled: repeated calls
     with the same (shapes, max_new_tokens, sampling config) hit the
@@ -85,7 +113,7 @@ def generate(module, variables: Pytree, prompt, max_new_tokens: int, *,
     max_len = max_len or total
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    sample = _sampler(temperature, top_k)
+    sample = _sampler(temperature, top_k, top_p)
 
     def run(variables, prompt, rng):
         caches = init_cache(module, B, max_len)
@@ -126,7 +154,7 @@ def generate(module, variables: Pytree, prompt, max_new_tokens: int, *,
     # retrace and recompile every time. Key the compiled program on
     # everything the closure bakes in (flax modules hash by config).
     key = (module, B, Lp, max_len, max_new_tokens, float(temperature),
-           int(top_k), eos_id, pad_id)
+           int(top_k), float(top_p), eos_id, pad_id)
     compiled = _COMPILED.get(key)
     if compiled is None:
         while len(_COMPILED) >= _COMPILED_MAX:  # LRU bound: a long-lived

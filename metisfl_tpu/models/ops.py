@@ -528,7 +528,7 @@ class FlaxModelOps:
                  **sampling) -> np.ndarray:
         """Autoregressive decoding on a causal-LM module (KV-cache decode,
         one jitted program per shape/config — models/generate.py). Sampling
-        kwargs: ``temperature``, ``top_k``, ``eos_id``, ``pad_id``, ``rng``,
+        kwargs: ``temperature``, ``top_k``, ``top_p``, ``eos_id``, ``pad_id``, ``rng``,
         ``max_len``. Sampled calls without an explicit ``rng`` advance the
         engine's own rng, so repeated requests draw different streams."""
         from metisfl_tpu.models.generate import generate as _generate

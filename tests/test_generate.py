@@ -219,3 +219,40 @@ def test_training_params_unchanged_by_decode_support():
     # and the plain forward is untouched by the new kwargs' default path
     logits = module.apply(variables, jnp.asarray(prompt))
     assert logits.shape == (2, 5, 64)
+
+
+def test_top_p_nucleus_restricts_support():
+    """top_p keeps exactly the smallest prefix whose mass reaches p: with
+    probs [.6, .3, .05, .05] and p=.7, only tokens {0, 1} can be drawn."""
+    import jax
+    import jax.numpy as jnp
+
+    from metisfl_tpu.models.generate import _sampler
+
+    probs = jnp.asarray([[0.6, 0.3, 0.05, 0.05]], jnp.float32)
+    logits = jnp.log(probs)
+    sample = _sampler(temperature=1.0, top_k=0, top_p=0.7)
+    draws = {int(sample(logits, jax.random.PRNGKey(i))[0])
+             for i in range(64)}
+    assert draws <= {0, 1} and draws, draws
+    # p=0 / p=1: no truncation — all four tokens reachable
+    free = _sampler(temperature=1.0, top_k=0, top_p=0.0)
+    draws = {int(free(logits, jax.random.PRNGKey(i))[0])
+             for i in range(256)}
+    assert draws == {0, 1, 2, 3}
+
+
+def test_generate_with_top_p_runs():
+    import jax
+    import numpy as np
+
+    from metisfl_tpu.models.generate import generate
+    from metisfl_tpu.models.zoo import LlamaLite
+
+    module = LlamaLite(vocab_size=64, dim=32, depth=1, heads=4)
+    prompt = np.ones((2, 4), np.int32)
+    variables = module.init(jax.random.PRNGKey(0), prompt)
+    out = generate(module, variables, prompt, 6, temperature=0.8,
+                   top_p=0.9, rng=jax.random.PRNGKey(1))
+    assert out.shape == (2, 6)
+    assert ((0 <= np.asarray(out)) & (np.asarray(out) < 64)).all()
