@@ -120,6 +120,10 @@ class RoundMetadata:
     scales: Dict[str, float] = field(default_factory=dict)
     model_insertion_duration_ms: Dict[str, float] = field(default_factory=dict)
     model_size: Dict[str, int] = field(default_factory=dict)
+    # bytes each learner actually sent this round (the wire-compression
+    # ladder — ship_dtype bf16/int8q/topk — shows up here as 2-32x
+    # smaller uplinks; the reference tracks only decoded tensor sizes)
+    uplink_bytes: Dict[str, int] = field(default_factory=dict)
     peak_rss_kb: int = 0
     # non-fatal round errors (e.g. partial-cohort secure aggregation after a
     # deadline) — surfaced in lineage instead of vanishing into a log line
@@ -401,6 +405,8 @@ class Controller:
             self._expired_tasks.pop(result.task_id, None)
             if not stale:
                 self._current_meta.train_received_at[result.learner_id] = start
+                self._current_meta.uplink_bytes[result.learner_id] = \
+                    len(result.model)
 
         if stale and self._topk_uplink():
             # a topk payload is a delta against the community model AT

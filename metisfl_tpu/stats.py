@@ -32,16 +32,21 @@ def summarize(stats: Dict[str, Any]) -> str:
     if rounds:
         lines.append("")
         lines.append(f"{'round':>5} {'wall':>8} {'cohort':>6} {'agg':>8} "
-                     f"{'params':>10} {'errors':>6}")
+                     f"{'params':>10} {'uplink':>9} {'errors':>6}")
         for meta in rounds:
             wall_ms = 1e3 * max(
                 0.0, meta.get("completed_at", 0) - meta.get("started_at", 0))
+            up = sum(meta.get("uplink_bytes", {}).values())
+            up_s = (f"{up / 1e6:.1f}MB" if up >= 1e6
+                    else f"{up / 1e3:.0f}KB" if up >= 1e3
+                    else f"{up}B" if up else "-")
             lines.append(
                 f"{meta.get('global_iteration', '?'):>5} "
                 f"{_fmt_ms(wall_ms):>8} "
                 f"{len(meta.get('selected_learners', [])):>6} "
                 f"{_fmt_ms(meta.get('aggregation_duration_ms', 0.0)):>8} "
                 f"{meta.get('model_size', {}).get('values', 0):>10} "
+                f"{up_s:>9} "
                 f"{len(meta.get('errors', [])):>6}")
         # clamped like the table rows, so both views agree on skewed clocks
         walls = [1e3 * max(0.0, m.get("completed_at", 0)

@@ -57,3 +57,70 @@ def test_cli_usage_error():
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 2
     assert "usage" in out.stderr
+
+
+def test_uplink_bytes_in_round_table():
+    """Per-learner uplink bytes land in round metadata and the summary
+    shows the per-round total (the compression ladder's observability)."""
+    from metisfl_tpu.stats import summarize
+
+    stats = {
+        "global_iteration": 1,
+        "learners": ["a", "b"],
+        "round_metadata": [{
+            "global_iteration": 0, "started_at": 1.0, "completed_at": 2.0,
+            "selected_learners": ["a", "b"],
+            "aggregation_duration_ms": 5.0,
+            "model_size": {"values": 100},
+            "uplink_bytes": {"a": 600_000, "b": 600_000},
+            "errors": [],
+        }],
+        "community_evaluations": [],
+    }
+    text = summarize(stats)
+    assert "uplink" in text and "1.2MB" in text
+
+
+def test_controller_records_uplink_bytes():
+    import numpy as np
+
+    from metisfl_tpu.comm.messages import (JoinRequest, TaskResult,
+                                           TrainParams)
+    from metisfl_tpu.config import (AggregationConfig, FederationConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.controller.core import Controller
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    class _NopProxy:
+        def run_task(self, task):
+            pass
+
+        def evaluate(self, task, callback):
+            pass
+
+        def shutdown(self):
+            pass
+
+    cfg = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(),
+        termination=TerminationConfig(federation_rounds=1))
+    ctl = Controller(cfg, lambda record: _NopProxy())
+    try:
+        reply = ctl.join(JoinRequest(hostname="h", port=1,
+                                     num_train_examples=4))
+        ctl.set_community_model(ModelBlob(tensors=[
+            ("w", np.zeros(64, np.float32))]).to_bytes())
+        payload = ModelBlob(tensors=[
+            ("w", np.ones(64, np.float32))]).to_bytes()
+        ctl._handle_completed(TaskResult(
+            task_id="t", learner_id=reply.learner_id,
+            auth_token=reply.auth_token, round_id=0, model=payload,
+            num_train_examples=4, completed_steps=1, completed_epochs=1,
+            completed_batches=1))
+        metas = ctl.round_metadata + [ctl._current_meta]
+        recorded = [m.uplink_bytes.get(reply.learner_id) for m in metas
+                    if m.uplink_bytes]
+        assert recorded and recorded[0] == len(payload)
+    finally:
+        ctl.shutdown()
