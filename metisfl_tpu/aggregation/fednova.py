@@ -61,6 +61,7 @@ class FedNova:
         self._pending: Optional[Dict[str, Any]] = None
         self._sum_q = 0.0      # Σ pᵢ/τᵢ
         self._tau_eff = 0.0    # Σ pᵢτᵢ
+        self._sum_p = 0.0      # Σ pᵢ over models actually accumulated
         self.reset()
 
     # -- fold interface ----------------------------------------------------
@@ -68,6 +69,7 @@ class FedNova:
         self._fold.reset()
         self._sum_q = 0.0
         self._tau_eff = 0.0
+        self._sum_p = 0.0
 
     def accumulate(
         self,
@@ -85,6 +87,7 @@ class FedNova:
             adjusted.append((lineage, float(p) / tau))
             self._sum_q += float(p) / tau
             self._tau_eff += float(p) * tau
+            self._sum_p += float(p)
         self._fold.accumulate(adjusted)
 
     def result(self) -> Pytree:
@@ -131,7 +134,13 @@ class FedNova:
             raise ValueError(
                 "fednova state tree does not match the aggregated model "
                 f"tree: state {treedef} vs round {avg_treedef}")
-        eff = self._tau_eff * self._sum_q
+        # Scales are normalized over the *selected* cohort, but learners
+        # whose models were dropped before accumulate (malformed payloads,
+        # departures) leave Σpᵢ = s < 1; τ_eff and Q are both linear in p,
+        # so renormalize each by s or the round's update is silently
+        # dampened by s² (the fold's avg_q is a ratio and needs no fix).
+        s = self._sum_p
+        eff = (self._tau_eff * self._sum_q) / (s * s) if s > 0.0 else 0.0
 
         def leaf(prev, a):
             a = np.asarray(a)

@@ -389,9 +389,7 @@ class Controller:
             record = self._learners.get(result.learner_id)
             if record is None:
                 return
-            record.completed_batches = result.completed_batches
             record.dispatch_failures = 0  # provably reachable
-            record.last_result_round = result.round_id
             if result.control_delta:
                 self._scaffold_deltas[result.learner_id] = result.control_delta
             if result.processing_ms_per_step > 0:
@@ -435,6 +433,14 @@ class Controller:
             model = None
         if model is not None:
             self._store.insert(result.learner_id, model)
+            with self._lock:
+                # step count and result round pair with the STORED model:
+                # dropped payloads (late topk, malformed) must not refresh
+                # them, or FedNova's τ / the batches scaler / staleness
+                # decay would weight the older stored model with metadata
+                # from a different task
+                record.completed_batches = result.completed_batches
+                record.last_result_round = result.round_id
         if not stale:
             with self._lock:
                 self._current_meta.model_insertion_duration_ms[result.learner_id] = (

@@ -152,10 +152,16 @@ class Learner:
     # model wire I/O (+ optional HE)
     # ------------------------------------------------------------------ #
 
-    def _load_model(self, blob_bytes: bytes):
+    def _load_model(self, blob_bytes: bytes, with_wire: bool = False):
         """Decode (and decrypt) a model blob → variables pytree, restored
         to the engine's own training dtypes (a community model may arrive
-        in a narrower wire dtype — TrainParams.ship_dtype)."""
+        in a narrower wire dtype — TrainParams.ship_dtype). With
+        ``with_wire`` also returns the exact wire-dtype tensors by name:
+        the top-k sparsifier must difference against what the controller
+        densifies against (its exact f32 community model), not the
+        engine-dtype cast — with bf16 training dtypes the cast would bake
+        the base weights' rounding into every shipped coordinate as a
+        systematic error the error-feedback residual never sees."""
         import jax
 
         blob = ModelBlob.from_bytes(blob_bytes)
@@ -172,9 +178,12 @@ class Learner:
             named = blob.tensors
         named = self._merge_local(named)
         tree = named_tensors_to_pytree(named, self._treedef_like)
-        return jax.tree.map(
+        tree = jax.tree.map(
             lambda a, t: a if a.dtype == t.dtype else np.asarray(a, t.dtype),
             tree, self._treedef_like)
+        if with_wire:
+            return tree, {n: np.asarray(a) for n, a in named}
+        return tree
 
     def _merge_local(self, named):
         """FedBN merge (Li et al., ICLR 2021): tensors the federation
@@ -218,12 +227,21 @@ class Learner:
                 return
         import re
 
-        self._local_values = {
+        values = {
             name: np.array(arr)
             for name, arr in pytree_to_named_tensors(self._treedef_like)
             if re.search(self._local_regex, name)
         }
-        self._snapshot_regex = self._local_regex
+        with self._task_lock:
+            # the in-flight train may have finished and run its own
+            # post-run _snapshot_local while we built the fallback from
+            # initial values; that snapshot is fresher — writing ours over
+            # it would have evals merge untrained tensors until the next
+            # train lands. A landed snapshot sets _snapshot_regex, so only
+            # install the fallback while it is still unset.
+            if self._snapshot_regex != self._local_regex:
+                self._local_values = values
+                self._snapshot_regex = self._local_regex
 
     def _snapshot_local(self) -> None:
         """Refresh _local_values from the engine. Call ONLY on the
@@ -284,18 +302,20 @@ class Learner:
                 named = narrow_named(named, resolve_ship_dtype(ship_dtype))
         return ModelBlob(tensors=named).to_bytes()
 
-    def _dump_sparse(self, incoming, ship_vars, denom: int) -> bytes:
+    def _dump_sparse(self, wire_ref, ship_vars, denom: int) -> bytes:
         """Top-k sparsified update vs the round's dispatched model, with
         error-feedback residuals carried across rounds (tensor/sparse.py);
-        ~denom/2x less uplink than the dense f32 blob."""
+        ~denom/2x less uplink than the dense f32 blob. ``wire_ref`` is the
+        wire-dtype tensor dict from ``_load_model(..., with_wire=True)`` —
+        the controller densifies against its exact community model, so the
+        difference must be taken against the same bytes."""
         from metisfl_tpu.tensor.sparse import sparsify_update
 
         variables = (ship_vars if ship_vars is not None
                      else self.model_ops.get_variables())
         named = self._drop_local(pytree_to_named_tensors(variables))
-        ref = dict(pytree_to_named_tensors(incoming))
         return ModelBlob(tensors=sparsify_update(
-            named, ref, denom, self._ef_residual)).to_bytes()
+            named, wire_ref, denom, self._ef_residual)).to_bytes()
 
     # ------------------------------------------------------------------ #
     # task execution
@@ -322,7 +342,8 @@ class Learner:
             # flight on this serialized thread)
             self._local_regex = params.local_tensor_regex
             if self._local_regex != self._snapshot_regex:
-                self._snapshot_local()
+                with self._task_lock:
+                    self._snapshot_local()
             if params.local_tensor_regex:
                 # fail BEFORE paying for local training (and before the
                 # round stalls to its deadline): a regex that localizes
@@ -330,9 +351,10 @@ class Learner:
                 # _drop_local raises on exactly that condition.
                 self._drop_local(
                     pytree_to_named_tensors(self._treedef_like))
+            from metisfl_tpu.tensor.sparse import parse_topk
+
             if params.ship_dtype:
                 from metisfl_tpu.tensor.quantize import SHIP_INT8Q
-                from metisfl_tpu.tensor.sparse import parse_topk
 
                 # fail a bad dtype name BEFORE paying for local training
                 if (params.ship_dtype.lower() != SHIP_INT8Q
@@ -348,7 +370,14 @@ class Learner:
                     params, profile_dir=_os.path.join(
                         params.profile_dir,
                         self.learner_id or f"port_{self.port}"))
-            incoming = self._load_model(task.model)
+            topk_denom = (parse_topk(params.ship_dtype)
+                          if params.ship_dtype else None)
+            wire_ref = None
+            if topk_denom is not None and self.secure_backend is None:
+                incoming, wire_ref = self._load_model(task.model,
+                                                      with_wire=True)
+            else:
+                incoming = self._load_model(task.model)
             self.model_ops.set_variables(incoming)
             grad_offset = None
             scaffold_c = None
@@ -368,8 +397,11 @@ class Learner:
                                        cancel_event=self._cancel,
                                        **train_kwargs)
             # training updated the local tensors (e.g. BatchNorm stats):
-            # refresh the snapshot evals and later merges read from
-            self._snapshot_local()
+            # refresh the snapshot evals and later merges read from —
+            # under the task lock so _adopt_local_regex's fallback install
+            # can never interleave with (and overwrite) this fresh snapshot
+            with self._task_lock:
+                self._snapshot_local()
             # round-scoped mask derivation (pairwise-masking secure agg)
             if self.secure_backend is not None and hasattr(
                     self.secure_backend, "begin_round"):
@@ -389,12 +421,8 @@ class Learner:
                 ship_vars = privatize_update(
                     self.model_ops.get_variables(), incoming,
                     params.dp_clip_norm, params.dp_noise_multiplier)
-            from metisfl_tpu.tensor.sparse import parse_topk
-
-            topk_denom = (parse_topk(params.ship_dtype)
-                          if params.ship_dtype else None)
-            if topk_denom is not None and self.secure_backend is None:
-                model_bytes = self._dump_sparse(incoming, ship_vars,
+            if wire_ref is not None:
+                model_bytes = self._dump_sparse(wire_ref, ship_vars,
                                                 topk_denom)
             else:
                 model_bytes = self._dump_model(ship_dtype=params.ship_dtype,

@@ -169,3 +169,29 @@ def test_fednova_federation_learns():
         assert last > 0.6, f"fednova federation failed to learn: {last}"
     finally:
         fed.shutdown()
+
+
+def test_dropped_learner_renormalizes():
+    """Scales normalize over the SELECTED cohort; when a selected
+    learner's model never reaches accumulate (malformed payload,
+    departure) the survivors' p must renormalize, or the round's update
+    is silently dampened by (Σp)²."""
+    models = _models(3, seed=3)
+    x = {"w": np.zeros(6, np.float32), "b": np.zeros(2, np.float32),
+         "step": np.asarray(0, np.int64)}
+    p = [0.5, 0.3, 0.2]
+    tau = [4.0, 2.0, 8.0]
+    # learner 2 (p=0.2) drops: aggregate only the first two at their
+    # cohort-normalized weights
+    dropped = FedNova()
+    dropped.seed_community(x)
+    got = dropped.aggregate([([m], pi) for m, pi in zip(models[:2], p[:2])],
+                            steps=tau[:2])
+    # ground truth: the same round with p renormalized over the survivors
+    s = p[0] + p[1]
+    renorm = FedNova()
+    renorm.seed_community(x)
+    want = renorm.aggregate(
+        [([m], pi / s) for m, pi in zip(models[:2], p[:2])], steps=tau[:2])
+    for key in ("w", "b"):
+        np.testing.assert_allclose(got[key], want[key], rtol=1e-5, atol=1e-6)
