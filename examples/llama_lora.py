@@ -73,8 +73,12 @@ def main() -> int:
                        lora_rank=args.lora_rank)
     config = FederationConfig(
         aggregation=AggregationConfig(scaler="participants"),
+        # ship-only-trainable: just the LoRA adapters cross the wire, and
+        # the controller holds only adapter state — an 8B frozen base never
+        # leaves the learners (TrainParams.ship_tensor_regex)
         train=TrainParams(batch_size=16, local_steps=4, learning_rate=0.01,
-                          optimizer="adam", scan_chunk=args.scan_chunk),
+                          optimizer="adam", scan_chunk=args.scan_chunk,
+                          ship_tensor_regex="lora_"),
         eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=args.rounds),
     )
@@ -106,10 +110,23 @@ def main() -> int:
           f"({100 * n_lora / n_total:.1f}%)")
 
     # KV-cache decode on the federated model (models/generate.py): greedy
-    # continuation of a prompt, one jitted program for the whole sequence
-    from metisfl_tpu.tensor.pytree import unpack_model
+    # continuation of a prompt, one jitted program for the whole sequence.
+    # The community blob carries ONLY the adapters; overlay them on the
+    # (frozen, shared) base exactly like a learner's backfill.
+    from metisfl_tpu.tensor.pytree import (ModelBlob,
+                                           named_tensors_to_pytree,
+                                           pytree_to_named_tensors)
     blob = fed.controller.community_model_bytes()
-    final = unpack_model(blob, template) if blob else template
+    if blob:
+        adapters = dict(ModelBlob.from_bytes(blob).tensors)
+        print(f"community blob: {sum(a.nbytes for a in adapters.values())} "
+              f"B of adapters (full model would be "
+              f"{sum(np.asarray(l).nbytes for l in jax.tree.leaves(template))} B)")
+        merged = [(n, adapters.get(n, a))
+                  for n, a in pytree_to_named_tensors(template)]
+        final = named_tensors_to_pytree(merged, template)
+    else:
+        final = template
     gen_ops = FlaxModelOps(module, sample, variables=final)
     prompt = np.arange(1, 9, dtype=np.int32)[None, :]
     tokens = gen_ops.generate(prompt, max_new_tokens=8)

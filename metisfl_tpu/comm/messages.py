@@ -146,6 +146,25 @@ class TrainParams(Message):
     # aggregation and with stateful server rules (fedavgm/fedadam/
     # fedyogi/fednova/scaffold track a full model tree) — config-checked.
     local_tensor_regex: str = ""
+    # Ship-only-trainable transport (the selective complement of
+    # local_tensor_regex — that one RETAINS, this one SELECTS): tensors
+    # whose flattened name matches this regex are the ONLY federated
+    # state. Learners ship just the matching subset, the controller holds
+    # and aggregates ONLY that subset (the frozen base never occupies
+    # controller memory or the wire), and the downlink broadcasts the
+    # aggregated subset; each learner backfills non-matching tensors from
+    # its own construction-time values. Contract: every learner holds the
+    # IDENTICAL base (the usual LoRA/linear-probe setting —
+    # ship_tensor_regex="lora_" with FlaxModelOps(trainable_regex="lora_")
+    # turns an 8B-param federation into an adapter-sized one, MBs instead
+    # of GBs both directions). Non-matching tensors are effectively
+    # frozen by the transport regardless of the optimizer mask.
+    # Incompatible with secure aggregation, local_tensor_regex, scaffold,
+    # and client-level DP — config-checked. The reference hit the
+    # full-model-blob wall and worked around it with a stub-per-request
+    # hack (reference metisfl/controller/core/controller.cc:594-604);
+    # shipping only the trainable subset removes the wall instead.
+    ship_tensor_regex: str = ""
     # Client-level differential privacy on the shipped update
     # (secure/dp.py): the delta vs the received community model is
     # L2-clipped to dp_clip_norm (> 0 enables; also a robustness tool on
@@ -229,6 +248,10 @@ class EvalTask(Message):
     # yet sampled, or crash-rejoined) must still be able to reconstruct
     # the model — the regex rides every eval/infer task too
     local_tensor_regex: str = ""
+    # Ship-only-trainable (TrainParams.ship_tensor_regex): community blobs
+    # carry ONLY the federated subset; a never-trained learner must know
+    # to backfill the frozen base from its own initial values
+    ship_tensor_regex: str = ""
 
 
 @dataclass
@@ -262,6 +285,8 @@ class InferTask(Message):
     generate_tokens: int = 0
     # FedBN merge for partial community blobs (see EvalTask)
     local_tensor_regex: str = ""
+    # ship-only-trainable backfill for subset community blobs (see EvalTask)
+    ship_tensor_regex: str = ""
     temperature: float = 0.0    # 0 = greedy
     top_k: int = 0
     top_p: float = 0.0          # nucleus sampling mass; 0/1 = off

@@ -286,6 +286,44 @@ class FederationConfig:
                     "so never-shipped local tensors (e.g. BatchNorm "
                     "running stats) would consume the sensitivity budget "
                     "and silently crush the shipped update")
+        if self.train.ship_tensor_regex:
+            import re as _re
+
+            try:
+                _re.compile(self.train.ship_tensor_regex)
+            except _re.error as exc:
+                raise ValueError(
+                    f"ship_tensor_regex does not compile: {exc}") from None
+            if self.train.local_tensor_regex:
+                # both partition the tensor tree (one retains, one
+                # selects); composing them invites silent misconfiguration
+                # — a name matching neither or both has no defined owner
+                raise ValueError(
+                    "ship_tensor_regex and local_tensor_regex cannot "
+                    "combine: one selects the federated subset, the other "
+                    "retains a local subset — pick one partition")
+            if self.secure.enabled:
+                raise ValueError(
+                    "ship_tensor_regex is incompatible with secure "
+                    "aggregation (partial trees break the uniform-shape "
+                    "masking/HE payload contract)")
+            if self.aggregation.rule.lower() == "scaffold":
+                # the control variate c spans the full params tree; a
+                # subset-resident controller cannot fold or broadcast it
+                raise ValueError(
+                    "ship_tensor_regex is incompatible with rule="
+                    "'scaffold' (control variates span the full model "
+                    "tree)")
+            if self.train.dp_clip_norm > 0.0:
+                # same rationale as local_tensor_regex: the clip norm is
+                # computed over the full update, so frozen tensors'
+                # (nominally zero, but unfrozen-engine nonzero) deltas
+                # would consume the sensitivity budget unaccountably
+                raise ValueError(
+                    "ship_tensor_regex is incompatible with client-level "
+                    "DP: the clip norm covers the full update while only "
+                    "the subset ships, so the guarantee would be "
+                    "mis-accounted")
         if self.train.downlink_dtype:
             import numpy as _np
 

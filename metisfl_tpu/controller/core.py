@@ -318,8 +318,27 @@ class Controller:
     # ------------------------------------------------------------------ #
 
     def set_community_model(self, blob_bytes: bytes) -> None:
-        """ReplaceCommunityModel (controller.cc:85-96): seed or overwrite."""
+        """ReplaceCommunityModel (controller.cc:85-96): seed or overwrite.
+
+        Under ship_tensor_regex the controller is subset-resident from the
+        seed on: the frozen base never occupies controller memory, store,
+        checkpoints, or any wire hop — a full-model seed (the usual driver
+        flow) is filtered down immediately and re-encoded, so round-1
+        dispatch is already adapter-sized."""
         blob = ModelBlob.from_bytes(blob_bytes)
+        ship_regex = self.config.train.ship_tensor_regex
+        if ship_regex and blob.tensors:
+            import re
+
+            subset = [(n, a) for n, a in blob.tensors
+                      if re.search(ship_regex, n)]
+            if not subset:
+                raise ValueError(
+                    f"ship_tensor_regex {ship_regex!r} matches no tensor "
+                    "in the seeded model — nothing would ever federate")
+            if len(subset) != len(blob.tensors):
+                blob = ModelBlob(tensors=subset)
+                blob_bytes = blob.to_bytes()
         with self._lock:
             self._community_blob = bytes(blob_bytes)
             if blob.tensors:
@@ -1074,6 +1093,7 @@ class Controller:
                 datasets=list(cfg.datasets),
                 metrics=list(cfg.metrics),
                 local_tensor_regex=self.config.train.local_tensor_regex,
+                ship_tensor_regex=self.config.train.ship_tensor_regex,
             )
             with self._lock:
                 meta.eval_submitted_at[record.learner_id] = time.time()

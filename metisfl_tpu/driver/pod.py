@@ -66,6 +66,12 @@ class PodFederationDriver:
             raise ValueError(
                 "pod transport does not implement FedBN local tensors "
                 "(local_tensor_regex); use the host path")
+        if config.train.ship_tensor_regex:
+            # same psum-averages-EVERY-variable rule: a subset transport
+            # contract cannot hold when weights never leave the device
+            raise ValueError(
+                "pod transport does not implement ship-only-trainable "
+                "subsets (ship_tensor_regex); use the host path")
         self.config = config
         self.datasets = list(train_datasets)
         self.test_dataset = test_dataset

@@ -534,6 +534,7 @@ class DriverSession:
             top_p=top_p,
             eos_id=-1 if eos_id is None else int(eos_id),
             local_tensor_regex=self.config.train.local_tensor_regex,
+            ship_tensor_regex=self.config.train.ship_tensor_regex,
         )
         client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,
                            ssl=self.config.ssl)

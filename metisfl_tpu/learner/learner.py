@@ -116,6 +116,14 @@ class Learner:
         # (controller reconfigured mid-run) must trigger a re-snapshot or
         # merges miss the newly-local names
         self._snapshot_regex: str = ""
+        # Ship-only-trainable (TrainParams.ship_tensor_regex): only
+        # matching tensors federate; community blobs carry just that
+        # subset and non-matching tensors backfill from the
+        # construction-time tree (_treedef_like — immutable, never
+        # donated, so the merge is race-free from any thread). Contract:
+        # every learner holds the identical frozen base.
+        self._ship_regex: str = ""
+        self._warned_unfrozen = False
 
     # ------------------------------------------------------------------ #
     # membership
@@ -177,6 +185,7 @@ class Learner:
         else:
             named = blob.tensors
         named = self._merge_local(named)
+        named = self._merge_frozen(named)
         tree = named_tensors_to_pytree(named, self._treedef_like)
         tree = jax.tree.map(
             lambda a, t: a if a.dtype == t.dtype else np.asarray(a, t.dtype),
@@ -260,6 +269,37 @@ class Learner:
         }
         self._snapshot_regex = self._local_regex
 
+    def _merge_frozen(self, named):
+        """Ship-only-trainable backfill: community blobs carry only the
+        federated subset; fill non-matching names from the
+        construction-time initial values. Strictly gated on the ship
+        regex — and only NON-matching names backfill, so a corrupt blob
+        missing a federated tensor still fails loudly downstream."""
+        if not self._ship_regex:
+            return named
+        import re
+
+        have = {n for n, _ in named}
+        out = list(named)
+        for name, arr in pytree_to_named_tensors(self._treedef_like):
+            if name not in have and not re.search(self._ship_regex, name):
+                out.append((name, arr))
+        return out
+
+    def _keep_ship(self, named):
+        """Uplink filter: only ship_tensor_regex matches federate."""
+        if not self._ship_regex:
+            return named
+        import re
+
+        kept = [(n, a) for n, a in named
+                if re.search(self._ship_regex, n)]
+        if not kept:
+            raise ValueError(
+                f"ship_tensor_regex {self._ship_regex!r} matches no "
+                "tensor — nothing would ever be aggregated")
+        return kept
+
     def _drop_local(self, named):
         """Uplink filter: local tensors never ship."""
         if not self._local_regex:
@@ -278,7 +318,8 @@ class Learner:
                     variables=None) -> bytes:
         if variables is None:
             variables = self.model_ops.get_variables()
-        named = self._drop_local(pytree_to_named_tensors(variables))
+        named = self._keep_ship(
+            self._drop_local(pytree_to_named_tensors(variables)))
         if self.secure_backend is not None:
             from metisfl_tpu.tensor.spec import TensorSpec, wire_dtype_of, TensorKind
             opaque = {}
@@ -313,7 +354,8 @@ class Learner:
 
         variables = (ship_vars if ship_vars is not None
                      else self.model_ops.get_variables())
-        named = self._drop_local(pytree_to_named_tensors(variables))
+        named = self._keep_ship(
+            self._drop_local(pytree_to_named_tensors(variables)))
         return ModelBlob(tensors=sparsify_update(
             named, wire_ref, denom, self._ef_residual)).to_bytes()
 
@@ -351,6 +393,25 @@ class Learner:
                 # _drop_local raises on exactly that condition.
                 self._drop_local(
                     pytree_to_named_tensors(self._treedef_like))
+            self._ship_regex = params.ship_tensor_regex
+            if self._ship_regex:
+                # same fail-fast: a subset regex matching nothing means
+                # nothing would ever aggregate
+                self._keep_ship(pytree_to_named_tensors(self._treedef_like))
+                # probe through wrappers (multi-host LeaderOps exposes the
+                # real engine as .inner) so a correctly-frozen multi-host
+                # federation is not nagged about a nonexistent problem
+                engine = getattr(self.model_ops, "inner", self.model_ops)
+                if not self._warned_unfrozen and not getattr(
+                        engine, "_trainable_regex", ""):
+                    self._warned_unfrozen = True
+                    logger.warning(
+                        "%s: ship_tensor_regex=%r but the engine has no "
+                        "trainable_regex freeze mask — non-shipped tensors "
+                        "train locally and are discarded every round "
+                        "(reset to initial values on each receipt); freeze "
+                        "them to save the wasted compute",
+                        self.learner_id, self._ship_regex)
             from metisfl_tpu.tensor.sparse import parse_topk
 
             if params.ship_dtype:
@@ -492,6 +553,10 @@ class Learner:
         """Blocking community-model evaluation over requested datasets."""
         t0 = time.time()
         self._adopt_local_regex(task.local_tensor_regex)
+        if task.ship_tensor_regex:
+            # never-trained learners get the regex from the task (backfill
+            # reads the immutable construction tree — no snapshot needed)
+            self._ship_regex = task.ship_tensor_regex
         # Evaluate on an explicit variables tree so a concurrently running
         # training task never races on the engine's model slot.
         variables = self._load_model(task.model)
@@ -528,6 +593,8 @@ class Learner:
         inputs or a named local split."""
         t0 = time.time()
         self._adopt_local_regex(task.local_tensor_regex)
+        if task.ship_tensor_regex:
+            self._ship_regex = task.ship_tensor_regex
         variables = self._load_model(task.model) if task.model else None
         if task.inputs:
             blob = ModelBlob.from_bytes(task.inputs)

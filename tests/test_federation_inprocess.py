@@ -444,6 +444,7 @@ def test_ship_dtype_skips_integer_state():
     learner.model_ops = _Ops()
     learner.secure_backend = None
     learner._local_regex = ""
+    learner._ship_regex = ""
     blob = ModelBlob.from_bytes(learner._dump_model(ship_dtype="bf16"))
     by_name = dict(blob.tensors)
     import ml_dtypes

@@ -1,0 +1,291 @@
+"""Ship-only-trainable transport (TrainParams.ship_tensor_regex).
+
+The selective complement of FedBN's local_tensor_regex: only matching
+tensors federate — the controller is subset-resident (the frozen base
+never occupies controller memory or any wire hop) and learners backfill
+the base from their construction-time values. This is the transport that
+makes the BASELINE.md 8B-LoRA north star traversable: the reference
+collapsed under ~100 MB full-model RPCs and hacked around it with a
+stub-per-request workaround (reference
+metisfl/controller/core/controller.cc:594-604); an 8.8B-param bf16 blob
+(~17.6 GB) would exceed gRPC's ~2 GiB framing outright.
+"""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    SecureAggConfig,
+    TerminationConfig,
+)
+from metisfl_tpu.driver import InProcessFederation
+from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+from metisfl_tpu.models.zoo import MLP
+from metisfl_tpu.tensor.pytree import ModelBlob, pytree_to_named_tensors
+from tests.test_federation_inprocess import _shards
+
+HEAD = r"Dense_1"  # the MLP's output layer — the federated subset
+
+
+def _named_bytes(named):
+    return sum(np.asarray(a).nbytes for _, a in named)
+
+
+def _build(rule="fedavg", rounds=3, ship=HEAD, **train_kw):
+    config = FederationConfig(
+        aggregation=AggregationConfig(
+            rule=rule,
+            scaler="train_dataset_size" if rule == "fednova"
+            else "participants"),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.2,
+                          ship_tensor_regex=ship, **train_kw),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=rounds),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(3)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)  # identical frozen base
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    return fed, template
+
+
+def _run(fed, rounds=3):
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(rounds, timeout_s=120)
+        assert fed.wait_for_evaluations(2, timeout_s=120)
+        evals = [e for e in fed.statistics()["community_evaluations"]
+                 if e["evaluations"]]
+        return fed.statistics(), float(np.mean(
+            [v["test"]["accuracy"]
+             for v in evals[-1]["evaluations"].values()]))
+    finally:
+        fed.shutdown()
+
+
+def test_head_only_federation_learns_and_wire_is_subset_sized():
+    """Only the output layer federates; the federation still learns the
+    linearly-separable task (shared random features + aggregated linear
+    head), and every wire hop carries only the subset."""
+    fed, template = _build()
+    controller = fed.controller
+    stats, acc = _run(fed)
+    assert acc > 0.8, f"head-only federation failed to learn: {acc}"
+
+    named = pytree_to_named_tensors(template)
+    full_bytes = _named_bytes(named)
+    head_bytes = _named_bytes([(n, a) for n, a in named if "Dense_1" in n])
+    assert head_bytes < full_bytes  # the subset is a strict subset
+
+    # downlink: the community blob holds ONLY head tensors
+    blob = ModelBlob.from_bytes(controller.community_model_bytes())
+    names = [n for n, _ in blob.tensors]
+    assert names and all("Dense_1" in n for n in names), names
+    assert _named_bytes(blob.tensors) <= head_bytes * 1.01
+
+    # uplink: per-learner payloads were subset-sized (codec overhead small)
+    for meta in stats["round_metadata"]:
+        for lid, nbytes in meta["uplink_bytes"].items():
+            assert nbytes < head_bytes * 2, (
+                f"{lid} shipped {nbytes} B — not adapter-sized "
+                f"(head={head_bytes} B, full={full_bytes} B)")
+
+
+def test_frozen_base_resets_each_round():
+    """Non-shipped tensors are frozen by the transport: whatever a learner
+    does locally, the model it evaluates/trains next round carries the
+    construction-time base."""
+    fed, template = _build(rounds=2)
+    learner = fed.learners[0]
+    stats, _ = _run(fed, rounds=2)
+    incoming = learner._load_model(fed.controller.community_model_bytes())
+    base_in = dict(pytree_to_named_tensors(incoming))
+    base_t = dict(pytree_to_named_tensors(template))
+    for name in base_t:
+        if "Dense_1" in name:
+            continue
+        np.testing.assert_array_equal(base_in[name], base_t[name])
+
+
+def test_topk_composes_with_ship_regex():
+    """Top-k sparse uplink over the shipped subset: the controller
+    densifies against its subset community model."""
+    fed, _ = _build(ship_dtype="topk2")
+    _, acc = _run(fed)
+    assert acc > 0.8, f"topk x ship-only federation failed to learn: {acc}"
+
+
+def test_fednova_composes_with_ship_regex():
+    """Stateful server rules track the SUBSET tree consistently (seeded
+    filtered, aggregated filtered)."""
+    fed, _ = _build(rule="fednova")
+    _, acc = _run(fed)
+    assert acc > 0.8, f"fednova x ship-only federation failed to learn: {acc}"
+
+
+def test_never_trained_learner_evaluates_subset_blob():
+    """A learner that never trained gets the regex from the eval task and
+    backfills the frozen base from its own initial values."""
+    from metisfl_tpu.comm.messages import EvalTask
+    from metisfl_tpu.learner.learner import Learner
+
+    shards, test = _shards(1)
+    engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                          shards[0].x[:2])
+    learner = Learner(engine, shards[0], controller=None,
+                      test_dataset=test)
+    named = pytree_to_named_tensors(engine.get_variables())
+    subset = [(n, a) for n, a in named if "Dense_1" in n]
+    blob = ModelBlob(tensors=subset).to_bytes()
+    result = learner.evaluate(EvalTask(
+        task_id="t", model=blob, batch_size=64, datasets=["test"],
+        ship_tensor_regex=HEAD))
+    assert "test" in result.evaluations
+    assert "accuracy" in result.evaluations["test"]
+    # without the regex the same subset blob must fail loudly
+    learner2 = Learner(engine, shards[0], controller=None,
+                      test_dataset=test)
+    with pytest.raises(KeyError):
+        learner2.evaluate(EvalTask(task_id="t", model=blob, batch_size=64,
+                                   datasets=["test"]))
+
+
+def test_checkpoint_roundtrip_is_subset_sized(tmp_path):
+    """Controller checkpoints persist only the federated subset and
+    restore into a working subset-resident controller."""
+    from metisfl_tpu.config import CheckpointConfig
+
+    config = FederationConfig(
+        train=TrainParams(batch_size=16, local_steps=4, learning_rate=0.2,
+                          ship_tensor_regex=HEAD),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=2),
+        checkpoint=CheckpointConfig(dir=str(tmp_path)),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(2)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+    finally:
+        fed.shutdown()
+    # restore into a fresh controller: community model is the subset
+    from metisfl_tpu.controller.core import Controller
+
+    fresh = Controller(config, proxy_factory=lambda record: None)
+    assert fresh.restore_checkpoint()
+    blob = ModelBlob.from_bytes(fresh.community_model_bytes())
+    assert blob.tensors and all("Dense_1" in n for n, _ in blob.tensors)
+
+
+def test_8b_lora_geometry_wire_blob_is_mb_sized():
+    """The north-star proof at true 8B geometry WITHOUT materializing it:
+    eval_shape the Llama-3-8B-LoRA variable tree (abstract — no memory),
+    apply the ship filter, and check the federated wire payload is
+    adapter-sized MBs while the full tree is ~double-digit GBs (over
+    gRPC's ~2 GiB framing; see module docstring)."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from metisfl_tpu.models.zoo.transformer import LlamaLite
+    from metisfl_tpu.tensor.pytree import _key_to_name
+
+    model = LlamaLite(vocab_size=128256, dim=4096, depth=32, heads=32,
+                      kv_heads=8, lora_rank=16, remat=True,
+                      dtype=jnp.bfloat16)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    flat = jax.tree_util.tree_flatten_with_path(variables)[0]
+    named_shapes = [(_key_to_name(p), leaf) for p, leaf in flat]
+    f32 = np.dtype(np.float32).itemsize  # the wire default
+    total = sum(int(np.prod(l.shape)) * f32 for _, l in named_shapes)
+    shipped = sum(int(np.prod(l.shape)) * f32
+                  for n, l in named_shapes if re.search("lora_", n))
+    assert shipped > 0
+    assert total > 30e9, f"not 8B-class: {total / 1e9:.1f} GB"
+    assert shipped < 100e6, (
+        f"adapters should be MBs, got {shipped / 1e6:.1f} MB")
+    # the blob the transport would carry fits ordinary RPC framing with
+    # orders of magnitude to spare; the full model does not
+    assert shipped < 2**31 < total
+
+
+def test_config_matrix():
+    """The validation matrix VERDICT r4 #2 asked for."""
+    def cfg(**kw):
+        train_kw = {"ship_tensor_regex": HEAD}
+        train_kw.update(kw.pop("train_kw", {}))
+        return FederationConfig(train=TrainParams(**train_kw), **kw)
+
+    cfg()  # baseline accepts
+    cfg(train_kw={"ship_dtype": "topk4"})          # topk composes
+    cfg(train_kw={"ship_dtype": "bf16"})           # narrowing composes
+    cfg(train_kw={"downlink_dtype": "bf16"})       # downlink composes
+    cfg(aggregation=AggregationConfig(rule="fednova"))   # stateful ok
+    cfg(aggregation=AggregationConfig(rule="median"))    # robust ok
+
+    with pytest.raises(ValueError, match="does not compile"):
+        cfg(train_kw={"ship_tensor_regex": "["})
+    with pytest.raises(ValueError, match="cannot combine"):
+        cfg(train_kw={"local_tensor_regex": "bias"})
+    with pytest.raises(ValueError, match="secure"):
+        cfg(aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="participants"),
+            secure=SecureAggConfig(enabled=True))
+    with pytest.raises(ValueError, match="scaffold"):
+        cfg(aggregation=AggregationConfig(rule="scaffold"))
+    with pytest.raises(ValueError, match="DP"):
+        cfg(train_kw={"dp_clip_norm": 1.0})
+
+    # the pod transport psum-averages every variable: it must refuse a
+    # subset-transport config instead of silently federating the base
+    from metisfl_tpu.driver.pod import PodFederationDriver
+
+    ds = ArrayDataset(np.zeros((8, 6), np.float32),
+                      np.zeros((8,), np.int32))
+    with pytest.raises(ValueError, match="ship_tensor_regex"):
+        PodFederationDriver(
+            FederationConfig(
+                aggregation=AggregationConfig(rule="fedavg",
+                                              scaler="participants"),
+                train=TrainParams(batch_size=4, local_steps=1,
+                                  ship_tensor_regex=HEAD)),
+            MLP(features=(4,), num_outputs=3), [ds, ds])
+
+
+def test_seed_rejects_regex_matching_nothing():
+    config = FederationConfig(
+        train=TrainParams(ship_tensor_regex="no_such_tensor_anywhere"))
+    fed = InProcessFederation(config)
+    shards, _ = _shards(1)
+    engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                          shards[0].x[:2])
+    fed.add_learner(engine, shards[0])
+    with pytest.raises(ValueError, match="matches no tensor"):
+        fed.seed_model(engine.get_variables())
+    fed.shutdown()
