@@ -5,6 +5,18 @@ The reference builds protobuf-codegen services with unlimited message sizes
 byte methods (no codegen): each endpoint is a named unary handler taking and
 returning codec/blob bytes. Retry-with-backoff on UNAVAILABLE mirrors
 grpc_services.py:60-75; unlimited message lengths mirror :28-30 and :93-97.
+
+Chunked transfer (SURVEY.md §7 hard parts): "unlimited" gRPC message sizes
+still stop at protobuf's ~2 GiB per-message framing, and the reference
+already collapsed well before that — its controller opens a fresh
+channel+stub per request to dodge a throughput cliff at ~100 MB FHE models
+(FIXME, reference metisfl/controller/core/controller.cc:594-604). Here
+every unary method transparently doubles as a chunked stream-stream method:
+payloads above ``STREAM_THRESHOLD`` are framed into ``CHUNK_BYTES``
+segments and reassembled server-side (and response-side), so a >2 GiB
+model blob round-trips through the same ``call()`` API. A unary response
+that would exceed framing is refused server-side with RESOURCE_EXHAUSTED
+and the client transparently retries over the chunked path.
 """
 
 from __future__ import annotations
@@ -29,6 +41,28 @@ _UNLIMITED = [
 
 _IDENTITY = lambda b: b  # noqa: E731 - bytes in, bytes out
 
+# Chunked-transfer framing. CHUNK_BYTES balances per-message overhead
+# against flow-control pipelining; STREAM_THRESHOLD stays far under both
+# protobuf's ~2 GiB hard framing limit and the reference's observed
+# ~100 MB reused-channel throughput cliff. Module-level so tests (and
+# operators) can tune them.
+CHUNK_BYTES = 32 * 1024 * 1024
+STREAM_THRESHOLD = 128 * 1024 * 1024
+# a unary RESPONSE above this cannot be framed — refuse server-side and
+# let the client retry chunked (margin under the 2 GiB wire limit)
+UNARY_RESPONSE_LIMIT = (2 << 30) - (64 << 20)
+_CHUNK_SUFFIX = "Chunked"
+_OVERSIZE_MARK = "response exceeds unary framing; retry chunked"
+
+
+def _iter_chunks(payload: bytes):
+    if not payload:
+        yield b""
+        return
+    view = memoryview(payload)
+    for i in range(0, len(payload), CHUNK_BYTES):
+        yield bytes(view[i : i + CHUNK_BYTES])
+
 
 class BytesService:
     """A named set of unary bytes→bytes methods served over gRPC."""
@@ -39,28 +73,58 @@ class BytesService:
         self.handlers = dict(handlers)
 
     def _generic_handler(self) -> grpc.GenericRpcHandler:
-        method_handlers = {
-            name: grpc.unary_unary_rpc_method_handler(
+        method_handlers = {}
+        for name, fn in self.handlers.items():
+            method_handlers[name] = grpc.unary_unary_rpc_method_handler(
                 self._wrap(fn),
                 request_deserializer=_IDENTITY,
                 response_serializer=_IDENTITY,
             )
-            for name, fn in self.handlers.items()
-        }
+            # every method transparently doubles as a chunked stream:
+            # RpcClient routes payloads above STREAM_THRESHOLD (and
+            # oversize-response retries) here
+            method_handlers[name + _CHUNK_SUFFIX] = \
+                grpc.stream_stream_rpc_method_handler(
+                    self._wrap_chunked(fn),
+                    request_deserializer=_IDENTITY,
+                    response_serializer=_IDENTITY,
+                )
         return grpc.method_handlers_generic_handler(
             self.service_name, method_handlers)
+
+    @staticmethod
+    def _abort(context: grpc.ServicerContext, exc: Exception):
+        code = getattr(exc, "code", None)
+        if isinstance(code, grpc.StatusCode):
+            context.abort(code, str(exc))
+        logger.exception("RPC handler failed")
+        context.abort(grpc.StatusCode.INTERNAL,
+                      f"{type(exc).__name__}: {exc}")
 
     @staticmethod
     def _wrap(fn: Callable[[bytes], bytes]):
         def handler(request: bytes, context: grpc.ServicerContext) -> bytes:
             try:
-                return fn(request)
+                result = fn(request)
             except Exception as exc:
-                code = getattr(exc, "code", None)
-                if isinstance(code, grpc.StatusCode):
-                    context.abort(code, str(exc))
-                logger.exception("RPC handler failed")
-                context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+                BytesService._abort(context, exc)
+            if len(result) > UNARY_RESPONSE_LIMIT:
+                # cannot frame this as one message — the client retries
+                # over the chunked method on this exact status+detail
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              _OVERSIZE_MARK)
+            return result
+
+        return handler
+
+    @staticmethod
+    def _wrap_chunked(fn: Callable[[bytes], bytes]):
+        def handler(request_iter, context: grpc.ServicerContext):
+            try:
+                result = fn(b"".join(request_iter))
+            except Exception as exc:
+                BytesService._abort(context, exc)
+            yield from _iter_chunks(result)
 
         return handler
 
@@ -122,20 +186,42 @@ class RpcClient:
                 self.target, channel_credentials(ssl), options=_UNLIMITED)
         else:
             self._channel = grpc.insecure_channel(self.target, options=_UNLIMITED)
+        # eager (threads only spawn on first submit): lazy init would race
+        # between the app thread and grpc callback threads
+        self._stream_pool = futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rpc-chunked")
+        # methods observed to need chunked responses: remember so later
+        # calls skip the fail-then-retry (which runs the handler twice)
+        self._chunked_methods: set = set()
 
     def call(self, method: str, payload: bytes, timeout: Optional[float] = None,
              wait_ready: bool = True) -> bytes:
-        fn = self._channel.unary_unary(
-            f"/{self.service_name}/{method}",
-            request_serializer=_IDENTITY,
-            response_deserializer=_IDENTITY,
-        )
+        chunked = (len(payload) > STREAM_THRESHOLD
+                   or method in self._chunked_methods)
         attempt = 0
         while True:
             try:
+                if chunked:
+                    return self._call_chunked(method, payload, timeout,
+                                              wait_ready)
+                fn = self._channel.unary_unary(
+                    f"/{self.service_name}/{method}",
+                    request_serializer=_IDENTITY,
+                    response_deserializer=_IDENTITY,
+                )
                 return fn(payload, timeout=timeout, wait_for_ready=wait_ready)
             except grpc.RpcError as exc:
                 code = exc.code() if hasattr(exc, "code") else None
+                if (not chunked
+                        and code == grpc.StatusCode.RESOURCE_EXHAUSTED
+                        and _OVERSIZE_MARK in (exc.details() or "")):
+                    # the handler's response exceeds unary framing (e.g. a
+                    # >2 GiB community model behind a tiny request):
+                    # transparently re-issue over the chunked stream, and
+                    # remember — the fail-then-retry runs the handler twice
+                    chunked = True
+                    self._chunked_methods.add(method)
+                    continue
                 if code == grpc.StatusCode.UNAVAILABLE and attempt < self.retries:
                     attempt += 1
                     logger.warning("%s/%s unavailable (attempt %d/%d)",
@@ -144,6 +230,16 @@ class RpcClient:
                     continue
                 raise
 
+    def _call_chunked(self, method: str, payload: bytes,
+                      timeout: Optional[float], wait_ready: bool) -> bytes:
+        fn = self._channel.stream_stream(
+            f"/{self.service_name}/{method}{_CHUNK_SUFFIX}",
+            request_serializer=_IDENTITY,
+            response_deserializer=_IDENTITY,
+        )
+        return b"".join(fn(_iter_chunks(payload), timeout=timeout,
+                           wait_for_ready=wait_ready))
+
     def call_async(self, method: str, payload: bytes,
                    callback: Optional[Callable[[bytes], None]] = None,
                    error_callback: Optional[Callable[[Exception], None]] = None,
@@ -151,7 +247,14 @@ class RpcClient:
                    wait_ready: bool = True):
         """Non-blocking unary call (the reference's CompletionQueue pattern,
         controller.cc:713-759, via grpc futures). ``wait_ready=False`` fails
-        fast with UNAVAILABLE on a dead endpoint instead of queueing."""
+        fast with UNAVAILABLE on a dead endpoint instead of queueing.
+        Payloads above STREAM_THRESHOLD (and oversize unary responses)
+        route through the chunked stream on a worker thread — stream
+        draining has no grpc-future form."""
+        if (len(payload) > STREAM_THRESHOLD
+                or method in self._chunked_methods):
+            return self._async_chunked(method, payload, callback,
+                                       error_callback, timeout, wait_ready)
         fn = self._channel.unary_unary(
             f"/{self.service_name}/{method}",
             request_serializer=_IDENTITY,
@@ -163,7 +266,13 @@ class RpcClient:
             try:
                 result = f.result()
             except Exception as exc:  # noqa: BLE001 - surfaced via callback
-                if error_callback is not None:
+                if (isinstance(exc, grpc.RpcError)
+                        and exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                        and _OVERSIZE_MARK in (exc.details() or "")):
+                    self._chunked_methods.add(method)
+                    self._async_chunked(method, payload, callback,
+                                        error_callback, timeout, wait_ready)
+                elif error_callback is not None:
                     error_callback(exc)
                 else:
                     logger.warning("async RPC %s failed: %s", method, exc)
@@ -174,5 +283,24 @@ class RpcClient:
         future.add_done_callback(_done)
         return future
 
+    def _async_chunked(self, method, payload, callback, error_callback,
+                       timeout, wait_ready):
+        def _run():
+            try:
+                result = self._call_chunked(method, payload, timeout,
+                                            wait_ready)
+            except Exception as exc:  # noqa: BLE001 - surfaced via callback
+                if error_callback is not None:
+                    error_callback(exc)
+                else:
+                    logger.warning("async chunked RPC %s failed: %s",
+                                   method, exc)
+                return
+            if callback is not None:
+                callback(result)
+
+        return self._stream_pool.submit(_run)
+
     def close(self) -> None:
+        self._stream_pool.shutdown(wait=False)
         self._channel.close()
