@@ -49,19 +49,29 @@ from metisfl_tpu.aggregation.base import (
 )
 
 
+def median_leaf(s):
+    """Coordinate median over the leading cohort axis — the ONE
+    definition both the host rule and the pod-mode device combine
+    (parallel/collectives.make_robust_pod_combine) compile."""
+    return jnp.median(s, axis=0)
+
+
+def trimmed_mean_leaf(s, trim: int):
+    """Coordinate trimmed mean over the leading cohort axis (shared with
+    the pod-mode device combine; trim semantics from TrimmedMean._trim)."""
+    s = jnp.sort(s, axis=0)
+    kept = s[trim: s.shape[0] - trim] if trim else s
+    return kept.mean(axis=0)
+
+
 @jax.jit
 def _median_tree(stacked: Pytree) -> Pytree:
-    return jax.tree.map(lambda s: jnp.median(s, axis=0), stacked)
+    return jax.tree.map(median_leaf, stacked)
 
 
 @functools.partial(jax.jit, static_argnames=("trim",))
 def _trimmed_mean_tree(stacked: Pytree, trim: int) -> Pytree:
-    def leaf(s):
-        s = jnp.sort(s, axis=0)
-        kept = s[trim: s.shape[0] - trim] if trim else s
-        return kept.mean(axis=0)
-
-    return jax.tree.map(leaf, stacked)
+    return jax.tree.map(lambda s: trimmed_mean_leaf(s, trim), stacked)
 
 
 @functools.partial(jax.jit, static_argnames=("f",))

@@ -85,6 +85,39 @@ def _acc(dtype):
     return dtype
 
 
+def make_robust_pod_combine(mesh: Mesh, rule: str, trim: int = 0,
+                            axis_name: str = "fed") -> Callable:
+    """Device-resident byzantine-robust combine for the ICI fast path.
+
+    ``stacked`` trees carry a leading learner axis sharded over ``fed``
+    (each learner's trained model on its own slice); the combine is a
+    coordinate-wise median or trimmed mean over that axis — XLA inserts
+    the all-gather over ICI, sorts on device, and the community model
+    comes out replicated. Host-path parity: same f32 accumulation and the
+    same trim count as :class:`aggregation.robust.TrimmedMean` (pass its
+    ``_trim(L)``); scales are ignored by construction — robustness comes
+    precisely from not letting any learner claim more weight
+    (aggregation/robust.py module contract). Memory note: the gather
+    materializes L models per device, the price of a sort none of the
+    psum algebra can pay."""
+    if rule not in ("median", "trimmed_mean"):
+        raise ValueError(f"unknown robust pod rule {rule!r}")
+    # the ONE leaf definition shared with the host rules — parity by
+    # construction, not by synchronized copies
+    from metisfl_tpu.aggregation.robust import median_leaf, trimmed_mean_leaf
+
+    def combine(stacked):
+        def leaf(s):
+            acc = s.astype(_acc(s.dtype))
+            r = (median_leaf(acc) if rule == "median"
+                 else trimmed_mean_leaf(acc, trim))
+            return r.astype(s.dtype)
+
+        return jax.tree.map(leaf, stacked)
+
+    return jax.jit(combine, out_shardings=NamedSharding(mesh, P()))
+
+
 def replicate_to_fed(mesh: Mesh, params, axis_name: str = "fed"):
     """Place a host pytree fully replicated on the mesh."""
     sharding = NamedSharding(mesh, P())

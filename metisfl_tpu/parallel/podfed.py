@@ -58,12 +58,34 @@ class PodFederation:
         loss: str | Callable = "softmax_cross_entropy",
         mesh: Optional[Mesh] = None,
         rng_seed: int = 0,
+        rule: str = "fedavg",
+        trim_ratio: float = 0.1,
     ):
+        # rule="median"/"trimmed_mean": byzantine-robust aggregation WITHOUT
+        # leaving the device mesh — the round's psum is replaced by an
+        # all-gather + coordinate sort over `fed` (collectives.
+        # make_robust_pod_combine); scales are ignored by construction,
+        # matching the host rules (aggregation/robust.py)
+        if rule not in ("fedavg", "median", "trimmed_mean"):
+            raise ValueError(f"unknown pod aggregation rule {rule!r}")
+        self.rule = rule
         self.module = module
         self.num_learners = num_learners
         self.train_params = train_params or TrainParams()
         self.loss_fn = _LOSSES[loss] if isinstance(loss, str) else loss
         self.mesh = mesh or federation_mesh(num_learners)
+        if rule != "fedavg":
+            from metisfl_tpu.aggregation.robust import TrimmedMean
+
+            from metisfl_tpu.parallel.collectives import \
+                make_robust_pod_combine
+
+            trim = (TrimmedMean(trim_ratio)._trim(num_learners)
+                    if rule == "trimmed_mean" else 0)
+            self._robust_combine = make_robust_pod_combine(
+                self.mesh, rule, trim)
+        else:
+            self._robust_combine = None
         if self.mesh.shape["fed"] != num_learners:
             raise ValueError(
                 f"mesh fed axis {self.mesh.shape['fed']} != {num_learners}")
@@ -153,11 +175,17 @@ class PodFederation:
 
         data_spec = self._data_spec
         axis_names = tuple(mesh.axis_names)
+        robust = self.rule != "fedavg"
+        # robust rules sort across the cohort, so the round emits each
+        # learner's trained model stacked over `fed` and a second jitted
+        # combine (all-gather + sort, still device-resident) replaces the
+        # psum; fedavg keeps the single-program weighted-psum fast path
+        model_spec = P("fed") if robust else P()
 
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(P(), P(), data_spec, data_spec, P("fed"), P("fed")),
-            out_specs=(P(), P(), P("fed")),
+            out_specs=(model_spec, model_spec, P("fed")),
         )
         def fed_round(community, batch_stats, x, y, scales, seeds):
             # Cast the replicated community model to device-varying BEFORE
@@ -170,6 +198,17 @@ class PodFederation:
             rng = jax.random.PRNGKey(seeds[0])
             trained, new_bs, losses = local_train(
                 community, batch_stats, x[0], y[0], rng)
+            if robust:
+                if has_dp:
+                    trained = jax.tree.map(
+                        lambda t: jax.lax.pmean(t, "dp"), trained)
+                    new_bs = jax.tree.map(
+                        lambda t: jax.lax.pmean(t, "dp"), new_bs)
+                # stacked over fed (leading axis 1 per shard); scales are
+                # ignored — the robust contract
+                return (jax.tree.map(lambda t: t[None], trained),
+                        jax.tree.map(lambda t: t[None], new_bs),
+                        losses[None])
             scale = scales[0]
             community = jax.tree.map(
                 lambda t: jax.lax.psum(t * scale, "fed"), trained)
@@ -218,6 +257,11 @@ class PodFederation:
         bs = self.batch_stats if self.batch_stats is not None else {}
         self.params, new_bs, losses = self._round_fn(
             self.params, bs, x_sharded, y_sharded, s_sharded, seeds_sharded)
+        if self._robust_combine is not None:
+            # second device-resident program: all-gather over fed + sort;
+            # the community model comes back replicated for the next round
+            self.params = self._robust_combine(self.params)
+            new_bs = self._robust_combine(new_bs)
         if self.batch_stats is not None:
             self.batch_stats = new_bs
         losses = np.asarray(losses)

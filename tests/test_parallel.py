@@ -551,3 +551,124 @@ def test_llama3_8b_lora_train_step_lowers_on_64_device_topology():
     ).trace(variables, tokens).lower(lowering_platforms=("tpu",))
     hlo = lowered.as_text()
     assert "sharding" in hlo  # the lowering is actually sharded
+
+
+def test_podfederation_median_rule_resists_poison():
+    """Device-resident robust aggregation (VERDICT r4 #8): a pod round
+    with rule='median' bounds a byzantine learner that the weighted-psum
+    fedavg path would let steer the community model arbitrarily — and the
+    device combine matches the host CoordinateMedian on the same stacked
+    models."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from metisfl_tpu.aggregation.robust import CoordinateMedian
+
+    L, K, B = 8, 3, 8
+    x, y = _pod_data(L, K, B, seed=3)
+    # learner 0 is poisoned: absurd inputs drive its local model far out
+    x_poison = x.copy()
+    x_poison[0] = 1e4
+    kwargs = dict(
+        sample_input=np.zeros((2, 12), np.float32),
+        num_learners=L,
+        train_params=TrainParams(optimizer="sgd", learning_rate=0.1,
+                                 batch_size=B, local_steps=K),
+    )
+    clean = PodFederation(MLP(features=(16,), num_outputs=4), **kwargs)
+    clean.run_round(x, y)
+    med = PodFederation(MLP(features=(16,), num_outputs=4), rule="median",
+                        **kwargs)
+    med.run_round(x_poison, y)
+    avg = PodFederation(MLP(features=(16,), num_outputs=4), **kwargs)
+    avg.run_round(x_poison, y)
+
+    def dist(a, b):
+        return float(sum(
+            np.sum((np.asarray(p) - np.asarray(q)) ** 2)
+            for p, q in zip(jax.tree.leaves(a), jax.tree.leaves(b))) ** 0.5)
+
+    d_med = dist(med.community_params(), clean.community_params())
+    d_avg = dist(avg.community_params(), clean.community_params())
+    assert d_med < d_avg / 5, (d_med, d_avg)
+
+    # device combine == host rule on the exact same stacked models
+    pod = PodFederation(MLP(features=(16,), num_outputs=4), rule="median",
+                        **kwargs)
+    seeds = np.arange(L, dtype=np.uint32) + np.uint32(1)
+    put = lambda v, spec: jax.device_put(  # noqa: E731
+        jnp.asarray(v), NamedSharding(pod.mesh, spec))
+    stacked, _, _ = pod._round_fn(
+        pod.params, {}, put(x, pod._data_spec), put(y, pod._data_spec),
+        put(np.full((L,), 1.0 / L, np.float32), P("fed")),
+        put(seeds, P("fed")))
+    device_med = jax.tree.map(np.asarray, pod._robust_combine(stacked))
+    host_models = [jax.tree.map(lambda s, i=i: np.asarray(s)[i], stacked)
+                   for i in range(L)]
+    host_med = CoordinateMedian().aggregate(
+        [([m], 1.0 / L) for m in host_models])
+    jax.tree.map(
+        lambda d, h: np.testing.assert_allclose(
+            np.asarray(d), np.asarray(h), atol=1e-5),
+        device_med, host_med)
+
+
+def test_podfederation_trimmed_mean_matches_host():
+    """Pod trimmed_mean uses the host rule's exact trim count and matches
+    its combine on identical stacked models (fed x dp mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from metisfl_tpu.aggregation.robust import TrimmedMean
+    from metisfl_tpu.parallel.mesh import federation_mesh
+
+    L, K, B = 4, 2, 8
+    mesh = federation_mesh(L, inner_axes=("dp",), inner_sizes=(2,))
+    pod = PodFederation(
+        MLP(features=(16,), num_outputs=4),
+        sample_input=np.zeros((2, 12), np.float32),
+        num_learners=L,
+        train_params=TrainParams(optimizer="sgd", learning_rate=0.1,
+                                 batch_size=B, local_steps=K),
+        mesh=mesh,
+        rule="trimmed_mean",
+        trim_ratio=0.25,
+    )
+    x, y = _pod_data(L, K, B, seed=4)
+    out = pod.run_round(x, y)
+    assert np.isfinite(out["mean_loss"])
+
+    pod2 = PodFederation(
+        MLP(features=(16,), num_outputs=4),
+        sample_input=np.zeros((2, 12), np.float32),
+        num_learners=L,
+        train_params=TrainParams(optimizer="sgd", learning_rate=0.1,
+                                 batch_size=B, local_steps=K),
+        mesh=mesh,
+        rule="trimmed_mean",
+        trim_ratio=0.25,
+    )
+    seeds = np.arange(L, dtype=np.uint32) + np.uint32(1)
+    put = lambda v, spec: jax.device_put(  # noqa: E731
+        jnp.asarray(v), NamedSharding(mesh, spec))
+    stacked, _, _ = pod2._round_fn(
+        pod2.params, {}, put(x, pod2._data_spec), put(y, pod2._data_spec),
+        put(np.full((L,), 1.0 / L, np.float32), P("fed")),
+        put(seeds, P("fed")))
+    device_tm = jax.tree.map(np.asarray, pod2._robust_combine(stacked))
+    host_models = [jax.tree.map(lambda s, i=i: np.asarray(s)[i], stacked)
+                   for i in range(L)]
+    host_tm = TrimmedMean(0.25).aggregate(
+        [([m], 1.0 / L) for m in host_models])
+    jax.tree.map(
+        lambda d, h: np.testing.assert_allclose(
+            np.asarray(d), np.asarray(h), atol=1e-5),
+        device_tm, host_tm)
+
+
+def test_podfederation_rejects_unknown_rule():
+    with pytest.raises(ValueError, match="unknown pod aggregation rule"):
+        PodFederation(
+            MLP(features=(8,), num_outputs=4),
+            sample_input=np.zeros((2, 12), np.float32),
+            num_learners=4,
+            rule="krum",  # distance selection needs a different program
+        )
