@@ -47,9 +47,12 @@ def _state(phase, **kw):
 
 def _items():
     # headline first: MFU is the round's missing number, cheapest/most
-    # likely-to-win variants leading; agg re-captures cheaply after
+    # likely-to-win variants leading; agg re-captures cheaply after; the
+    # product-loop round (e2e) and decode/flash/train follow; the
+    # 1.2B-param lora compile is the likeliest wedge trigger so it goes
+    # LAST — a wedge there forfeits nothing already banked
     items = [f"mfu:{label}" for label, _ in bench._MFU_VARIANTS]
-    items += ["agg", "flash", "train", "decode"]
+    items += ["agg", "e2e", "flash", "train", "decode", "lora"]
     return items
 
 
@@ -79,7 +82,7 @@ def _run_item(item, details, errors, info):
 def main():
     hours = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
     out_path = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
-        _REPO, "bench_results", "tpu_v5e_round4_watch.json")
+        _REPO, "bench_results", "tpu_v5e_round5_watch.json")
     deadline = time.time() + hours * 3600
     info = {"orig_platforms": os.environ.get("JAX_PLATFORMS") or "axon",
             "degraded_to_cpu": True, "last_dead_ts": 0.0}

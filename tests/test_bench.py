@@ -338,3 +338,106 @@ def test_mfu_fail_fast_dead_tunnel_degrades(monkeypatch):
     # only the first variant burned a child; the rest were skipped
     assert "mfu.b8_dense_scan8" not in errors
     assert errors.get("mfu") == "skipped: backend degraded"
+
+
+def test_key_section_mapping_covers_device_keys():
+    import bench
+
+    assert bench._key_section("ms_per_round_median") == "agg"
+    assert bench._key_section("lm_b8_dense_ms_per_step") == "mfu"
+    assert bench._key_section("mfu") == "mfu"
+    assert bench._key_section("attn_dense_s2048_fwd_ms") == "flash"
+    assert bench._key_section("attn_flash_best_blk") == "flash"
+    assert bench._key_section("e2e_round_wall_clock_s") == "e2e"
+    assert bench._key_section("lora_1b_mfu") == "lora"
+    assert bench._key_section("store_disk_select_all_ms") is None
+    assert bench._key_section("ckks_encrypt_ms") is None
+
+
+def test_watcher_capture_merges_into_official(tmp_path, monkeypatch):
+    """VERDICT r4 #9: a watcher capture with on-chip sections closes the
+    official channel — no-clobber, per section, newest file wins."""
+    import json as _json
+
+    import bench
+
+    results = tmp_path / "bench_results"
+    results.mkdir()
+    capture = {
+        "details": {
+            "agg_backend": "tpu",
+            "ms_per_round_median": 97.2,
+            "num_learners": 64,
+            "mfu_backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "lm_b8_dense_ms_per_step": 50.0,
+            "lm_b8_dense_tokens_per_sec": 163840.0,
+            "decode_backend": "cpu",      # NOT merged: not on chip
+            "decode_tokens_per_sec": 1.0,
+        },
+        "errors": {},
+    }
+    (results / "tpu_v5e_round5_watch.json").write_text(
+        _json.dumps(capture))
+    monkeypatch.setattr(
+        bench.os.path, "abspath",
+        lambda p, _real=bench.os.path.abspath: str(tmp_path / "bench.py")
+        if p.endswith("bench.py") else _real(p))
+
+    details = {
+        "ms_per_round_median": 2500.0,   # the degraded CPU number
+        "agg_backend": "cpu",
+        "decode_backend": "cpu",
+    }
+    errors = {}
+    bench._merge_watcher_capture(details, errors)
+    assert details["ms_per_round_median"] == 97.2     # on-chip wins
+    assert details["agg_backend"] == "tpu"
+    assert details["lm_b8_dense_ms_per_step"] == 50.0
+    assert details["mfu_backend"] == "tpu"
+    assert "lm_best_variant" in details               # rollup recomputed
+    assert details["decode_backend"] == "cpu"         # cpu capture ignored
+    assert "decode_tokens_per_sec" not in details
+    assert details["watcher_merged_sections"] == ["agg", "mfu"]
+
+
+def test_watcher_capture_never_clobbers_onchip_official(tmp_path,
+                                                        monkeypatch):
+    import json as _json
+
+    import bench
+
+    results = tmp_path / "bench_results"
+    results.mkdir()
+    (results / "x_watch.json").write_text(_json.dumps({
+        "details": {"agg_backend": "tpu", "ms_per_round_median": 500.0}}))
+    monkeypatch.setattr(
+        bench.os.path, "abspath",
+        lambda p, _real=bench.os.path.abspath: str(tmp_path / "bench.py")
+        if p.endswith("bench.py") else _real(p))
+    details = {"agg_backend": "tpu", "ms_per_round_median": 80.0}
+    bench._merge_watcher_capture(details, {})
+    assert details["ms_per_round_median"] == 80.0
+    assert "watcher_merged_sections" not in details
+
+
+def test_new_sections_registered():
+    import bench
+
+    for name in ("e2e", "cohort", "lora"):
+        assert name in bench._SECTIONS
+        assert name in bench._SECTION_TIMEOUTS
+    assert "lora" == bench._DEVICE_SECTIONS[-1]  # likeliest wedge last
+    assert "cohort" in bench._HOST_SECTIONS
+    # watcher items cover the new device sections
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location(
+        "tpu_watch", bench.os.path.join(
+            bench.os.path.dirname(bench.os.path.abspath(bench.__file__)),
+            "scripts", "tpu_watch.py"))
+    # (import executes chdir/sys.path side effects only)
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    items = mod._items()
+    assert "e2e" in items and "lora" in items
+    assert items[-1] == "lora"
