@@ -1,0 +1,59 @@
+"""Paillier additive-HE walkthrough: encrypt → aggregate → decrypt.
+
+Counterpart of the reference's Paillier demo material (reference
+test/fhe/demo/paillier_example.py next to its CKKS example): three
+"learners" encrypt weight vectors, the aggregator computes the weighted
+average ON CIPHERTEXTS (it never decrypts), and the learners decrypt the
+community vector. Demo-grade by design — production secure aggregation
+here is CKKS (native/ckks.cc) or pairwise masking; see
+metisfl_tpu/secure/paillier.py's module docstring.
+
+    python examples/paillier_demo.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from metisfl_tpu.secure.paillier import (
+    decrypt_vector,
+    encrypt_vector,
+    generate_keypair,
+    weighted_sum,
+)
+
+
+def main() -> int:
+    t0 = time.time()
+    pub, priv = generate_keypair(bits=1024)
+    print(f"keygen (n: 1024 bits): {time.time() - t0:.2f}s")
+
+    rng = np.random.default_rng(0)
+    learners = [rng.standard_normal(16) for _ in range(3)]
+    weights = [0.5, 0.3, 0.2]
+
+    t0 = time.time()
+    encrypted = [encrypt_vector(pub, v) for v in learners]
+    print(f"encrypt 3 x 16 coords: {time.time() - t0:.2f}s")
+
+    t0 = time.time()
+    community_ct = weighted_sum(pub, encrypted, weights)
+    print(f"homomorphic weighted sum: {time.time() - t0:.2f}s")
+
+    community = decrypt_vector(priv, community_ct, weighted=True)
+    expected = sum(w * v for w, v in zip(weights, learners))
+    err = float(np.max(np.abs(community - expected)))
+    print(f"max |decrypted - plaintext| = {err:.2e}")
+    assert err < 1e-8, err
+    print("paillier demo: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

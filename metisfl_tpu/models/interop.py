@@ -27,7 +27,8 @@ BatchNorm's bias never pairs with a conv's) with every shape checked. An
 explicit ``name_map`` overrides matching for architectures whose module
 order differs. Caveat (same as any cross-framework converter): a Linear
 fed by a spatial ``flatten`` mixes channel orders (torch flattens CHW,
-Flax HWC) — such kernels need a custom permutation via ``transforms``;
+Flax HWC) — :func:`flatten_head_permutation` builds the repairing
+``transforms`` entry from the feature-map geometry at the flatten point;
 models that pool before the head import exactly.
 """
 
@@ -230,6 +231,42 @@ def import_named_weights(
 
     named = [(name, out[name]) for name, _ in target_named]
     return named_tensors_to_pytree(named, variables)
+
+
+def flatten_head_permutation(spatial: Tuple[int, ...], channels: int
+                             ) -> Callable[[np.ndarray], np.ndarray]:
+    """The ``transforms`` hook for a Linear fed by a spatial flatten.
+
+    torch flattens conv feature maps channel-first (``C, *spatial``) while
+    Flax flattens channel-last (``*spatial, C``), so the imported kernel's
+    input rows arrive in the wrong order (the module-docstring caveat).
+    Given the FEATURE-MAP geometry at the flatten point — its spatial
+    shape and channel count — this returns the row permutation that
+    repairs the kernel::
+
+        transforms={"classifier.0.weight":
+                    flatten_head_permutation((4, 4), channels=64)}
+
+    Applied AFTER the framework layout transform, i.e. to the ``(in,
+    out)``-layout kernel.
+    """
+    spatial = tuple(int(s) for s in spatial)
+    torch_order = np.arange(
+        int(channels) * int(np.prod(spatial))).reshape(
+        (int(channels),) + spatial)
+    # Flax row i (flattened *spatial, C order) must read the torch row
+    # that held the same (c, *spatial) element
+    perm = np.transpose(
+        torch_order, tuple(range(1, 1 + len(spatial))) + (0,)).ravel()
+
+    def transform(arr: np.ndarray) -> np.ndarray:
+        if arr.ndim != 2 or arr.shape[0] != perm.size:
+            raise ValueError(
+                f"flatten_head_permutation for {perm.size} input rows got "
+                f"kernel shape {arr.shape}")
+        return arr[perm]
+
+    return transform
 
 
 def load_npz(path: str) -> Dict[str, np.ndarray]:

@@ -167,3 +167,60 @@ def test_name_map_pins_target():
                                          "params/Dense_1/bias"})
     flat = dict(pytree_to_named_tensors(out))
     np.testing.assert_array_equal(flat["params/Dense_1/bias"], arr)
+
+
+def test_torch_flatten_head_forward_parity():
+    """The module-docstring caveat, CLOSED: a Linear fed by a spatial
+    flatten imports exactly when its kernel passes through
+    flatten_head_permutation (torch flattens CHW, Flax flattens HWC)."""
+    torch = pytest.importorskip("torch")
+    tnn = torch.nn
+
+    from metisfl_tpu.models.interop import flatten_head_permutation
+
+    class TorchFlatCNN(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = tnn.Conv2d(1, 6, 3, padding=1)
+            self.fc = tnn.Linear(6 * 5 * 5, 10)
+
+        def forward(self, x):
+            x = torch.relu(self.conv(x))
+            x = torch.flatten(x, 1)
+            return self.fc(x)
+
+    class FlaxFlatCNN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Conv(6, (3, 3), padding="SAME")(x))
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(10)(x)
+
+    torch.manual_seed(3)
+    tmodel = TorchFlatCNN().eval()
+    batch = np.random.default_rng(2).standard_normal((4, 5, 5, 1)).astype(
+        np.float32)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(
+            np.transpose(batch, (0, 3, 1, 2)))).numpy()
+
+    fmodel = FlaxFlatCNN()
+    variables = _flax_init(fmodel, (1, 5, 5, 1))
+    # WITHOUT the permutation the head mixes channel orders: outputs differ
+    mixed = from_torch_state_dict(tmodel.state_dict(), variables)
+    assert not np.allclose(
+        np.asarray(fmodel.apply(mixed, batch)), want, atol=1e-4)
+    # WITH it: exact parity from the feature-map geometry alone
+    imported = from_torch_state_dict(
+        tmodel.state_dict(), variables,
+        transforms={"fc.weight": flatten_head_permutation((5, 5), 6)})
+    got = np.asarray(fmodel.apply(imported, batch))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_flatten_head_permutation_validates_shape():
+    from metisfl_tpu.models.interop import flatten_head_permutation
+
+    transform = flatten_head_permutation((2, 2), 3)
+    with pytest.raises(ValueError, match="input rows"):
+        transform(np.zeros((5, 4), np.float32))

@@ -1,0 +1,66 @@
+"""Paillier demo scheme (secure/paillier.py) — executable specification
+of the additive-HE math (reference test/fhe/demo/paillier_example.py
+role)."""
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.secure.paillier import (
+    decrypt_vector,
+    encrypt_vector,
+    generate_keypair,
+    weighted_sum,
+)
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    return generate_keypair(bits=512)  # small n: fast tests, same math
+
+
+def test_roundtrip_signed_ints(keypair):
+    pub, priv = keypair
+    for m in (0, 1, -1, 12345, -98765, 2**31):
+        assert priv.decrypt_int(pub.encrypt_int(m)) == m
+
+
+def test_additive_homomorphism(keypair):
+    pub, priv = keypair
+    a, b = 1234, -567
+    c = pub.add(pub.encrypt_int(a), pub.encrypt_int(b))
+    assert priv.decrypt_int(c) == a + b
+
+
+def test_plaintext_scaling(keypair):
+    pub, priv = keypair
+    c = pub.scale(pub.encrypt_int(-21), 3)
+    assert priv.decrypt_int(c) == -63
+    with pytest.raises(ValueError, match="non-negative"):
+        pub.scale(c, -1)
+
+
+def test_ciphertexts_randomized(keypair):
+    pub, _ = keypair
+    assert pub.encrypt_int(7) != pub.encrypt_int(7)
+
+
+def test_weighted_average_never_decrypts(keypair):
+    pub, priv = keypair
+    rng = np.random.default_rng(3)
+    vecs = [rng.standard_normal(8) for _ in range(3)]
+    weights = [0.5, 0.3, 0.2]
+    ct = weighted_sum(pub, [encrypt_vector(pub, v) for v in vecs], weights)
+    got = decrypt_vector(priv, ct, weighted=True)
+    want = sum(w * v for w, v in zip(weights, vecs))
+    np.testing.assert_allclose(got, want, atol=1e-8)
+
+
+def test_weighted_sum_validates_shapes(keypair):
+    pub, _ = keypair
+    enc = encrypt_vector(pub, [1.0, 2.0])
+    with pytest.raises(ValueError, match="one weight"):
+        weighted_sum(pub, [enc], [0.5, 0.5])
+    with pytest.raises(ValueError, match="share a length"):
+        weighted_sum(pub, [enc, enc[:1]], [0.5, 0.5])
+    with pytest.raises(ValueError, match="nothing"):
+        weighted_sum(pub, [], [])
