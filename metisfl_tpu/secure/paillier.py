@@ -46,6 +46,10 @@ def _is_probable_prime(n: int, rounds: int = 40) -> bool:
     """Miller-Rabin with random bases (error ≤ 4^-rounds)."""
     if n < 2:
         return False
+    if n == 2:
+        return True
+    if n % 2 == 0:
+        return False
     for p in _SMALL_PRIMES:
         if n == p:
             return True
@@ -71,7 +75,10 @@ def _is_probable_prime(n: int, rounds: int = 40) -> bool:
 
 def _random_prime(bits: int) -> int:
     while True:
-        cand = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+        # top TWO bits set: p·q of two such primes always reaches the full
+        # 2·bits length (single-top-bit primes can lose a bit in n = p·q)
+        cand = (secrets.randbits(bits)
+                | (1 << (bits - 1)) | (1 << (bits - 2)) | 1)
         if _is_probable_prime(cand):
             return cand
 
@@ -170,7 +177,12 @@ def weighted_sum(pub: PaillierPublicKey,
     int_weights = [int(round(float(w) * wscale)) for w in weights]
     out: List[int] = []
     for j in range(length):
-        acc = pub.encrypt_int(0)
+        # 1 is the multiplicative identity = an (unrandomized) Enc(0);
+        # seeding with encrypt_int(0) would cost a full n-bit modexp per
+        # coordinate — ~10x the three 32-bit-weight scalings combined.
+        # Each term carries its own encryption randomness, so the product
+        # is a properly randomized ciphertext.
+        acc = 1
         for cv, iw in zip(ciphervecs, int_weights):
             acc = pub.add(acc, pub.scale(cv[j], iw))
         out.append(acc)

@@ -64,3 +64,19 @@ def test_weighted_sum_validates_shapes(keypair):
         weighted_sum(pub, [enc, enc[:1]], [0.5, 0.5])
     with pytest.raises(ValueError, match="nothing"):
         weighted_sum(pub, [], [])
+
+
+def test_modulus_reaches_documented_bits():
+    from metisfl_tpu.secure.paillier import generate_keypair
+
+    for _ in range(3):
+        pub, _ = generate_keypair(bits=256)
+        assert pub.n.bit_length() == 256
+
+
+def test_small_prime_probe_handles_two():
+    from metisfl_tpu.secure.paillier import _is_probable_prime
+
+    assert _is_probable_prime(2)
+    assert not _is_probable_prime(4)
+    assert _is_probable_prime(3)
