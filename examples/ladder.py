@@ -76,7 +76,11 @@ def _run_rung(name, module_fn, shards, config, rounds, secure_backends=None,
 
     t0 = time.time()
     fed.start()
-    ok = fed.wait_for_rounds(rounds, timeout_s=1200)
+    # budget scales with the work: a full-scale x32 round takes ~950 s on
+    # the single-core host (ladder_fullscale_cpu_round5.json) — a flat cap
+    # would throw away completed training on exactly the documented runs
+    timeout_s = max(1200, 90 * len(shards) * rounds)
+    ok = fed.wait_for_rounds(rounds, timeout_s=timeout_s)
     wall = time.time() - t0
     stats = fed.statistics()
     fed.shutdown()
@@ -189,12 +193,13 @@ def rung_bert(rounds, workdir):
         controller_backend=CKKSBackend(role="controller"))
 
 
-def rung_vit_full(rounds, workdir):
+def rung_vit_full(rounds, workdir, learners=2, optimizer="adam"):
     """ViT-B/16 at FULL reference scale (dim 768 / depth 12 / heads 12 /
-    patch 16, 224x224x3 inputs, ~86M params) x 2 learners, semi-sync —
-    proof the ladder executes at real model scale, not only -lite shapes
-    (VERDICT r3 weak #7). Tiny shard sizes keep the single-host wall-clock
-    in minutes; the model is the real thing."""
+    patch 16, 224x224x3 inputs, ~86M params), semi-sync — proof the
+    ladder executes at real model scale, not only -lite shapes (VERDICT
+    r3 weak #7; ``--learners-full 32`` runs the BASELINE rung-3 cohort
+    shape). Tiny shard sizes keep the single-host wall-clock in minutes;
+    the model is the real thing."""
     from metisfl_tpu.comm.messages import TrainParams
     from metisfl_tpu.config import (
         AggregationConfig, EvalConfig, FederationConfig, TerminationConfig)
@@ -204,23 +209,27 @@ def rung_vit_full(rounds, workdir):
         protocol="semi_synchronous",
         semi_sync_lambda=1.0,
         aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
-        train=TrainParams(batch_size=2, local_steps=1, optimizer="adam",
+        train=TrainParams(batch_size=2, local_steps=1, optimizer=optimizer,
                           learning_rate=3e-4),
         eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=rounds),
     )
-    shards = _image_shards(2, 4, (224, 224, 3), 1000, seed=4)
+    shards = _image_shards(learners, 4, (224, 224, 3), 1000, seed=4)
     return _run_rung(
-        "vit_b16_full_x2_semisync",
+        f"vit_b16_full_x{learners}_semisync",
         lambda: ViTLite(num_classes=1000, dim=768, depth=12, heads=12,
                         patch=16),
         shards, config, rounds)
 
 
-def rung_bert_full(rounds, workdir):
+def rung_bert_full(rounds, workdir, learners=2, optimizer="adam"):
     """BERT-base at FULL reference scale (vocab 30522, dim 768 / depth 12 /
     heads 12, ~110M params; sequences at 128 to bound single-host step
-    time — the MODEL is full-size) x 2 learners, asynchronous."""
+    time — the MODEL is full-size), asynchronous (``--learners-full 64``
+    runs the BASELINE rung-5 cohort shape; watch host RAM — ~1.3 GB per
+    concurrently-training learner with adam, so the x64 single-host run
+    uses ``--optimizer-full sgd`` — the protocol x cohort shape is the
+    point of the rung, not the local optimizer)."""
     from metisfl_tpu.comm.messages import TrainParams
     from metisfl_tpu.config import (
         AggregationConfig, EvalConfig, FederationConfig, TerminationConfig)
@@ -229,14 +238,15 @@ def rung_bert_full(rounds, workdir):
     config = FederationConfig(
         protocol="asynchronous",
         aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
-        train=TrainParams(batch_size=2, local_steps=1, optimizer="adam",
+        train=TrainParams(batch_size=2, local_steps=1, optimizer=optimizer,
                           learning_rate=3e-4),
         eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=rounds),
     )
-    shards = _token_shards(2, 4, seq=128, vocab=30522, classes=2, seed=5)
+    shards = _token_shards(learners, 4, seq=128, vocab=30522, classes=2,
+                           seed=5)
     return _run_rung(
-        "bert_base_full_x2_async",
+        f"bert_base_full_x{learners}_async",
         lambda: BertLite(vocab_size=30522, num_classes=2, dim=768, depth=12,
                          heads=12, max_len=128),
         shards, config, rounds)
@@ -254,8 +264,18 @@ def main() -> int:
     parser.add_argument("--rungs", default="resnet,vit,bert",
                         help=f"comma list from {sorted(RUNGS)}")
     parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--learners-full", type=int, default=2,
+                        help="cohort size for the *_full rungs (BASELINE "
+                             "shapes: vit_full 32, bert_full 64)")
+    parser.add_argument("--optimizer-full", default="adam",
+                        help="local optimizer for the *_full rungs (sgd "
+                             "bounds host RAM on large single-host runs)")
     parser.add_argument("--workdir", default="")
     args = parser.parse_args()
+    # a typo here must fail in milliseconds, not after tens of GB of
+    # full-scale learner construction
+    from metisfl_tpu.models.optimizers import make_optimizer
+    make_optimizer(args.optimizer_full, 1e-3, {})
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="metisfl_tpu_ladder_")
     os.makedirs(workdir, exist_ok=True)
@@ -264,7 +284,12 @@ def main() -> int:
         key = key.strip()
         if key not in RUNGS:
             raise SystemExit(f"unknown rung {key!r}; pick from {sorted(RUNGS)}")
-        record, stats = RUNGS[key](args.rounds, workdir)
+        if key.endswith("_full"):
+            record, stats = RUNGS[key](args.rounds, workdir,
+                                       learners=args.learners_full,
+                                       optimizer=args.optimizer_full)
+        else:
+            record, stats = RUNGS[key](args.rounds, workdir)
         with open(os.path.join(workdir, f"experiment_{key}.json"), "w") as f:
             json.dump(stats, f, indent=2, default=str)
         summary.append(record)
