@@ -35,8 +35,10 @@ def _named_bytes(named):
     return sum(np.asarray(a).nbytes for _, a in named)
 
 
-def _build(rule="fedavg", rounds=3, ship=HEAD, **train_kw):
+def _build(rule="fedavg", rounds=3, ship=HEAD, protocol="synchronous",
+           **train_kw):
     config = FederationConfig(
+        protocol=protocol,
         aggregation=AggregationConfig(
             rule=rule,
             scaler="train_dataset_size" if rule == "fednova"
@@ -133,6 +135,17 @@ def test_fednova_composes_with_ship_regex():
     fed, _ = _build(rule="fednova")
     _, acc = _run(fed)
     assert acc > 0.8, f"fednova x ship-only federation failed to learn: {acc}"
+
+
+def test_async_protocol_composes_with_ship_regex():
+    """Asynchronous rounds advance the subset community model per
+    completion; the subset contract holds without a sync barrier."""
+    fed, _ = _build(protocol="asynchronous", rounds=4)
+    controller = fed.controller
+    _, acc = _run(fed, rounds=4)
+    assert acc > 0.8, f"async x ship-only federation failed to learn: {acc}"
+    blob = ModelBlob.from_bytes(controller.community_model_bytes())
+    assert blob.tensors and all("Dense_1" in n for n, _ in blob.tensors)
 
 
 def test_never_trained_learner_evaluates_subset_blob():
