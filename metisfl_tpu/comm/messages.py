@@ -159,11 +159,15 @@ class TrainParams(Message):
     # turns an 8B-param federation into an adapter-sized one, MBs instead
     # of GBs both directions). Non-matching tensors are effectively
     # frozen by the transport regardless of the optimizer mask.
-    # Incompatible with secure aggregation, local_tensor_regex, scaffold,
-    # and client-level DP — config-checked. The reference hit the
-    # full-model-blob wall and worked around it with a stub-per-request
-    # hack (reference metisfl/controller/core/controller.cc:594-604);
-    # shipping only the trainable subset removes the wall instead.
+    # Composes with secure aggregation (the subset is identical across
+    # parties, so the uniform-shape masking/HE payload contract holds —
+    # and encrypting adapters instead of the full model is what makes
+    # secure LoRA federations practical); incompatible with
+    # local_tensor_regex, scaffold, and client-level DP — config-checked.
+    # The reference hit the full-model-blob wall and worked around it
+    # with a stub-per-request hack (reference
+    # metisfl/controller/core/controller.cc:594-604); shipping only the
+    # trainable subset removes the wall instead.
     ship_tensor_regex: str = ""
     # Client-level differential privacy on the shipped update
     # (secure/dp.py): the delta vs the received community model is

@@ -266,10 +266,11 @@ def test_config_matrix():
         cfg(train_kw={"ship_tensor_regex": "["})
     with pytest.raises(ValueError, match="cannot combine"):
         cfg(train_kw={"local_tensor_regex": "bias"})
-    with pytest.raises(ValueError, match="secure"):
-        cfg(aggregation=AggregationConfig(rule="secure_agg",
-                                          scaler="participants"),
-            secure=SecureAggConfig(enabled=True))
+    # secure aggregation COMPOSES: the shipped subset is identical
+    # across parties, so the uniform-shape payload contract holds
+    cfg(aggregation=AggregationConfig(rule="secure_agg",
+                                      scaler="participants"),
+        secure=SecureAggConfig(enabled=True))
     with pytest.raises(ValueError, match="scaffold"):
         cfg(aggregation=AggregationConfig(rule="scaffold"))
     with pytest.raises(ValueError, match="DP"):
@@ -302,3 +303,104 @@ def test_seed_rejects_regex_matching_nothing():
     with pytest.raises(ValueError, match="matches no tensor"):
         fed.seed_model(engine.get_variables())
     fed.shutdown()
+
+
+def _secure_ship_federation(scheme, backends, controller_backend, rounds=4):
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="secure_agg",
+                                      scaler="participants"),
+        secure=SecureAggConfig(enabled=True, scheme=scheme,
+                               num_parties=len(backends)),
+        train=TrainParams(batch_size=16, local_steps=6, learning_rate=0.2,
+                          ship_tensor_regex=HEAD),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=rounds),
+    )
+    fed = InProcessFederation(config, secure_backend=controller_backend)
+    shards, test = _shards(len(backends))
+    template = None
+    for shard, backend in zip(shards, backends):
+        engine = FlaxModelOps(MLP(features=(16,), num_outputs=3),
+                              shard.x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        fed.add_learner(engine, shard, test_dataset=test,
+                        secure_backend=backend)
+    fed.seed_model(template)
+    return fed, template
+
+
+def test_masking_secure_composes_with_ship_regex():
+    """Secure adapter-only federation: the masked payloads cover ONLY the
+    shipped subset (identical across parties — the uniform-shape contract
+    holds), the controller's community model is an opaque subset, and the
+    learners' decrypted+backfilled model actually improves."""
+    from metisfl_tpu.secure import MaskingBackend
+
+    n = 3
+    backends = [MaskingBackend(federation_secret="fed", party_index=i,
+                               num_parties=n) for i in range(n)]
+    fed, template = _secure_ship_federation(
+        "masking", backends, MaskingBackend(num_parties=n))
+    controller = fed.controller
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(4, timeout_s=180)
+        stats = fed.statistics()
+        blob = ModelBlob.from_bytes(controller.community_model_bytes())
+        assert blob.opaque and not blob.tensors
+        assert all("Dense_1" in name for name in blob.opaque), \
+            list(blob.opaque)
+        # the wire carried subset-sized masked payloads, not model-sized
+        full = _named_bytes(pytree_to_named_tensors(template))
+        head = _named_bytes([(n_, a) for n_, a in
+                             pytree_to_named_tensors(template)
+                             if "Dense_1" in n_])
+        for meta in stats["round_metadata"]:
+            for nbytes in meta["uplink_bytes"].values():
+                assert nbytes < full, (nbytes, full)
+                assert nbytes < head * 4  # masked f64 + framing overhead
+        # decrypted community merges into a full working model learner-side
+        learner = fed.learners[0]
+        merged = learner._load_model(controller.community_model_bytes())
+        acc = learner.model_ops.evaluate(
+            fed.learners[0].datasets["test"], 64, ["accuracy"],
+            variables=merged)
+        # the read races the next round's completion, so the exact round
+        # evaluated varies; the mechanism assertions above are the test
+        assert acc["accuracy"] > 0.7, acc
+    finally:
+        fed.shutdown()
+
+
+def test_ckks_secure_composes_with_ship_regex():
+    """Same contract over the native RLWE CKKS scheme: homomorphic
+    aggregation of adapter-only ciphertexts."""
+    from metisfl_tpu.secure.ckks import CKKSBackend, generate_keys
+
+    import tempfile
+
+    try:
+        keys = generate_keys(tempfile.mkdtemp(prefix="ckks_ship_"))
+        backends = [CKKSBackend(key_dir=keys, role="learner")
+                    for _ in range(2)]
+    except Exception as exc:  # pragma: no cover - no native toolchain
+        pytest.skip(f"native CKKS unavailable: {exc}")
+    fed, _ = _secure_ship_federation(
+        "ckks", backends, CKKSBackend(role="controller"))
+    controller = fed.controller
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(4, timeout_s=240)
+        blob = ModelBlob.from_bytes(controller.community_model_bytes())
+        assert blob.opaque and not blob.tensors
+        assert all("Dense_1" in name for name in blob.opaque)
+        learner = fed.learners[0]
+        merged = learner._load_model(controller.community_model_bytes())
+        acc = learner.model_ops.evaluate(
+            learner.datasets["test"], 64, ["accuracy"], variables=merged)
+        assert acc["accuracy"] > 0.7, acc  # see masking test note
+    finally:
+        fed.shutdown()
