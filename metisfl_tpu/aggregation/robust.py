@@ -194,13 +194,19 @@ class Krum(_RobustBase):
         f = self.byzantine_f if self.byzantine_f > 0 else max(0, (n - 3) // 2)
         return min(f, max(0, n - 3))  # scores need n - f - 2 >= 1
 
-    def _select(self, cohort, scores: np.ndarray):
-        n = len(cohort)
-        f = self._effective_f(n)
+    def _select_count(self, n: int) -> int:
+        """How many best-scored models this rule adopts — the ONE
+        definition the pod-mode device combine shares
+        (parallel/collectives.make_robust_pod_combine)."""
         if self.name == "multikrum" or self.multi > 0:
-            m = self.multi if self.multi > 0 else max(1, n - f)
-            return [cohort[int(i)] for i in np.argsort(scores)[:min(m, n)]]
-        return [cohort[int(np.argmin(scores))]]
+            m = self.multi if self.multi > 0 else max(
+                1, n - self._effective_f(n))
+            return min(m, n)
+        return 1
+
+    def _select(self, cohort, scores: np.ndarray):
+        m = self._select_count(len(cohort))
+        return [cohort[int(i)] for i in np.argsort(scores)[:m]]
 
     def aggregate(self, models, state=None, learner_ids=None) -> Pytree:
         cohort = [lineage[0] for lineage, _scale in models]

@@ -86,34 +86,61 @@ def _acc(dtype):
 
 
 def make_robust_pod_combine(mesh: Mesh, rule: str, trim: int = 0,
+                            byzantine_f: int = 0, multi: int = 0,
                             axis_name: str = "fed") -> Callable:
     """Device-resident byzantine-robust combine for the ICI fast path.
 
     ``stacked`` trees carry a leading learner axis sharded over ``fed``
     (each learner's trained model on its own slice); the combine is a
-    coordinate-wise median or trimmed mean over that axis — XLA inserts
-    the all-gather over ICI, sorts on device, and the community model
-    comes out replicated. Host-path parity: same f32 accumulation and the
-    same trim count as :class:`aggregation.robust.TrimmedMean` (pass its
-    ``_trim(L)``); scales are ignored by construction — robustness comes
-    precisely from not letting any learner claim more weight
-    (aggregation/robust.py module contract). Memory note: the gather
-    materializes L models per device, the price of a sort none of the
-    psum algebra can pay."""
-    if rule not in ("median", "trimmed_mean"):
+    coordinate-wise median / trimmed mean over that axis, or (Multi-)Krum
+    distance selection — XLA inserts the all-gather over ICI, sorts (or
+    runs Krum's single Gram matmul on the MXU) on device, and the
+    community model comes out replicated. Host-path parity: the same leaf
+    math and scoring as aggregation/robust.py (one definition each);
+    scales are ignored by construction — robustness comes precisely from
+    not letting any learner claim more weight (robust.py module
+    contract). Memory note: the gather materializes L models per device,
+    the price of a sort/selection none of the psum algebra can pay."""
+    if rule not in ("median", "trimmed_mean", "krum", "multikrum"):
         raise ValueError(f"unknown robust pod rule {rule!r}")
-    # the ONE leaf definition shared with the host rules — parity by
-    # construction, not by synchronized copies
-    from metisfl_tpu.aggregation.robust import median_leaf, trimmed_mean_leaf
+    # the ONE leaf/scoring definition shared with the host rules — parity
+    # by construction, not by synchronized copies
+    from metisfl_tpu.aggregation.robust import (
+        Krum,
+        _krum_scores,
+        median_leaf,
+        trimmed_mean_leaf,
+    )
 
-    def combine(stacked):
-        def leaf(s):
-            acc = s.astype(_acc(s.dtype))
-            r = (median_leaf(acc) if rule == "median"
-                 else trimmed_mean_leaf(acc, trim))
-            return r.astype(s.dtype)
+    if rule in ("krum", "multikrum"):
+        L = mesh.shape[axis_name]
+        host_rule = Krum(byzantine_f=byzantine_f, multi=multi, name=rule)
+        f = host_rule._effective_f(L)
+        m = host_rule._select_count(L)
 
-        return jax.tree.map(leaf, stacked)
+        def combine(stacked):
+            flat = jnp.concatenate(
+                [s.astype(jnp.float32).reshape(s.shape[0], -1)
+                 for s in jax.tree.leaves(stacked)], axis=1)
+            scores = _krum_scores(flat, f)
+            picked = jnp.argsort(scores)[:m]
+
+            def leaf(s):
+                # take the m picked rows FIRST, then cast — touching m
+                # models instead of an f32 copy of all L gathered ones
+                sel = jnp.take(s, picked, axis=0).astype(_acc(s.dtype))
+                return sel.mean(axis=0).astype(s.dtype)
+
+            return jax.tree.map(leaf, stacked)
+    else:
+        def combine(stacked):
+            def leaf(s):
+                acc = s.astype(_acc(s.dtype))
+                r = (median_leaf(acc) if rule == "median"
+                     else trimmed_mean_leaf(acc, trim))
+                return r.astype(s.dtype)
+
+            return jax.tree.map(leaf, stacked)
 
     return jax.jit(combine, out_shardings=NamedSharding(mesh, P()))
 

@@ -60,14 +60,24 @@ class PodFederation:
         rng_seed: int = 0,
         rule: str = "fedavg",
         trim_ratio: float = 0.1,
+        byzantine_f: int = 0,
+        multi: int = 0,
     ):
-        # rule="median"/"trimmed_mean": byzantine-robust aggregation WITHOUT
-        # leaving the device mesh — the round's psum is replaced by an
-        # all-gather + coordinate sort over `fed` (collectives.
+        # rule="median"/"trimmed_mean"/"krum"/"multikrum": byzantine-robust
+        # aggregation WITHOUT leaving the device mesh — the round's psum is
+        # replaced by an all-gather + coordinate sort (or Krum's Gram-
+        # matmul distance selection) over `fed` (collectives.
         # make_robust_pod_combine); scales are ignored by construction,
         # matching the host rules (aggregation/robust.py)
-        if rule not in ("fedavg", "median", "trimmed_mean"):
+        if rule not in ("fedavg", "median", "trimmed_mean", "krum",
+                        "multikrum"):
             raise ValueError(f"unknown pod aggregation rule {rule!r}")
+        if rule not in ("krum", "multikrum") and (byzantine_f or multi):
+            # silently-ignored tolerance knobs read as guarantees that are
+            # not in effect
+            raise ValueError(
+                f"byzantine_f/multi only apply to the krum rules, not "
+                f"rule={rule!r}")
         self.rule = rule
         self.module = module
         self.num_learners = num_learners
@@ -83,7 +93,8 @@ class PodFederation:
             trim = (TrimmedMean(trim_ratio)._trim(num_learners)
                     if rule == "trimmed_mean" else 0)
             self._robust_combine = make_robust_pod_combine(
-                self.mesh, rule, trim)
+                self.mesh, rule, trim=trim, byzantine_f=byzantine_f,
+                multi=multi)
         else:
             self._robust_combine = None
         if self.mesh.shape["fed"] != num_learners:
@@ -258,10 +269,14 @@ class PodFederation:
         self.params, new_bs, losses = self._round_fn(
             self.params, bs, x_sharded, y_sharded, s_sharded, seeds_sharded)
         if self._robust_combine is not None:
-            # second device-resident program: all-gather over fed + sort;
-            # the community model comes back replicated for the next round
-            self.params = self._robust_combine(self.params)
-            new_bs = self._robust_combine(new_bs)
+            # second device-resident program: all-gather over fed + sort
+            # (or Krum selection); the community model comes back
+            # replicated for the next round. ONE call over params AND
+            # batch_stats so Krum's per-learner selection stays coherent
+            # across the whole model (its scores also span both, matching
+            # the host rule's whole-tree flatten)
+            packed = self._robust_combine({"p": self.params, "b": new_bs})
+            self.params, new_bs = packed["p"], packed["b"]
         if self.batch_stats is not None:
             self.batch_stats = new_bs
         losses = np.asarray(losses)

@@ -664,11 +664,68 @@ def test_podfederation_trimmed_mean_matches_host():
         device_tm, host_tm)
 
 
+def test_podfederation_krum_selects_clean_model():
+    """Pod-mode Krum: the Gram-matmul distance selection runs on device
+    and adopts a model far from the poisoned learner's — and matches the
+    host Krum on the same stacked models (whole-tree scoring)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from metisfl_tpu.aggregation.robust import Krum
+
+    L, K, B = 8, 3, 8
+    x, y = _pod_data(L, K, B, seed=5)
+    x_poison = x.copy()
+    x_poison[0] = 1e4
+    kwargs = dict(
+        sample_input=np.zeros((2, 12), np.float32),
+        num_learners=L,
+        train_params=TrainParams(optimizer="sgd", learning_rate=0.1,
+                                 batch_size=B, local_steps=K),
+    )
+    clean = PodFederation(MLP(features=(16,), num_outputs=4), **kwargs)
+    clean.run_round(x, y)
+    krum = PodFederation(MLP(features=(16,), num_outputs=4), rule="krum",
+                         **kwargs)
+    krum.run_round(x_poison, y)
+
+    def dist(a, b):
+        return float(sum(
+            np.sum((np.asarray(p) - np.asarray(q)) ** 2)
+            for p, q in zip(jax.tree.leaves(a), jax.tree.leaves(b))) ** 0.5)
+
+    avg = PodFederation(MLP(features=(16,), num_outputs=4), **kwargs)
+    avg.run_round(x_poison, y)
+    d_krum = dist(krum.community_params(), clean.community_params())
+    d_avg = dist(avg.community_params(), clean.community_params())
+    assert d_krum < d_avg / 5, (d_krum, d_avg)
+
+    # device selection == host Krum on identical stacked models
+    pod = PodFederation(MLP(features=(16,), num_outputs=4), rule="krum",
+                        **kwargs)
+    seeds = np.arange(L, dtype=np.uint32) + np.uint32(1)
+    put = lambda v, spec: jax.device_put(  # noqa: E731
+        jnp.asarray(v), NamedSharding(pod.mesh, spec))
+    stacked, _, _ = pod._round_fn(
+        pod.params, {}, put(x_poison, pod._data_spec),
+        put(y, pod._data_spec),
+        put(np.full((L,), 1.0 / L, np.float32), P("fed")),
+        put(seeds, P("fed")))
+    device_k = jax.tree.map(
+        np.asarray, pod._robust_combine({"p": stacked, "b": {}}))["p"]
+    host_models = [jax.tree.map(lambda s, i=i: np.asarray(s)[i], stacked)
+                   for i in range(L)]
+    host_k = Krum().aggregate([([m], 1.0 / L) for m in host_models])
+    jax.tree.map(
+        lambda d, h: np.testing.assert_allclose(
+            np.asarray(d), np.asarray(h), atol=1e-5),
+        device_k, host_k)
+
+
 def test_podfederation_rejects_unknown_rule():
     with pytest.raises(ValueError, match="unknown pod aggregation rule"):
         PodFederation(
             MLP(features=(8,), num_outputs=4),
             sample_input=np.zeros((2, 12), np.float32),
             num_learners=4,
-            rule="krum",  # distance selection needs a different program
+            rule="geometric_median",
         )
