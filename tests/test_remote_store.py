@@ -145,3 +145,20 @@ def test_federation_runs_on_remote_store(tmp_path):
             fed.shutdown()
     finally:
         server.stop()
+
+
+def test_large_blob_streams_through_store_service(served, monkeypatch):
+    """The network store rides the chunked transport transparently: a
+    blob past the stream threshold (tuned down — the >2 GiB path is
+    proven at production constants in test_rpc.py) round-trips through
+    insert/select with exact bytes."""
+    from metisfl_tpu.comm import rpc
+
+    monkeypatch.setattr(rpc, "STREAM_THRESHOLD", 64 * 1024)
+    monkeypatch.setattr(rpc, "CHUNK_BYTES", 128 * 1024)
+    _, client, _ = served
+    big = {"emb/table": np.random.default_rng(0).standard_normal(
+        (512, 1024)).astype(np.float32)}  # ~2 MB >> threshold
+    client.insert("whale", big)
+    got = client.select(["whale"], k=1)["whale"][0]
+    np.testing.assert_array_equal(got["emb/table"], big["emb/table"])
