@@ -156,9 +156,20 @@ def test_large_blob_streams_through_store_service(served, monkeypatch):
 
     monkeypatch.setattr(rpc, "STREAM_THRESHOLD", 64 * 1024)
     monkeypatch.setattr(rpc, "CHUNK_BYTES", 128 * 1024)
+    # spy: the test must FAIL (not pass vacuously) if a refactor stops
+    # the store client from routing oversize payloads through the stream
+    streamed = []
+    orig = rpc.RpcClient._call_chunked
+
+    def spy(self, *args, **kwargs):
+        streamed.append(args[0])
+        return orig(self, *args, **kwargs)
+
+    monkeypatch.setattr(rpc.RpcClient, "_call_chunked", spy)
     _, client, _ = served
     big = {"emb/table": np.random.default_rng(0).standard_normal(
         (512, 1024)).astype(np.float32)}  # ~2 MB >> threshold
     client.insert("whale", big)
     got = client.select(["whale"], k=1)["whale"][0]
     np.testing.assert_array_equal(got["emb/table"], big["emb/table"])
+    assert "Insert" in streamed, streamed
