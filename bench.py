@@ -728,12 +728,20 @@ def bench_e2e_round(rounds: int = 4, learners: int = 3):
     from metisfl_tpu.models.zoo import FashionMnistCNN
 
     rng = np.random.default_rng(11)
-    batch = 128
+    if jax.default_backend() == "cpu":
+        # a degraded run still exercises the product loop, but the CPU
+        # pass must not eat most of the section budget (138 s/round at
+        # full shapes on the 1-core host)
+        batch, steps, rounds = 32, 4, min(rounds, 2)
+    else:
+        batch, steps = 128, 8
     config = FederationConfig(
         aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
         # scan_chunk amortizes host->device dispatch (dominant behind a
-        # network tunnel); 2 chunks/task: first compiles, second times
-        train=TrainParams(batch_size=batch, local_steps=8, scan_chunk=4,
+        # network tunnel). On chip: 2 chunks/task (first compiles, second
+        # times). The CPU fallback runs a single chunk/task — its wall
+        # numbers are sanity only, and the recorded shapes say so.
+        train=TrainParams(batch_size=batch, local_steps=steps, scan_chunk=4,
                           optimizer="sgd", learning_rate=0.05),
         eval=EvalConfig(every_n_rounds=0),
         termination=TerminationConfig(federation_rounds=rounds),
@@ -772,6 +780,10 @@ def bench_e2e_round(rounds: int = 4, learners: int = 3):
     aggs = [m.get("aggregation_duration_ms", 0.0) for m in steady]
     out = {
         "e2e_learners": learners,
+        # effective workload shapes: the CPU fallback runs smaller ones,
+        # so captures are only comparable at equal shapes
+        "e2e_batch_size": batch,
+        "e2e_local_steps": steps,
         "e2e_rounds_completed": int(len(metas)),
         "e2e_rounds_ok": bool(ok),
         "e2e_round_wall_clock_s": round(float(np.median(walls)), 3),
