@@ -1,11 +1,15 @@
 """Experiment summary CLI: ``python -m metisfl_tpu.stats experiment.json``.
 
 The reference ships convergence-plot helpers with its examples
-(reference examples/analysis, driver_session.py:408-418 dumps the raw
-lineage); this is the rebuild's text equivalent — a round-by-round table
-(wall-clock, cohort, aggregation time, model size) and per-metric
-convergence summaries from the ``experiment.json`` a driver writes, with no
-plotting dependencies. Usable as a library via :func:`summarize`.
+(reference examples/utils/convergence_plots.py — hardcoded paper
+figures; driver_session.py:408-418 dumps the raw lineage); this is the
+rebuild's generic equivalent — a round-by-round table (wall-clock,
+cohort, aggregation time, model size) and per-metric convergence
+summaries from the ``experiment.json`` a driver writes, plus an optional
+``--plot out.png`` convergence figure (metric curves over evaluated
+rounds + per-round wall-clock/aggregation bars) when matplotlib is
+available. Usable as a library via :func:`summarize` /
+:func:`metric_series` / :func:`plot_convergence`.
 """
 
 from __future__ import annotations
@@ -63,24 +67,8 @@ def summarize(stats: Dict[str, Any]) -> str:
             lines.append(f"round errors ({len(errors)}):")
             lines.extend(f"  - {e}" for e in errors[:10])
 
-    evals = [e for e in stats.get("community_evaluations", [])
-             if e.get("evaluations")]
-    if evals:
-        # metric → per-round mean across learners and datasets
-        series: Dict[str, List[float]] = {}
-        for entry in evals:
-            per_metric: Dict[str, List[float]] = {}
-            for learner_metrics in entry["evaluations"].values():
-                for dataset, metrics in learner_metrics.items():
-                    for name, value in metrics.items():
-                        try:
-                            per_metric.setdefault(
-                                f"{dataset}/{name}", []).append(float(value))
-                        except (TypeError, ValueError):
-                            continue
-            for key, values in per_metric.items():
-                series.setdefault(key, []).append(
-                    sum(values) / len(values))
+    series = metric_series(stats)
+    if series:
         lines.append("")
         lines.append("community-model evaluations (mean across learners):")
         for key in sorted(series):
@@ -96,10 +84,104 @@ def summarize(stats: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def metric_series(stats: Dict[str, Any]) -> Dict[str, List[float]]:
+    """``{"dataset/metric": [per-evaluated-round mean across learners]}``
+    from a statistics payload — the series both the text summary and the
+    plot draw."""
+    series: Dict[str, List[float]] = {}
+    for entry in stats.get("community_evaluations", []):
+        if not entry.get("evaluations"):
+            continue
+        per_metric: Dict[str, List[float]] = {}
+        for learner_metrics in entry["evaluations"].values():
+            for dataset, metrics in learner_metrics.items():
+                for name, value in metrics.items():
+                    try:
+                        per_metric.setdefault(
+                            f"{dataset}/{name}", []).append(float(value))
+                    except (TypeError, ValueError):
+                        continue
+        for key, values in per_metric.items():
+            series.setdefault(key, []).append(sum(values) / len(values))
+    return series
+
+
+def plot_convergence(stats: Dict[str, Any], path: str) -> str:
+    """Write a convergence figure (the reference convergence_plots.py
+    role, generalized): one panel of community-metric curves over
+    evaluated rounds, one of per-round wall-clock with the aggregation
+    share. Requires matplotlib; raises ImportError where unavailable."""
+    import matplotlib
+
+    # force=False: a library caller's interactive backend (Jupyter, Qt)
+    # must not be clobbered; headless processes resolve to Agg anyway
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    # align every metric to the evaluated-round ordinal it was OBSERVED
+    # at (a metric first reported in a later round must not shift left)
+    aligned: Dict[str, List[tuple]] = {}
+    eval_idx = 0
+    for entry in stats.get("community_evaluations", []):
+        if not entry.get("evaluations"):
+            continue
+        eval_idx += 1
+        per_metric: Dict[str, List[float]] = {}
+        for learner_metrics in entry["evaluations"].values():
+            for dataset, metrics in learner_metrics.items():
+                for name, value in metrics.items():
+                    try:
+                        per_metric.setdefault(
+                            f"{dataset}/{name}", []).append(float(value))
+                    except (TypeError, ValueError):
+                        continue
+        for key, values in per_metric.items():
+            aligned.setdefault(key, []).append(
+                (eval_idx, sum(values) / len(values)))
+    rounds = stats.get("round_metadata", [])
+    fig, axes = plt.subplots(1, 2 if rounds else 1,
+                             figsize=(12 if rounds else 7, 4.5))
+    ax0 = axes[0] if rounds else axes
+    if aligned:
+        for key in sorted(aligned):
+            xs, ys = zip(*aligned[key])
+            ax0.plot(xs, ys, marker="o", label=key)
+        ax0.legend(fontsize=8)
+    ax0.set_xlabel("evaluated round")
+    ax0.set_ylabel("mean across learners")
+    ax0.set_title("community-model convergence")
+    ax0.grid(alpha=0.3)
+    if rounds:
+        idx = [m.get("global_iteration", i) for i, m in enumerate(rounds)]
+        walls = [max(0.0, m.get("completed_at", 0) - m.get("started_at", 0))
+                 for m in rounds]
+        aggs = [m.get("aggregation_duration_ms", 0.0) / 1e3 for m in rounds]
+        axes[1].bar(idx, walls, label="round wall-clock (s)", alpha=0.7)
+        axes[1].bar(idx, aggs, label="aggregation (s)", alpha=0.9)
+        axes[1].set_xlabel("round")
+        axes[1].set_ylabel("seconds")
+        axes[1].set_title("round timing")
+        axes[1].legend(fontsize=8)
+        axes[1].grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def main(argv: List[str]) -> int:
+    plot_path = None
+    if "--plot" in argv:
+        i = argv.index("--plot")
+        try:
+            plot_path = argv[i + 1]
+        except IndexError:
+            print("--plot requires an output path", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
     if len(argv) != 1 or argv[0] in ("-h", "--help"):
-        print("usage: python -m metisfl_tpu.stats <experiment.json>",
-              file=sys.stderr)
+        print("usage: python -m metisfl_tpu.stats <experiment.json> "
+              "[--plot out.png]", file=sys.stderr)
         return 2
     try:
         with open(argv[0]) as fh:
@@ -108,6 +190,13 @@ def main(argv: List[str]) -> int:
         print(f"cannot read {argv[0]}: {exc}", file=sys.stderr)
         return 1
     print(summarize(stats))
+    if plot_path:
+        try:
+            print(f"plot written: {plot_convergence(stats, plot_path)}")
+        except ImportError:
+            print("matplotlib unavailable; no plot written",
+                  file=sys.stderr)
+            return 1
     return 0
 
 
