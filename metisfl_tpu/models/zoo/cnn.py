@@ -45,19 +45,25 @@ class Cifar10CNN(nn.Module):
 
 
 class BrainAge3DCNN(nn.Module):
-    """Volumetric 3D-CNN regressor — the reference's neuroimaging workload
-    family (reference examples/keras/models/brainage_cnns.py: stacked
-    Conv3D/MaxPool3D blocks regressing age from MRI volumes), scaled by
-    ``widths`` (the reference ships 5-block variants; the default here is a
-    CI-sized 3-block model — same topology, smaller volumes).
+    """Volumetric 3D-CNN — the reference's neuroimaging workload family
+    (reference examples/keras/models/brainage_cnns.py: stacked
+    Conv3D/MaxPool3D blocks regressing age from MRI volumes; its sibling
+    examples/keras/models/alzheimers_disease_cnns.py is the same topology
+    with a classification head), scaled by ``widths`` (the reference
+    ships 5-block variants; the default here is a CI-sized 3-block model
+    — same topology, smaller volumes).
 
-    Input: (B, D, H, W) or (B, D, H, W, 1) float volumes. Output: (B,)
-    regression values (train with ``FlaxModelOps(..., loss="mse")``; the
-    squeezed shape matches the (B,)-shaped labels — a (B, 1) output would
-    broadcast against them inside the mse loss).
+    Input: (B, D, H, W) or (B, D, H, W, 1) float volumes. Output with
+    ``num_outputs=0`` (default): (B,) regression values (train with
+    ``FlaxModelOps(..., loss="mse")``; the squeezed shape matches the
+    (B,)-shaped labels — a (B, 1) output would broadcast against them
+    inside the mse loss). With ``num_outputs > 0``: (B, num_outputs)
+    class logits (the Alzheimer's-disease classifier role; default
+    softmax-cross-entropy loss applies).
     """
 
     widths: tuple = (8, 16, 32)
+    num_outputs: int = 0  # 0 = regression head; > 0 = class logits
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -68,4 +74,6 @@ class BrainAge3DCNN(nn.Module):
             x = nn.max_pool(x, (2, 2, 2), strides=(2, 2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(64)(x))
+        if self.num_outputs > 0:
+            return nn.Dense(self.num_outputs)(x)
         return nn.Dense(1)(x)[..., 0]

@@ -187,6 +187,28 @@ def test_brainage_3dcnn_regression_trains():
     assert metrics["mse"] < before * 0.5
 
 
+def test_brainage_3dcnn_classifier_trains():
+    """The same 3D topology with a classification head (the reference's
+    alzheimers_disease_cnns.py role): logits shape + learning under the
+    default softmax-cross-entropy loss."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import BrainAge3DCNN
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((32, 8, 8, 8)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    x[y == 1] += 0.4  # separable signal
+    ds = ArrayDataset(x, y)
+    ops = FlaxModelOps(BrainAge3DCNN(widths=(4, 8), num_outputs=2), x[:2])
+    logits = ops.infer(x[:4], batch_size=4)
+    assert np.asarray(logits).shape == (4, 2)
+    ops.train(ds, TrainParams(batch_size=8, local_steps=30,
+                              optimizer="adam", learning_rate=1e-2))
+    acc = ops.evaluate(ds, batch_size=8, metrics=["accuracy"])["accuracy"]
+    assert acc > 0.8, acc
+
+
 def test_lstm_classifier_trains():
     """IMDB-style LSTM text classifier (reference imdb_lstm.py parity)."""
     from metisfl_tpu.comm.messages import TrainParams
