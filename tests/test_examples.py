@@ -205,3 +205,24 @@ def test_yaml_template_loads_to_defaults(tmp_path):
 
     with pytest.raises(ValueError, match="scaffold requires optimizer"):
         load_config(str(bad))
+
+
+def test_robust_federation_example(tmp_path):
+    """The byzantine demo: a poisoned learner collapses fedavg but not
+    median — asserted on the script's own printed accuracies."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "robust_federation.py"),
+         "--learners", "4", "--rounds", "2", "--rules", "fedavg,median"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rows = re.findall(
+        r"rule=(\w+)\s+rounds_ok=(\w+) community test accuracy: ([\d.]+)",
+        proc.stdout)
+    accs = {rule: acc for rule, _, acc in rows}
+    assert set(accs) == {"fedavg", "median"}, proc.stdout[-500:]
+    # a timed-out run must fail HERE (self-explanatory), not at the
+    # accuracy gap with barely-trained models
+    assert all(ok == "True" for _, ok, _ in rows), rows
+    assert float(accs["median"]) > float(accs["fedavg"]) + 0.15, accs
