@@ -71,7 +71,13 @@ def main() -> int:
         import time
         t0 = time.time()
         fed.start()
-        ok = fed.wait_for_rounds(args.rounds, timeout_s=600)
+        # budget scales with the WORK (a flat cap cut the 1024-learner
+        # sweep mid-flight on the single-core host): ~0.2 s of sequential
+        # per-learner cost per local step at the default shapes
+        ok = fed.wait_for_rounds(
+            args.rounds,
+            timeout_s=max(600, n * args.rounds
+                          * max(1, args.local_steps) // 2))
         wall = time.time() - t0
         stats = fed.statistics()
         fed.shutdown()
