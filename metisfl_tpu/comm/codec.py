@@ -14,9 +14,22 @@ message level; see messages.py for the concrete message schemas.
 from __future__ import annotations
 
 import struct
+import time
 from typing import Any
 
 import numpy as np
+
+from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import trace as _ttrace
+
+# codec hot-path telemetry: histograms always (cheap), spans only for
+# payloads big enough to matter in a round trace — every tiny ack would
+# otherwise flood the JSONL sink
+_M_CODEC = _tmetrics.registry().histogram(
+    "codec_duration_seconds", "Message codec encode/decode time", ("op",))
+_M_CODEC_BYTES = _tmetrics.registry().counter(
+    "codec_bytes_total", "Message codec bytes by operation", ("op",))
+_SPAN_MIN_BYTES = 1 << 18
 
 _T_NONE = 0x00
 _T_FALSE = 0x01
@@ -102,8 +115,18 @@ def _encode(out: bytearray, value: Any) -> None:
 
 def dumps(value: Any) -> bytes:
     out = bytearray()
+    if not _tmetrics.enabled():
+        _encode(out, value)
+        return bytes(out)
+    t0 = time.perf_counter()
     _encode(out, value)
-    return bytes(out)
+    buf = bytes(out)
+    elapsed = time.perf_counter() - t0
+    _M_CODEC.observe(elapsed, op="encode")
+    _M_CODEC_BYTES.inc(len(buf), op="encode")
+    if len(buf) >= _SPAN_MIN_BYTES:
+        _ttrace.event("codec.encode", elapsed, attrs={"bytes": len(buf)})
+    return buf
 
 
 def _read_varint(view: memoryview, offset: int) -> tuple[int, int]:
@@ -187,6 +210,20 @@ def _decode(view: memoryview, offset: int, depth: int = 0) -> tuple[Any, int]:
 
 
 def loads(buf) -> Any:
+    if not _tmetrics.enabled():
+        return _loads(buf)
+    t0 = time.perf_counter()
+    value = _loads(buf)
+    elapsed = time.perf_counter() - t0
+    nbytes = memoryview(buf).nbytes
+    _M_CODEC.observe(elapsed, op="decode")
+    _M_CODEC_BYTES.inc(nbytes, op="decode")
+    if nbytes >= _SPAN_MIN_BYTES:
+        _ttrace.event("codec.decode", elapsed, attrs={"bytes": nbytes})
+    return value
+
+
+def _loads(buf) -> Any:
     view = memoryview(buf)
     value, offset = _decode(view, 0)
     if offset != len(view):

@@ -28,7 +28,47 @@ from typing import Callable, Dict, Optional
 
 import grpc
 
+from metisfl_tpu.telemetry import metrics as _metrics
+from metisfl_tpu.telemetry import trace as _trace
+
 logger = logging.getLogger("metisfl_tpu.rpc")
+
+# Per-method RPC metrics (telemetry registry; families are idempotent so
+# module reload is safe). Client counters are LOGICAL: one sample per
+# call() regardless of transparent retries — the retried label says
+# whether any fail-then-retry (UNAVAILABLE backoff or unary-oversize →
+# chunked) happened inside. Server counters are per handler invocation,
+# so the oversize path visibly costs two invocations for one call.
+_REG = _metrics.registry()
+_M_CLIENT_CALLS = _REG.counter(
+    "rpc_client_calls_total", "Logical client calls (retries collapsed)",
+    ("service", "method", "retried"))
+_M_CLIENT_LATENCY = _REG.histogram(
+    "rpc_client_latency_seconds", "Logical client call latency",
+    ("service", "method"))
+_M_CLIENT_BYTES = _REG.counter(
+    "rpc_client_bytes_total", "Client payload bytes by direction",
+    ("service", "method", "direction"))
+_M_CLIENT_ERRORS = _REG.counter(
+    "rpc_client_errors_total", "Client calls that raised after retries",
+    ("service", "method", "code"))
+_M_SERVER_CALLS = _REG.counter(
+    "rpc_server_calls_total", "Handler invocations",
+    ("service", "method", "transport"))
+_M_SERVER_LATENCY = _REG.histogram(
+    "rpc_server_latency_seconds", "Server handler latency",
+    ("service", "method"))
+_M_SERVER_BYTES = _REG.counter(
+    "rpc_server_bytes_total", "Server payload bytes by direction",
+    ("service", "method", "direction"))
+_M_SERVER_ERRORS = _REG.counter(
+    "rpc_server_errors_total", "Handler invocations that raised",
+    ("service", "method"))
+
+
+def _error_code_name(exc: Exception) -> str:
+    code = exc.code() if hasattr(exc, "code") else None
+    return code.name if isinstance(code, grpc.StatusCode) else "UNKNOWN"
 
 _UNLIMITED = [
     ("grpc.max_send_message_length", -1),
@@ -76,7 +116,7 @@ class BytesService:
         method_handlers = {}
         for name, fn in self.handlers.items():
             method_handlers[name] = grpc.unary_unary_rpc_method_handler(
-                self._wrap(fn),
+                self._wrap(name, fn),
                 request_deserializer=_IDENTITY,
                 response_serializer=_IDENTITY,
             )
@@ -85,7 +125,7 @@ class BytesService:
             # oversize-response retries) here
             method_handlers[name + _CHUNK_SUFFIX] = \
                 grpc.stream_stream_rpc_method_handler(
-                    self._wrap_chunked(fn),
+                    self._wrap_chunked(name, fn),
                     request_deserializer=_IDENTITY,
                     response_serializer=_IDENTITY,
                 )
@@ -101,29 +141,75 @@ class BytesService:
         context.abort(grpc.StatusCode.INTERNAL,
                       f"{type(exc).__name__}: {exc}")
 
-    @staticmethod
-    def _wrap(fn: Callable[[bytes], bytes]):
+    def _wrap(self, method: str, fn: Callable[[bytes], bytes]):
+        service = self.service_name
+
         def handler(request: bytes, context: grpc.ServicerContext) -> bytes:
+            t0 = time.perf_counter()
+            _M_SERVER_CALLS.inc(service=service, method=method,
+                                transport="unary")
+            _M_SERVER_BYTES.inc(len(request), service=service,
+                                method=method, direction="in")
+            sp = _trace.span(
+                f"rpc.server/{method}",
+                parent=_trace.extract(context.invocation_metadata()),
+                attrs={"service": service})
             try:
-                result = fn(request)
-            except Exception as exc:
-                BytesService._abort(context, exc)
-            if len(result) > UNARY_RESPONSE_LIMIT:
-                # cannot frame this as one message — the client retries
-                # over the chunked method on this exact status+detail
-                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                              _OVERSIZE_MARK)
-            return result
+                with sp, sp.activate():
+                    try:
+                        result = fn(request)
+                    except Exception as exc:
+                        _M_SERVER_ERRORS.inc(service=service, method=method)
+                        sp.set_attr("error", f"{type(exc).__name__}: {exc}")
+                        BytesService._abort(context, exc)
+                if len(result) > UNARY_RESPONSE_LIMIT:
+                    # cannot frame this as one message — the client retries
+                    # over the chunked method on this exact status+detail
+                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                  _OVERSIZE_MARK)
+                _M_SERVER_BYTES.inc(len(result), service=service,
+                                    method=method, direction="out")
+                return result
+            finally:
+                _M_SERVER_LATENCY.observe(time.perf_counter() - t0,
+                                          service=service, method=method)
 
         return handler
 
-    @staticmethod
-    def _wrap_chunked(fn: Callable[[bytes], bytes]):
+    def _wrap_chunked(self, method: str, fn: Callable[[bytes], bytes]):
+        service = self.service_name
+
         def handler(request_iter, context: grpc.ServicerContext):
+            t0 = time.perf_counter()
+            _M_SERVER_CALLS.inc(service=service, method=method,
+                                transport="chunked")
             try:
-                result = fn(b"".join(request_iter))
-            except Exception as exc:
-                BytesService._abort(context, exc)
+                try:
+                    # draining the request stream can itself fail (client
+                    # cancelled mid-upload): shape it like a handler error
+                    # so metrics and status stay consistent
+                    request = b"".join(request_iter)
+                except Exception as exc:
+                    _M_SERVER_ERRORS.inc(service=service, method=method)
+                    BytesService._abort(context, exc)
+                _M_SERVER_BYTES.inc(len(request), service=service,
+                                    method=method, direction="in")
+                sp = _trace.span(
+                    f"rpc.server/{method}",
+                    parent=_trace.extract(context.invocation_metadata()),
+                    attrs={"service": service, "transport": "chunked"})
+                with sp, sp.activate():
+                    try:
+                        result = fn(request)
+                    except Exception as exc:
+                        _M_SERVER_ERRORS.inc(service=service, method=method)
+                        sp.set_attr("error", f"{type(exc).__name__}: {exc}")
+                        BytesService._abort(context, exc)
+                _M_SERVER_BYTES.inc(len(result), service=service,
+                                    method=method, direction="out")
+            finally:
+                _M_SERVER_LATENCY.observe(time.perf_counter() - t0,
+                                          service=service, method=method)
             yield from _iter_chunks(result)
 
         return handler
@@ -199,36 +285,58 @@ class RpcClient:
         chunked = (len(payload) > STREAM_THRESHOLD
                    or method in self._chunked_methods)
         attempt = 0
-        while True:
-            try:
-                if chunked:
-                    return self._call_chunked(method, payload, timeout,
-                                              wait_ready)
-                fn = self._channel.unary_unary(
-                    f"/{self.service_name}/{method}",
-                    request_serializer=_IDENTITY,
-                    response_deserializer=_IDENTITY,
-                )
-                return fn(payload, timeout=timeout, wait_for_ready=wait_ready)
-            except grpc.RpcError as exc:
-                code = exc.code() if hasattr(exc, "code") else None
-                if (not chunked
-                        and code == grpc.StatusCode.RESOURCE_EXHAUSTED
-                        and _OVERSIZE_MARK in (exc.details() or "")):
-                    # the handler's response exceeds unary framing (e.g. a
-                    # >2 GiB community model behind a tiny request):
-                    # transparently re-issue over the chunked stream, and
-                    # remember — the fail-then-retry runs the handler twice
-                    chunked = True
-                    self._chunked_methods.add(method)
-                    continue
-                if code == grpc.StatusCode.UNAVAILABLE and attempt < self.retries:
-                    attempt += 1
-                    logger.warning("%s/%s unavailable (attempt %d/%d)",
-                                   self.target, method, attempt, self.retries)
-                    time.sleep(self.retry_sleep_s)
-                    continue
-                raise
+        retried = 0
+        t0 = time.perf_counter()
+        try:
+            while True:
+                try:
+                    if chunked:
+                        result = self._call_chunked(method, payload, timeout,
+                                                    wait_ready)
+                    else:
+                        fn = self._channel.unary_unary(
+                            f"/{self.service_name}/{method}",
+                            request_serializer=_IDENTITY,
+                            response_deserializer=_IDENTITY,
+                        )
+                        result = fn(payload, timeout=timeout,
+                                    wait_for_ready=wait_ready,
+                                    metadata=_trace.outbound_metadata())
+                    _M_CLIENT_BYTES.inc(len(payload),
+                                        service=self.service_name,
+                                        method=method, direction="sent")
+                    _M_CLIENT_BYTES.inc(len(result),
+                                        service=self.service_name,
+                                        method=method, direction="received")
+                    return result
+                except grpc.RpcError as exc:
+                    code = exc.code() if hasattr(exc, "code") else None
+                    if (not chunked
+                            and code == grpc.StatusCode.RESOURCE_EXHAUSTED
+                            and _OVERSIZE_MARK in (exc.details() or "")):
+                        # the handler's response exceeds unary framing (e.g. a
+                        # >2 GiB community model behind a tiny request):
+                        # transparently re-issue over the chunked stream, and
+                        # remember — the fail-then-retry runs the handler twice
+                        chunked = True
+                        retried = 1
+                        self._chunked_methods.add(method)
+                        continue
+                    if code == grpc.StatusCode.UNAVAILABLE and attempt < self.retries:
+                        attempt += 1
+                        retried = 1
+                        logger.warning("%s/%s unavailable (attempt %d/%d)",
+                                       self.target, method, attempt, self.retries)
+                        time.sleep(self.retry_sleep_s)
+                        continue
+                    _M_CLIENT_ERRORS.inc(service=self.service_name,
+                                         method=method,
+                                         code=_error_code_name(exc))
+                    raise
+        finally:
+            # ONE logical-call sample however many transparent retries ran
+            # inside (the regression contract tests/test_rpc.py pins)
+            self._record_client_call(method, str(retried), t0)
 
     def _call_chunked(self, method: str, payload: bytes,
                       timeout: Optional[float], wait_ready: bool) -> bytes:
@@ -238,7 +346,8 @@ class RpcClient:
             response_deserializer=_IDENTITY,
         )
         return b"".join(fn(_iter_chunks(payload), timeout=timeout,
-                           wait_for_ready=wait_ready))
+                           wait_for_ready=wait_ready,
+                           metadata=_trace.outbound_metadata()))
 
     def call_async(self, method: str, payload: bytes,
                    callback: Optional[Callable[[bytes], None]] = None,
@@ -251,16 +360,24 @@ class RpcClient:
         Payloads above STREAM_THRESHOLD (and oversize unary responses)
         route through the chunked stream on a worker thread — stream
         draining has no grpc-future form."""
+        # capture the span context HERE, on the caller's thread: grpc
+        # completion callbacks and the stream pool run in their own
+        # (empty) contextvars contexts, so an oversize retry issued from
+        # _done would otherwise lose the trace parent
+        ctx = _trace.current_context()
+        t0 = time.perf_counter()
         if (len(payload) > STREAM_THRESHOLD
                 or method in self._chunked_methods):
             return self._async_chunked(method, payload, callback,
-                                       error_callback, timeout, wait_ready)
+                                       error_callback, timeout, wait_ready,
+                                       ctx=ctx, t0=t0)
         fn = self._channel.unary_unary(
             f"/{self.service_name}/{method}",
             request_serializer=_IDENTITY,
             response_deserializer=_IDENTITY,
         )
-        future = fn.future(payload, timeout=timeout, wait_for_ready=wait_ready)
+        future = fn.future(payload, timeout=timeout, wait_for_ready=wait_ready,
+                           metadata=_trace.outbound_metadata())
 
         def _done(f):
             try:
@@ -270,32 +387,82 @@ class RpcClient:
                         and exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
                         and _OVERSIZE_MARK in (exc.details() or "")):
                     self._chunked_methods.add(method)
+                    # still ONE logical call — the chunked leg records it
+                    # (with retried="1"), not this failed unary attempt
                     self._async_chunked(method, payload, callback,
-                                        error_callback, timeout, wait_ready)
-                elif error_callback is not None:
+                                        error_callback, timeout, wait_ready,
+                                        retried="1", ctx=ctx, t0=t0)
+                    return
+                # never invisible: count the failure whether or not the
+                # caller asked to hear about it — and keep the logical-call
+                # denominator honest (errors_total/calls_total <= 1)
+                _M_CLIENT_ERRORS.inc(service=self.service_name,
+                                     method=method,
+                                     code=_error_code_name(exc))
+                self._record_client_call(method, "0", t0)
+                if error_callback is not None:
                     error_callback(exc)
                 else:
-                    logger.warning("async RPC %s failed: %s", method, exc)
+                    logger.warning("async RPC %s failed with no "
+                                   "error_callback: %s", method, exc)
                 return
+            self._record_client_call(method, "0", t0, sent=len(payload),
+                                     received=len(result))
             if callback is not None:
                 callback(result)
 
         future.add_done_callback(_done)
         return future
 
+    def _record_client_call(self, method: str, retried: str, t0: float,
+                            sent: Optional[int] = None,
+                            received: Optional[int] = None) -> None:
+        """One logical-call sample (calls + latency, and bytes on
+        success) — async paths share the sync ``call()`` contract so the
+        client metric families stay mutually consistent."""
+        _M_CLIENT_CALLS.inc(service=self.service_name, method=method,
+                            retried=retried)
+        _M_CLIENT_LATENCY.observe(time.perf_counter() - t0,
+                                  service=self.service_name, method=method)
+        if sent is not None:
+            _M_CLIENT_BYTES.inc(sent, service=self.service_name,
+                                method=method, direction="sent")
+        if received is not None:
+            _M_CLIENT_BYTES.inc(received, service=self.service_name,
+                                method=method, direction="received")
+
     def _async_chunked(self, method, payload, callback, error_callback,
-                       timeout, wait_ready):
+                       timeout, wait_ready, retried: str = "0",
+                       ctx=None, t0: Optional[float] = None):
+        # ``ctx``/``t0`` arrive from call_async's caller thread (a grpc
+        # completion thread has no useful contextvars state); direct
+        # callers fall back to capturing here. ``retried="1"`` marks this
+        # leg as the transparent continuation of a failed unary attempt —
+        # one logical call either way.
+        if ctx is None:
+            ctx = _trace.current_context()
+        if t0 is None:
+            t0 = time.perf_counter()
+
         def _run():
             try:
-                result = self._call_chunked(method, payload, timeout,
-                                            wait_ready)
+                with _trace.use_context(ctx):
+                    result = self._call_chunked(method, payload, timeout,
+                                                wait_ready)
             except Exception as exc:  # noqa: BLE001 - surfaced via callback
+                _M_CLIENT_ERRORS.inc(service=self.service_name,
+                                     method=method,
+                                     code=_error_code_name(exc))
+                self._record_client_call(method, retried, t0)
                 if error_callback is not None:
                     error_callback(exc)
                 else:
-                    logger.warning("async chunked RPC %s failed: %s",
-                                   method, exc)
+                    logger.warning("async chunked RPC %s failed with no "
+                                   "error_callback: %s", method, exc)
                 return
+            self._record_client_call(method, retried, t0,
+                                     sent=len(payload),
+                                     received=len(result))
             if callback is not None:
                 callback(result)
 

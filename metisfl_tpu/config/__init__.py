@@ -6,6 +6,7 @@ from metisfl_tpu.config.federation import (
     LearnerEndpoint,
     ModelStoreConfig,
     SecureAggConfig,
+    TelemetryConfig,
     TerminationConfig,
     load_config,
 )
@@ -16,6 +17,7 @@ __all__ = [
     "CheckpointConfig",
     "ModelStoreConfig",
     "SecureAggConfig",
+    "TelemetryConfig",
     "TerminationConfig",
     "EvalConfig",
     "LearnerEndpoint",

@@ -91,6 +91,23 @@ class SecureAggConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Federation-wide observability (metisfl_tpu/telemetry): trace spans
+    + metrics registry. ``enabled=false`` opts the whole subsystem out
+    (instrument call sites become attribute-check no-ops)."""
+
+    enabled: bool = True
+    # JSONL trace-sink directory. "" → spans are not persisted (ids and
+    # durations still flow into RoundMetadata); the driver fills this in
+    # with <workdir>/telemetry so controller + learner files stitch.
+    dir: str = ""
+    # optional plain-HTTP /metrics listener on the controller (0 = off);
+    # learners take --metrics-port on their CLI instead (N learners on
+    # one host cannot share a configured port)
+    http_port: int = 0
+
+
+@dataclass
 class CheckpointConfig:
     """Controller-side global checkpoint (SURVEY.md §5.4: the reference has
     no resume flow; community model + round counter are rebuilt here)."""
@@ -143,6 +160,7 @@ class FederationConfig:
     secure: SecureAggConfig = field(default_factory=SecureAggConfig)
     termination: TerminationConfig = field(default_factory=TerminationConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     ssl: SSLConfig = field(default_factory=SSLConfig)
     train: TrainParams = field(default_factory=TrainParams)
     eval: EvalConfig = field(default_factory=EvalConfig)

@@ -41,6 +41,14 @@ def main(argv=None) -> int:
         with open(args.config, "rb") as f:
             config = FederationConfig.from_wire(f.read())
 
+    from metisfl_tpu import telemetry
+    telemetry.apply_config(config.telemetry, service="controller")
+    metrics_http = None
+    if config.telemetry.enabled and config.telemetry.http_port > 0:
+        from metisfl_tpu.telemetry.httpd import start_metrics_http
+        metrics_http = start_metrics_http(config.telemetry.http_port,
+                                          host=args.host)
+
     secure_backend = None
     if config.secure.enabled:
         from metisfl_tpu.secure import make_backend
@@ -75,6 +83,9 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: server.stop())
     signal.signal(signal.SIGINT, lambda *_: server.stop())
     server.wait_for_shutdown()
+    if metrics_http is not None:
+        metrics_http.close()
+    telemetry.trace.flush()
     return 0
 
 

@@ -53,10 +53,30 @@ from metisfl_tpu.scaling import apply_staleness_decay, make_scaler
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
 from metisfl_tpu.selection import make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
+from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.tensor.pytree import ModelBlob
 from metisfl_tpu.tensor.spec import quantify
 
 logger = logging.getLogger("metisfl_tpu.controller")
+
+# Round-lifecycle metrics: scraped live via GetMetrics / the /metrics
+# listener while the lineage equivalents (RoundMetadata) stay post-hoc.
+_REG = _tmetrics.registry()
+_M_ROUND_DURATION = _REG.histogram(
+    "round_duration_seconds", "Federation round wall-clock")
+_M_ROUNDS = _REG.counter("rounds_total", "Completed federation rounds")
+_M_PHASE = _REG.histogram(
+    "round_phase_duration_seconds",
+    "Per-phase round durations (dispatch/wait_uplinks/select/aggregate/"
+    "aggregate_block/store_insert)", ("phase",))
+_M_UPLINK = _REG.counter(
+    "uplink_bytes_total", "Model bytes received from learners",
+    ("learner",))
+_M_ACTIVE_LEARNERS = _REG.gauge(
+    "controller_active_learners", "Currently registered learners")
+_M_AGG_FAILURES = _REG.counter(
+    "aggregation_failures_total", "Aggregation attempts that raised")
 
 
 class LearnerProxy(Protocol):
@@ -115,6 +135,12 @@ class RoundMetadata:
     aggregation_block_sizes: List[int] = field(default_factory=list)
     aggregation_block_duration_ms: List[float] = field(default_factory=list)
     aggregation_duration_ms: float = 0.0
+    # phase breakdown sourced from the round's telemetry spans (trace and
+    # lineage agree by construction): total train-dispatch time and the
+    # dispatch-to-barrier-release wait. Absent in pre-telemetry payloads —
+    # stats.py renders those unchanged.
+    dispatch_duration_ms: float = 0.0
+    wait_duration_ms: float = 0.0
     # the contribution weights actually applied this round (post scaler and
     # staleness damping) — reference lineage has nothing comparable
     scales: Dict[str, float] = field(default_factory=dict)
@@ -205,6 +231,11 @@ class Controller:
         self.round_metadata: List[RoundMetadata] = []
         self.community_evaluations: List[Dict[str, Any]] = []
         self._current_meta = RoundMetadata(global_iteration=0)
+        # telemetry: the open round span (root of the round's trace tree;
+        # learner train spans parent under it via RPC metadata) and the
+        # open dispatch→barrier-release wait span
+        self._round_span = None
+        self._wait_span = None
 
         # single-worker pool serializes all scheduling/aggregation work
         self._pool = ThreadPoolExecutor(max_workers=1,
@@ -277,6 +308,7 @@ class Controller:
             record.proxy = self._proxy_factory(record)
             self._learners[learner_id] = record
             self._tokens[learner_id] = token
+            _M_ACTIVE_LEARNERS.set(len(self._learners))
         logger.info("learner %s joined (%d train examples)",
                     learner_id, request.num_train_examples)
         # Control handoff exactly like controller.cc:163-164: initial task is
@@ -292,6 +324,10 @@ class Controller:
             if record is None or record.auth_token != auth_token:
                 return False
             del self._learners[learner_id]
+            _M_ACTIVE_LEARNERS.set(len(self._learners))
+        # bounded metric cardinality under churn: a departed learner's
+        # per-learner series must not accumulate for the process lifetime
+        _M_UPLINK.remove(learner=learner_id)
         self._store.erase([learner_id])
         logger.info("learner %s left", learner_id)
         # Re-evaluate the round barrier: if the departed learner was the last
@@ -424,6 +460,10 @@ class Controller:
                 self._current_meta.train_received_at[result.learner_id] = start
                 self._current_meta.uplink_bytes[result.learner_id] = \
                     len(result.model)
+            # under the lock: leave() deletes the record under this lock
+            # and prunes the series after — an unlocked inc here could
+            # interleave and resurrect a departed learner's series
+            _M_UPLINK.inc(len(result.model), learner=result.learner_id)
 
         if stale and self._topk_uplink():
             # a topk payload is a delta against the community model AT
@@ -451,7 +491,13 @@ class Controller:
                     f"malformed result from {result.learner_id}: {exc}")
             model = None
         if model is not None:
-            self._store.insert(result.learner_id, model)
+            insert_sp = _ttrace.span(
+                "round.store_insert", parent=self._round_span,
+                attrs={"learner": result.learner_id})
+            with insert_sp:
+                self._store.insert(result.learner_id, model)
+            _M_PHASE.observe(insert_sp.duration_ms / 1e3,
+                             phase="store_insert")
             with self._lock:
                 # step count and result round pair with the STORED model:
                 # dropped payloads (late topk, malformed) must not refresh
@@ -602,11 +648,27 @@ class Controller:
         and re-dispatches a fresh full cohort (mask streams are keyed on the
         round counter, which did not advance, so secure retries are clean).
         """
-        selected = self._selector.select(cohort, self.active_learners())
+        # the round barrier just released: close the wait-for-uplinks span
+        with self._lock:
+            wait_sp, self._wait_span = self._wait_span, None
+        if wait_sp is not None:
+            wait_sp.end()
+            _M_PHASE.observe(wait_sp.duration_ms / 1e3, phase="wait_uplinks")
+            with self._lock:
+                # accumulate like dispatch_duration_ms: an intra-round
+                # aggregation-failure retry opens a second wait barrier
+                # and both belong to this round's total
+                self._current_meta.wait_duration_ms += wait_sp.duration_ms
+        select_sp = _ttrace.span("round.select", parent=self._round_span,
+                                 attrs={"cohort": len(cohort)})
+        with select_sp:
+            selected = self._selector.select(cohort, self.active_learners())
+        _M_PHASE.observe(select_sp.duration_ms / 1e3, phase="select")
         try:
             self._compute_community_model(selected)
             self._agg_failures = 0
         except Exception as exc:
+            _M_AGG_FAILURES.inc()
             self._agg_failures += 1
             with self._lock:
                 self._current_meta.errors.append(f"aggregation failed: {exc!r}")
@@ -618,6 +680,14 @@ class Controller:
                 logger.error(
                     "aggregation failed %d consecutive times (%r); halting "
                     "re-dispatch", self._agg_failures, exc)
+                # flush the halted round's trace tree: the round span is
+                # the root carrying the round attr, and the operator
+                # debugging THIS round needs it in the sink
+                with self._lock:
+                    round_sp, self._round_span = self._round_span, None
+                if round_sp is not None:
+                    round_sp.set_attr("error", f"aggregation halted: {exc!r}")
+                    round_sp.end()
                 return
             logger.warning("aggregation failed (%r); re-dispatching", exc)
             if self._shutdown.is_set():
@@ -635,9 +705,17 @@ class Controller:
             self._current_meta.completed_at = time.time()
             self._current_meta.peak_rss_kb = resource.getrusage(
                 resource.RUSAGE_SELF).ru_maxrss
+            round_wall_s = max(0.0, self._current_meta.completed_at
+                               - self._current_meta.started_at)
             self.round_metadata.append(self._current_meta)
             self._current_meta = RoundMetadata(
                 global_iteration=self.global_iteration)
+            round_sp, self._round_span = self._round_span, None
+        if round_sp is not None:
+            round_sp.set_attr("learners", len(selected))
+            round_sp.end()
+        _M_ROUND_DURATION.observe(round_wall_s)
+        _M_ROUNDS.inc()
         ckpt = self.config.checkpoint
         if ckpt.dir and self.global_iteration % max(1, ckpt.every_n_rounds) == 0:
             try:
@@ -700,8 +778,23 @@ class Controller:
     # -- aggregation ------------------------------------------------------
 
     def _compute_community_model(self, selected: Sequence[str]) -> None:
-        """ComputeCommunityModel (controller.cc:795-950), stride-blocked."""
-        t0 = time.time()
+        """ComputeCommunityModel (controller.cc:795-950), stride-blocked.
+
+        Timing comes from telemetry spans (the aggregate span and one span
+        per stride block) which ALSO populate the RoundMetadata fields the
+        ad-hoc ``time.time()`` deltas used to fill — ``experiment.json``
+        is unchanged."""
+        agg_sp = _ttrace.span("round.aggregate", parent=self._round_span,
+                              attrs={"rule": self._aggregator.name,
+                                     "selected": len(selected)})
+        try:
+            self._compute_community_model_traced(selected, agg_sp)
+        finally:
+            agg_sp.end()
+            _M_PHASE.observe(agg_sp.duration_ms / 1e3, phase="aggregate")
+
+    def _compute_community_model_traced(self, selected: Sequence[str],
+                                        agg_sp) -> None:
         lineage_k = self._aggregator.required_lineage
         stride = self.config.aggregation.stride_length or len(selected) or 1
         metadata = self._scaling_metadata(selected)
@@ -718,6 +811,20 @@ class Controller:
         meta_blocks: List[int] = []
         meta_durations: List[float] = []
         ids = [lid for lid in selected if lid in scales]
+
+        def block_span(block):
+            """One aggregation-block span; ``end()`` feeds both the phase
+            metric and the lineage block-duration list."""
+            sp = _ttrace.span("round.agg_block", parent=agg_sp,
+                              attrs={"size": len(block)})
+            return sp
+
+        def end_block(sp, block):
+            sp.end()
+            _M_PHASE.observe(sp.duration_ms / 1e3, phase="aggregate_block")
+            meta_blocks.append(len(block))
+            meta_durations.append(sp.duration_ms)
+
         def collect_all_pairs():
             """Whole-cohort collection (secure + robust rules): stride only
             bounds store-select batching; every selected model enters ONE
@@ -725,14 +832,13 @@ class Controller:
             pairs, present_ids = [], []
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
-                tb = time.time()
+                sp = block_span(block)
                 picked = self._store.select(block, k=lineage_k)
                 for lid in block:
                     if lid in picked:
                         pairs.append((picked[lid], scales[lid]))
                         present_ids.append(lid)
-                meta_blocks.append(len(block))
-                meta_durations.append((time.time() - tb) * 1e3)
+                end_block(sp, block)
             return pairs, present_ids
 
         if self.config.secure.enabled:
@@ -768,7 +874,7 @@ class Controller:
                                   False)
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
-                tb = time.time()
+                sp = block_span(block)
                 picked = self._store.select(block, k=lineage_k)
                 pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
                 if pairs:
@@ -783,8 +889,7 @@ class Controller:
                     else:
                         self._aggregator.accumulate(pairs)
                     accumulated += len(pairs)
-                meta_blocks.append(len(block))
-                meta_durations.append((time.time() - tb) * 1e3)
+                end_block(sp, block)
             if not accumulated:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
@@ -797,15 +902,14 @@ class Controller:
             # rolling rules (fedstride / fedrec): incremental block updates
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
-                tb = time.time()
+                sp = block_span(block)
                 picked = self._store.select(block, k=lineage_k)
                 pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
                 present = [lid for lid in block if lid in picked]
                 if pairs:
                     community = self._aggregator.aggregate(
                         pairs, learner_ids=present)
-                meta_blocks.append(len(block))
-                meta_durations.append((time.time() - tb) * 1e3)
+                end_block(sp, block)
             if community is None:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
@@ -814,6 +918,9 @@ class Controller:
             self._fold_scaffold_controls(ids)
 
         blob = self._community_to_blob(community)
+        # close the span here so its duration covers collection +
+        # combine + blob encode — the same interval the old t0 delta did
+        agg_sp.end()
         with self._lock:
             if self.config.secure.enabled:
                 self._community_opaque = community
@@ -828,7 +935,7 @@ class Controller:
                            for lid, w in scales.items()}
             meta.aggregation_block_sizes = meta_blocks
             meta.aggregation_block_duration_ms = meta_durations
-            meta.aggregation_duration_ms = (time.time() - t0) * 1e3
+            meta.aggregation_duration_ms = agg_sp.duration_ms
             if not self.config.secure.enabled:
                 sizes = {"values": 0, "non_zeros": 0, "zeros": 0, "bytes": 0}
                 for arr in community.values():
@@ -1006,45 +1113,61 @@ class Controller:
         with self._lock:
             if not self._current_meta.started_at:
                 # first dispatch of this round == round start
-                # (reference controller.cc:406-418)
+                # (reference controller.cc:406-418); the round span is the
+                # root of this round's trace — learner train spans parent
+                # under it via the RPC metadata the dispatch carries
                 self._current_meta.started_at = time.time()
-        for lid in learner_ids:
-            with self._lock:
-                record = self._learners.get(lid)
-                if record is None:
-                    continue
-                params = dataclasses.replace(self.config.train)
-                if record.local_steps_override:
-                    params.local_steps = record.local_steps_override
-                task = TrainTask(
-                    task_id=uuid.uuid4().hex,
-                    learner_id=lid,
-                    round_id=self.global_iteration,
-                    global_iteration=self.global_iteration,
-                    model=blob,
-                    params=params,
-                    scaffold=self._aggregator.name == "scaffold",
-                    control=self._pack_scaffold_c(),
-                )
-                self._tasks_in_flight[task.task_id] = lid
-                self._current_meta.train_submitted_at[lid] = time.time()
-                proxy = record.proxy
-            try:
-                if hasattr(proxy, "run_task_with_callback"):
-                    # async transports surface failures via callback
-                    proxy.run_task_with_callback(
-                        task, lambda exc, lid=lid:
-                        self._note_dispatch_failure(lid, exc))
-                else:
-                    proxy.run_task(task)
-            except Exception as exc:
-                # Failed dispatches are logged and counted (the reference
-                # only logs and keeps scheduling them, controller.cc:783-786);
-                # async protocols recover, sync rounds rely on the round
-                # deadline / membership changes, and _sample_cohort skips
-                # learners past the consecutive-failure limit.
-                logger.exception("train dispatch to %s failed", lid)
-                self._note_dispatch_failure(lid, exc)
+                self._round_span = _ttrace.span(
+                    "round", parent=None,
+                    attrs={"round": self.global_iteration})
+            round_span = self._round_span
+        dispatch_sp = _ttrace.span("round.dispatch", parent=round_span,
+                                   attrs={"learners": len(learner_ids)})
+        with dispatch_sp, dispatch_sp.activate():
+            for lid in learner_ids:
+                with self._lock:
+                    record = self._learners.get(lid)
+                    if record is None:
+                        continue
+                    params = dataclasses.replace(self.config.train)
+                    if record.local_steps_override:
+                        params.local_steps = record.local_steps_override
+                    task = TrainTask(
+                        task_id=uuid.uuid4().hex,
+                        learner_id=lid,
+                        round_id=self.global_iteration,
+                        global_iteration=self.global_iteration,
+                        model=blob,
+                        params=params,
+                        scaffold=self._aggregator.name == "scaffold",
+                        control=self._pack_scaffold_c(),
+                    )
+                    self._tasks_in_flight[task.task_id] = lid
+                    self._current_meta.train_submitted_at[lid] = time.time()
+                    proxy = record.proxy
+                try:
+                    if hasattr(proxy, "run_task_with_callback"):
+                        # async transports surface failures via callback
+                        proxy.run_task_with_callback(
+                            task, lambda exc, lid=lid:
+                            self._note_dispatch_failure(lid, exc))
+                    else:
+                        proxy.run_task(task)
+                except Exception as exc:
+                    # Failed dispatches are logged and counted (the reference
+                    # only logs and keeps scheduling them, controller.cc:783-786);
+                    # async protocols recover, sync rounds rely on the round
+                    # deadline / membership changes, and _sample_cohort skips
+                    # learners past the consecutive-failure limit.
+                    logger.exception("train dispatch to %s failed", lid)
+                    self._note_dispatch_failure(lid, exc)
+        _M_PHASE.observe(dispatch_sp.duration_ms / 1e3, phase="dispatch")
+        with self._lock:
+            # accumulate: join/rejoin re-dispatches add to the same round
+            self._current_meta.dispatch_duration_ms += dispatch_sp.duration_ms
+            if self._wait_span is None and learner_ids:
+                self._wait_span = _ttrace.span("round.wait_uplinks",
+                                               parent=round_span)
         self._arm_round_deadline(restart=restart_deadline)
 
     def _note_dispatch_failure(self, learner_id: str, exc: Exception) -> None:
@@ -1083,6 +1206,9 @@ class Controller:
         entry: Dict[str, Any] = {"global_iteration": iteration, "evaluations": {}}
         with self._lock:
             self.community_evaluations.append(entry)
+        eval_sp = _ttrace.span("round.eval_dispatch",
+                               parent=self._round_span,
+                               attrs={"learners": len(learners)})
         for record in learners:
             task = EvalTask(
                 task_id=uuid.uuid4().hex,
@@ -1105,9 +1231,11 @@ class Controller:
                     meta.eval_received_at[lid] = time.time()
 
             try:
-                record.proxy.evaluate(task, _digest)
+                with eval_sp.activate():
+                    record.proxy.evaluate(task, _digest)
             except Exception:
                 logger.exception("eval dispatch to %s failed", record.learner_id)
+        eval_sp.end()
 
     # ------------------------------------------------------------------ #
     # checkpoint / resume

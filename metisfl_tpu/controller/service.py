@@ -101,6 +101,7 @@ class ControllerServer:
             "GetEvaluationLineage": self._get_evaluation_lineage,
             "ListLearners": self._list_learners,
             "GetHealthStatus": self._health,
+            "GetMetrics": self._get_metrics,
             "ShutDown": self._shutdown_rpc,
         }))
         self._shutdown_event = threading.Event()
@@ -146,6 +147,13 @@ class ControllerServer:
     def _health(self, raw: bytes) -> bytes:
         return dumps({"status": "SERVING",
                       "learners": self.controller.active_learners()})
+
+    def _get_metrics(self, raw: bytes) -> bytes:
+        # Prometheus text exposition of the process registry (served next
+        # to grpc.health.v1 like the scrape surface of a normal service;
+        # plain-HTTP scrapers use telemetry.httpd instead)
+        from metisfl_tpu.telemetry import render_metrics
+        return render_metrics().encode("utf-8")
 
     def _shutdown_rpc(self, raw: bytes) -> bytes:
         # ack first, then tear down off-thread (servicer :364-375 pattern)
@@ -219,6 +227,11 @@ class ControllerClient:
 
     def health(self, timeout: float = 5.0) -> dict:
         return loads(self._client.call("GetHealthStatus", b"", timeout=timeout))
+
+    def get_metrics(self, timeout: float = 5.0) -> str:
+        """The controller's Prometheus text exposition (GetMetrics RPC)."""
+        return self._client.call("GetMetrics", b"",
+                                 timeout=timeout).decode("utf-8")
 
     def shutdown_controller(self) -> bool:
         return bool(loads(self._client.call("ShutDown", b""))["ok"])

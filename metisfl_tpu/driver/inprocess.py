@@ -67,6 +67,23 @@ class InProcessFederation:
 
     def __init__(self, config: FederationConfig, secure_backend=None):
         self.config = config
+        # one process, one telemetry context: controller round spans and
+        # learner train spans share the registry/sink directly. The
+        # metrics enabled flag always follows THIS config (a prior
+        # opt-out run must not stick to later default-enabled ones); the
+        # tracer is only reconfigured when the config says something (a
+        # sink dir, or an explicit opt-out) — a default config must not
+        # clobber a sink the host process already set up.
+        from metisfl_tpu.telemetry import metrics as _tmetrics
+        from metisfl_tpu.telemetry import trace as _ttrace
+        _tmetrics.set_enabled(config.telemetry.enabled)
+        if not config.telemetry.enabled or config.telemetry.dir:
+            from metisfl_tpu import telemetry
+            telemetry.apply_config(config.telemetry, service="inprocess")
+        else:
+            # enabled with no sink of its own: keep any host-configured
+            # sink, just make sure a prior opt-out run does not stick
+            _ttrace.set_enabled(True)
         self._learners_by_port: Dict[int, Learner] = {}
         self._proxies: List[_DirectLearnerProxy] = []
         self.controller = Controller(config, self._make_proxy,

@@ -261,6 +261,16 @@ class DriverSession:
     def initialize_federation(self, health_retries: int = 30,
                               health_sleep_s: float = 1.0) -> None:
         self._prepare_secure()
+        # telemetry trace sinks default into the experiment workdir so
+        # controller + learner spans stitch into one tree on disk; the
+        # same path ships to learners via --telemetry-dir (local
+        # launchers share the filesystem; SSH learners keep their files
+        # remote and collect_traces skips them)
+        if self.config.telemetry.enabled and not self.config.telemetry.dir:
+            self.config.telemetry.dir = os.path.join(self.workdir,
+                                                     "telemetry")
+        if self.config.telemetry.enabled and self.config.telemetry.dir:
+            os.makedirs(self.config.telemetry.dir, exist_ok=True)
         # TLS: generate the federation's self-signed pair on first boot
         # (reference driver keygen posture, ssl_configurator.py:21-30)
         if self.config.ssl.enabled and not self.config.ssl.cert_path:
@@ -334,6 +344,10 @@ class DriverSession:
         if self.config.secure.enabled:
             argv += ["--secure-config",
                      os.path.join(self.workdir, f"learner_{idx}_secure.bin")]
+        if not self.config.telemetry.enabled:
+            argv += ["--telemetry-off"]
+        elif self.config.telemetry.dir:
+            argv += ["--telemetry-dir", self.config.telemetry.dir]
         if isinstance(launcher, SSHLauncher):
             # remote host: copy the recipe + TLS/secure material to the same
             # absolute paths (metisfl_tpu itself must be installed remotely)
@@ -552,6 +566,32 @@ class DriverSession:
             json.dump(self.get_statistics(), f, indent=2, default=str)
         return path
 
+    def collect_traces(self, dest: Optional[str] = None) -> Optional[str]:
+        """Merge the per-process telemetry trace files (controller +
+        local learners append to ``<workdir>/telemetry/*.jsonl``) into
+        one ``traces.jsonl`` next to ``experiment.json``, so the
+        experiment directory is self-contained for
+        ``python -m metisfl_tpu.telemetry``. Returns the merged path, or
+        None when there is nothing to collect (telemetry off, or every
+        learner was remote and kept its sink on its own host)."""
+        tel_dir = self.config.telemetry.dir
+        if not (self.config.telemetry.enabled and tel_dir
+                and os.path.isdir(tel_dir)):
+            return None
+        import glob as _glob
+        files = sorted(_glob.glob(os.path.join(tel_dir, "*.jsonl")))
+        if not files:
+            return None
+        dest = dest or os.path.join(self.workdir, "traces.jsonl")
+        with open(dest, "w") as out:
+            for name in files:
+                try:
+                    with open(name) as f:
+                        out.write(f.read())
+                except OSError:  # noqa: PERF203 - a torn file is skippable
+                    logger.warning("could not collect trace file %s", name)
+        return dest
+
     def shutdown_federation(self, timeout_s: Optional[float] = None) -> None:
         # Default drain budget: 15 s, or 150 s when any learner is a
         # multi-host world — its leader can only release the followers
@@ -603,6 +643,10 @@ class DriverSession:
                 proc.process.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
                 _terminate_process(proc.process)
+        try:
+            self.collect_traces()
+        except Exception:  # noqa: BLE001 - collection must not fail shutdown
+            logger.exception("trace collection failed")
 
     def run(self) -> dict:
         """initialize → monitor → save stats → shutdown, one call."""

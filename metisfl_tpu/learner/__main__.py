@@ -75,7 +75,28 @@ def main(argv=None) -> int:
     parser.add_argument("--secure-config", default="",
                         help="codec file with the driver-distributed secure-"
                              "aggregation material (scheme + keys/secret)")
+    parser.add_argument("--telemetry-dir", default="",
+                        help="JSONL trace-sink directory (the driver points "
+                             "this at <workdir>/telemetry)")
+    parser.add_argument("--telemetry-off", action="store_true",
+                        help="disable spans + metrics (federation config "
+                             "telemetry.enabled=false, forwarded by the "
+                             "driver)")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="plain-HTTP /metrics listener port (0 = off; "
+                             "metrics stay reachable via the GetMetrics RPC)")
     args = parser.parse_args(argv)
+
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.config import TelemetryConfig
+    telemetry.apply_config(
+        TelemetryConfig(enabled=not args.telemetry_off,
+                        dir=args.telemetry_dir),
+        service=f"learner-{args.port or os.getpid()}")
+    metrics_http = None
+    if not args.telemetry_off and args.metrics_port > 0:
+        from metisfl_tpu.telemetry.httpd import start_metrics_http
+        metrics_http = start_metrics_http(args.metrics_port, host=args.host)
 
     logging.basicConfig(
         level=logging.INFO,
@@ -188,6 +209,9 @@ def main(argv=None) -> int:
             except Exception:
                 logging.getLogger("metisfl_tpu.learner").exception(
                     "follower release broadcast failed")
+        if metrics_http is not None:
+            metrics_http.close()
+        telemetry.trace.flush()
     return 0
 
 

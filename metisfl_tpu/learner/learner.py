@@ -40,6 +40,8 @@ from metisfl_tpu.comm.messages import (
 )
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.ops import FlaxModelOps
+from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.tensor.spec import resolve_ship_dtype
 from metisfl_tpu.tensor.pytree import (
     ModelBlob,
@@ -48,6 +50,23 @@ from metisfl_tpu.tensor.pytree import (
 )
 
 logger = logging.getLogger("metisfl_tpu.learner")
+
+_REG = _tmetrics.registry()
+_M_TRAIN_DURATION = _REG.histogram(
+    "learner_train_duration_seconds", "End-to-end train-task time")
+_M_TRAIN_STEP_MS = _REG.histogram(
+    "learner_step_milliseconds", "Median per-optimizer-step time",
+    buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+             5000))
+_M_JIT_COMPILE = _REG.histogram(
+    "learner_jit_compile_seconds",
+    "Estimated jit-compile overhead per train task (task wall-clock "
+    "minus steps x steady-state step time)")
+_M_TASKS = _REG.counter(
+    "learner_tasks_total", "Train tasks by outcome",
+    ("outcome",))
+_M_EVALS = _REG.histogram(
+    "learner_eval_duration_seconds", "Community-model evaluation time")
 
 
 class ControllerProxy(Protocol):
@@ -367,14 +386,32 @@ class Learner:
         """Non-blocking: cancels any running training, schedules this one."""
         if self._shutdown.is_set():
             return
+        # capture the dispatch-time span context (the controller's round
+        # span — via gRPC metadata cross-process, via the live contextvar
+        # in-process): the train executor thread has its own contextvars
+        # context, so the parent link must travel explicitly
+        trace_ctx = _ttrace.current_context()
         with self._task_lock:
             if self._current_future is not None and not self._current_future.done():
                 self._cancel.set()
             self._current_future = self._executor.submit(
-                self._train_and_report, task)
+                self._train_and_report, task, trace_ctx)
 
-    def _train_and_report(self, task: TrainTask) -> None:
+    def _train_and_report(self, task: TrainTask,
+                          trace_ctx=None) -> None:
         self._cancel.clear()
+        task_sp = _ttrace.span(
+            "learner.train", parent=trace_ctx,
+            attrs={"task_id": task.task_id, "round": task.round_id,
+                   "learner": self.learner_id})
+        with task_sp, task_sp.activate():
+            self._run_train_task(task, task_sp)
+        # the whole task — load + train + dump + report — matching the
+        # metric's end-to-end contract (learner.train_steps has its own
+        # step/compile histograms)
+        _M_TRAIN_DURATION.observe(task_sp.duration_ms / 1e3)
+
+    def _run_train_task(self, task: TrainTask, task_sp) -> None:
         try:
             params = task.params
             # set BEFORE _load_model: round-2+ community blobs omit the
@@ -434,11 +471,14 @@ class Learner:
             topk_denom = (parse_topk(params.ship_dtype)
                           if params.ship_dtype else None)
             wire_ref = None
-            if topk_denom is not None and self.secure_backend is None:
-                incoming, wire_ref = self._load_model(task.model,
-                                                      with_wire=True)
-            else:
-                incoming = self._load_model(task.model)
+            load_sp = _ttrace.span("learner.load_model",
+                                   attrs={"bytes": len(task.model)})
+            with load_sp:
+                if topk_denom is not None and self.secure_backend is None:
+                    incoming, wire_ref = self._load_model(task.model,
+                                                          with_wire=True)
+                else:
+                    incoming = self._load_model(task.model)
             self.model_ops.set_variables(incoming)
             grad_offset = None
             scaffold_c = None
@@ -454,9 +494,26 @@ class Learner:
             # is rejected at config time)
             train_kwargs = ({"grad_offset": grad_offset}
                             if grad_offset is not None else {})
-            out = self.model_ops.train(self.datasets["train"], params,
-                                       cancel_event=self._cancel,
-                                       **train_kwargs)
+            train_sp = _ttrace.span("learner.train_steps")
+            with train_sp:
+                out = self.model_ops.train(self.datasets["train"], params,
+                                           cancel_event=self._cancel,
+                                           **train_kwargs)
+                train_sp.set_attr("steps", out.completed_steps)
+                train_sp.set_attr("ms_per_step", round(out.ms_per_step, 3))
+                # steady-state step time x steps leaves (mostly) the
+                # one-off jit compile of the step/scan program — a live
+                # proxy for the trace capture the TPU watch scripts lost
+                # (ISSUE motivation). Attrs must land BEFORE the span
+                # ends: end() is what serializes the record to the sink.
+                compile_s = max(0.0, train_sp.duration_ms / 1e3
+                                - out.completed_steps * out.ms_per_step / 1e3)
+                train_sp.set_attr("jit_compile_s_est", round(compile_s, 3))
+            if out.completed_steps > 0 and out.ms_per_step > 0:
+                # a zero-step task (instant cancel, empty dataset) has no
+                # step baseline — its wall-clock is not compile time
+                _M_TRAIN_STEP_MS.observe(out.ms_per_step)
+                _M_JIT_COMPILE.observe(compile_s)
             # training updated the local tensors (e.g. BatchNorm stats):
             # refresh the snapshot evals and later merges read from —
             # under the task lock so _adopt_local_regex's fallback install
@@ -469,6 +526,8 @@ class Learner:
                 self.secure_backend.begin_round(task.round_id)
             if self._cancel.is_set():
                 logger.info("%s: task %s cancelled", self.learner_id, task.task_id)
+                _M_TASKS.inc(outcome="cancelled")
+                task_sp.set_attr("outcome", "cancelled")
                 return
             control_delta = b""
             if scaffold_c is not None:
@@ -482,12 +541,16 @@ class Learner:
                 ship_vars = privatize_update(
                     self.model_ops.get_variables(), incoming,
                     params.dp_clip_norm, params.dp_noise_multiplier)
-            if wire_ref is not None:
-                model_bytes = self._dump_sparse(wire_ref, ship_vars,
-                                                topk_denom)
-            else:
-                model_bytes = self._dump_model(ship_dtype=params.ship_dtype,
-                                               variables=ship_vars)
+            dump_sp = _ttrace.span("learner.dump_model")
+            with dump_sp:
+                if wire_ref is not None:
+                    model_bytes = self._dump_sparse(wire_ref, ship_vars,
+                                                    topk_denom)
+                else:
+                    model_bytes = self._dump_model(
+                        ship_dtype=params.ship_dtype, variables=ship_vars)
+                dump_sp.set_attr("bytes", len(model_bytes))
+            task_sp.set_attr("uplink_bytes", len(model_bytes))
             result = TaskResult(
                 task_id=task.task_id,
                 learner_id=self.learner_id,
@@ -504,7 +567,11 @@ class Learner:
                 control_delta=control_delta,
             )
             self.controller.task_completed(result)
+            _M_TASKS.inc(outcome="completed")
+            task_sp.set_attr("outcome", "completed")
         except Exception:
+            _M_TASKS.inc(outcome="failed")
+            task_sp.set_attr("outcome", "failed")
             logger.exception("%s: training task %s failed",
                              self.learner_id, task.task_id)
 
@@ -552,21 +619,27 @@ class Learner:
     def evaluate(self, task: EvalTask) -> EvalResult:
         """Blocking community-model evaluation over requested datasets."""
         t0 = time.time()
-        self._adopt_local_regex(task.local_tensor_regex)
-        if task.ship_tensor_regex:
-            # never-trained learners get the regex from the task (backfill
-            # reads the immutable construction tree — no snapshot needed)
-            self._ship_regex = task.ship_tensor_regex
-        # Evaluate on an explicit variables tree so a concurrently running
-        # training task never races on the engine's model slot.
-        variables = self._load_model(task.model)
-        evaluations: Dict[str, Dict[str, float]] = {}
-        for name in task.datasets:
-            ds = self.datasets.get(name)
-            if ds is None or len(ds) == 0:
-                continue
-            evaluations[name] = self.model_ops.evaluate(
-                ds, task.batch_size, task.metrics, variables=variables)
+        eval_sp = _ttrace.span(
+            "learner.eval", attrs={"task_id": task.task_id,
+                                   "round": task.round_id,
+                                   "learner": self.learner_id})
+        with eval_sp, eval_sp.activate():
+            self._adopt_local_regex(task.local_tensor_regex)
+            if task.ship_tensor_regex:
+                # never-trained learners get the regex from the task (backfill
+                # reads the immutable construction tree — no snapshot needed)
+                self._ship_regex = task.ship_tensor_regex
+            # Evaluate on an explicit variables tree so a concurrently running
+            # training task never races on the engine's model slot.
+            variables = self._load_model(task.model)
+            evaluations: Dict[str, Dict[str, float]] = {}
+            for name in task.datasets:
+                ds = self.datasets.get(name)
+                if ds is None or len(ds) == 0:
+                    continue
+                evaluations[name] = self.model_ops.evaluate(
+                    ds, task.batch_size, task.metrics, variables=variables)
+        _M_EVALS.observe(eval_sp.duration_ms / 1e3)
         return EvalResult(
             task_id=task.task_id,
             learner_id=self.learner_id,

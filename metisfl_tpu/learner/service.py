@@ -36,6 +36,7 @@ class LearnerServer:
             "RunInference": self._infer,
             "RecoverMasks": self._recover_masks,
             "GetHealthStatus": self._health,
+            "GetMetrics": self._get_metrics,
             "ShutDown": self._shutdown_rpc,
         }))
         self._shutdown_event = threading.Event()
@@ -62,6 +63,12 @@ class LearnerServer:
 
     def _health(self, raw: bytes) -> bytes:
         return dumps({"status": "SERVING", "tasks_received": self._tasks_received})
+
+    def _get_metrics(self, raw: bytes) -> bytes:
+        # same scrape surface as the controller: Prometheus exposition of
+        # this learner process's registry
+        from metisfl_tpu.telemetry import render_metrics
+        return render_metrics().encode("utf-8")
 
     def _shutdown_rpc(self, raw: bytes) -> bytes:
         logger.info("learner ShutDown RPC received")

@@ -35,7 +35,15 @@ def summarize(stats: Dict[str, Any]) -> str:
 
     if rounds:
         lines.append("")
-        lines.append(f"{'round':>5} {'wall':>8} {'cohort':>6} {'agg':>8} "
+        # telemetry-era payloads carry a span-sourced phase breakdown
+        # (dispatch / wait-for-uplinks; aggregate was always present);
+        # pre-telemetry experiment.json renders byte-identically because
+        # the extra columns only appear when some round has the keys
+        has_phases = any("dispatch_duration_ms" in m
+                         or "wait_duration_ms" in m for m in rounds)
+        phase_header = f"{'disp':>8} {'wait':>8} " if has_phases else ""
+        lines.append(f"{'round':>5} {'wall':>8} {phase_header}"
+                     f"{'cohort':>6} {'agg':>8} "
                      f"{'params':>10} {'uplink':>9} {'errors':>6}")
         for meta in rounds:
             wall_ms = 1e3 * max(
@@ -44,9 +52,15 @@ def summarize(stats: Dict[str, Any]) -> str:
             up_s = (f"{up / 1e6:.1f}MB" if up >= 1e6
                     else f"{up / 1e3:.0f}KB" if up >= 1e3
                     else f"{up}B" if up else "-")
+            phase_cells = ""
+            if has_phases:
+                phase_cells = (
+                    f"{_fmt_ms(meta.get('dispatch_duration_ms', 0.0)):>8} "
+                    f"{_fmt_ms(meta.get('wait_duration_ms', 0.0)):>8} ")
             lines.append(
                 f"{meta.get('global_iteration', '?'):>5} "
                 f"{_fmt_ms(wall_ms):>8} "
+                f"{phase_cells}"
                 f"{len(meta.get('selected_learners', [])):>6} "
                 f"{_fmt_ms(meta.get('aggregation_duration_ms', 0.0)):>8} "
                 f"{meta.get('model_size', {}).get('values', 0):>10} "

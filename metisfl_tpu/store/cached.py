@@ -19,6 +19,17 @@ import numpy as np
 
 from metisfl_tpu.store.base import EvictionPolicy
 from metisfl_tpu.store.disk import _MISS, DiskModelStore
+from metisfl_tpu.telemetry import metrics as _tmetrics
+
+_REG = _tmetrics.registry()
+_M_CACHE_HITS = _REG.counter(
+    "store_cache_hits_total", "Model-store cache hits")
+_M_CACHE_MISSES = _REG.counter(
+    "store_cache_misses_total", "Model-store cache misses (disk reads)")
+_M_CACHE_BYTES = _REG.gauge(
+    "store_cache_resident_bytes", "Decoded models resident in the cache")
+_M_CACHE_ENTRIES = _REG.gauge(
+    "store_cache_entries", "Models resident in the cache")
 
 
 def _value_nbytes(value: Any) -> int:
@@ -68,11 +79,17 @@ class CachedDiskStore(DiskModelStore):
         while self._cached_total > self.cache_bytes and self._cache:
             _, (evicted_bytes, _) = self._cache.popitem(last=False)
             self._cached_total -= evicted_bytes
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        _M_CACHE_BYTES.set(self._cached_total)
+        _M_CACHE_ENTRIES.set(len(self._cache))
 
     def _cache_drop_learner(self, learner_id: str) -> None:
         for key in [k for k in self._cache if k[0] == learner_id]:
             nbytes, _ = self._cache.pop(key)
             self._cached_total -= nbytes
+        self._publish_gauges()
 
     # -- DiskModelStore overrides -----------------------------------------
     def _append(self, learner_id: str, model: Any) -> int:
@@ -88,8 +105,10 @@ class CachedDiskStore(DiskModelStore):
         if cached is not None:
             self._cache.move_to_end((learner_id, seq))
             self.cache_hits += 1
+            _M_CACHE_HITS.inc()
             return cached[1]
         self.cache_misses += 1
+        _M_CACHE_MISSES.inc()
         return _MISS
 
     def _cache_store(self, learner_id: str, seq: int, value: Any) -> None:
@@ -119,3 +138,4 @@ class CachedDiskStore(DiskModelStore):
             dropped = self._cache.pop((learner_id, seq), None)
             if dropped is not None:
                 self._cached_total -= dropped[0]
+        self._publish_gauges()

@@ -1,0 +1,71 @@
+"""Federation-wide telemetry: trace spans + metrics registry.
+
+Zero-dependency observability for the federation runtime (ROADMAP
+north-star: a production service must tell you *where* a round is stuck
+while it is stuck, not after the experiment ends):
+
+- :mod:`metisfl_tpu.telemetry.trace` — context-manager spans with
+  federation-wide trace/span ids, a process-local JSONL sink, and
+  propagation over gRPC metadata (controller dispatch → learner train →
+  aggregation stitch into one tree per round, rooted at the controller's
+  round span; the driver collects every process's sink files).
+- :mod:`metisfl_tpu.telemetry.metrics` — thread-safe counters / gauges /
+  histograms with Prometheus text exposition, served via the
+  ``GetMetrics`` RPC on controller and learner and the optional
+  plain-HTTP ``/metrics`` listener (:mod:`metisfl_tpu.telemetry.httpd`).
+- ``python -m metisfl_tpu.telemetry <trace dir or .jsonl>`` renders a
+  round's span tree from the sink.
+
+Everything is opt-out via federation config ``telemetry.enabled=false``
+(:func:`apply_config`); the disabled paths are attribute-check cheap.
+"""
+
+from __future__ import annotations
+
+from metisfl_tpu.telemetry import metrics, trace
+from metisfl_tpu.telemetry.metrics import parse_exposition, registry
+from metisfl_tpu.telemetry.trace import (
+    METADATA_KEY,
+    SpanContext,
+    current_context,
+    extract,
+    outbound_metadata,
+    span,
+)
+
+__all__ = [
+    "metrics",
+    "trace",
+    "registry",
+    "parse_exposition",
+    "span",
+    "current_context",
+    "extract",
+    "outbound_metadata",
+    "SpanContext",
+    "METADATA_KEY",
+    "apply_config",
+    "render_metrics",
+]
+
+
+def render_metrics() -> str:
+    """The process registry's Prometheus exposition (GetMetrics RPC body)."""
+    return registry().render()
+
+
+def apply_config(telemetry_config, service: str = "") -> None:
+    """Configure process-wide telemetry from a federation config's
+    ``telemetry`` section (config/federation.py TelemetryConfig): one call
+    in each process entry point (controller/learner ``__main__``,
+    in-process federation, tests)."""
+    enabled = bool(getattr(telemetry_config, "enabled", True))
+    metrics.set_enabled(enabled)
+    if enabled:
+        trace.configure(enabled=True, service=service,
+                        dir=getattr(telemetry_config, "dir", ""))
+    else:
+        # disable without forgetting any previously configured sink dir:
+        # a later re-enable (set_enabled / a default-enabled config in
+        # the same process) restores it
+        trace.set_enabled(False)

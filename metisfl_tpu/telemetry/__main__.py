@@ -1,0 +1,160 @@
+"""Trace-viewer CLI: ``python -m metisfl_tpu.telemetry <dir-or-jsonl>...``.
+
+Renders the span trees recorded in one or more JSONL trace sinks
+(:mod:`metisfl_tpu.telemetry.trace`) — typically the ``telemetry/``
+directory a driver run leaves in its workdir, where controller and
+learner files stitch into one tree per federation round via the
+wire-propagated trace ids.
+
+    python -m metisfl_tpu.telemetry /tmp/metisfl_tpu_x/telemetry
+    python -m metisfl_tpu.telemetry traces.jsonl --round 3
+    python -m metisfl_tpu.telemetry traces.jsonl --trace 01ab... --attrs
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def load_spans(paths: Iterable[str]) -> List[dict]:
+    """All span records from JSONL files / directories of them. Unreadable
+    lines are skipped (a crashed process can leave a torn tail line)."""
+    spans: List[dict] = []
+    for path in paths:
+        files = (sorted(glob.glob(os.path.join(path, "*.jsonl")))
+                 if os.path.isdir(path) else [path])
+        for name in files:
+            with open(name) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        record = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(record, dict) and record.get("span"):
+                        spans.append(record)
+    return spans
+
+
+def _fmt_dur(ms: float) -> str:
+    return f"{ms / 1e3:.2f}s" if ms >= 1e3 else f"{ms:.1f}ms"
+
+
+def render_trace(spans: List[dict], show_attrs: bool = False) -> str:
+    """One trace's spans (same trace id) as an indented tree, children
+    ordered by start time. Spans whose parent never landed in the sink
+    (e.g. a process killed mid-round) render as roots."""
+    by_id: Dict[str, dict] = {s["span"]: s for s in spans}
+    children: Dict[str, List[dict]] = {}
+    roots: List[dict] = []
+    for s in spans:
+        parent = s.get("parent", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: s.get("start", 0.0))
+    roots.sort(key=lambda s: s.get("start", 0.0))
+
+    lines: List[str] = []
+
+    def _walk(span: dict, prefix: str, tail: bool,
+              root: bool = False) -> None:
+        connector = "" if root else ("└─ " if tail else "├─ ")
+        label = (f"{span.get('name', '?')} "
+                 f"({_fmt_dur(float(span.get('dur_ms', 0.0)))}) "
+                 f"[{span.get('service', '?')}]")
+        if show_attrs and span.get("attrs"):
+            attrs = " ".join(f"{k}={v}" for k, v in span["attrs"].items())
+            label += f"  {{{attrs}}}"
+        lines.append(prefix + connector + label)
+        kids = children.get(span["span"], [])
+        child_prefix = prefix if root else (
+            prefix + ("   " if tail else "│  "))
+        for i, kid in enumerate(kids):
+            _walk(kid, child_prefix, i == len(kids) - 1)
+
+    for root in roots:
+        _walk(root, "", True, root=True)
+    return "\n".join(lines)
+
+
+def _root_round(spans: List[dict]) -> Optional[int]:
+    for s in spans:
+        if not s.get("parent") and "round" in (s.get("attrs") or {}):
+            try:
+                return int(s["attrs"]["round"])
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+def main(argv: List[str]) -> int:
+    show_attrs = "--attrs" in argv
+    argv = [a for a in argv if a != "--attrs"]
+    want_trace = want_round = None
+    for flag in ("--trace", "--round"):
+        if flag in argv:
+            i = argv.index(flag)
+            try:
+                value = argv[i + 1]
+            except IndexError:
+                print(f"{flag} requires a value", file=sys.stderr)
+                return 2
+            if flag == "--trace":
+                want_trace = value
+            else:
+                try:
+                    want_round = int(value)
+                except ValueError:
+                    print("--round requires an integer", file=sys.stderr)
+                    return 2
+            argv = argv[:i] + argv[i + 2:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m metisfl_tpu.telemetry <dir-or-jsonl>... "
+              "[--trace ID] [--round N] [--attrs]", file=sys.stderr)
+        return 2
+
+    try:
+        spans = load_spans(argv)
+    except OSError as exc:
+        print(f"cannot read traces: {exc}", file=sys.stderr)
+        return 1
+    if not spans:
+        print("no spans found", file=sys.stderr)
+        return 1
+
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s.get("trace", "?"), []).append(s)
+    # stable order: by each trace's earliest span
+    ordered = sorted(by_trace.items(),
+                     key=lambda kv: min(s.get("start", 0.0)
+                                        for s in kv[1]))
+    shown = 0
+    for trace_id, group in ordered:
+        if want_trace and not trace_id.startswith(want_trace):
+            continue
+        if want_round is not None and _root_round(group) != want_round:
+            continue
+        round_no = _root_round(group)
+        tag = f" round={round_no}" if round_no is not None else ""
+        print(f"trace {trace_id}{tag} ({len(group)} spans)")
+        print(render_trace(group, show_attrs=show_attrs))
+        print()
+        shown += 1
+    if not shown:
+        print("no matching traces", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

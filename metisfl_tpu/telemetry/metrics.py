@@ -1,0 +1,337 @@
+"""Thread-safe metrics registry with Prometheus text exposition.
+
+Zero-dependency counterpart of ``prometheus_client``: counters, gauges and
+histograms keyed by label values, rendered in the text exposition format
+(version 0.0.4) that Prometheus, victoriametrics and ``promtool`` ingest:
+
+    https://prometheus.io/docs/instrumenting/exposition_formats/
+
+One process-wide registry (``registry()``) backs every instrumented
+subsystem — RPC transport, controller rounds, learner training, stores —
+and is served over the ``GetMetrics`` RPC and the optional plain-HTTP
+``/metrics`` listener (:mod:`metisfl_tpu.telemetry.httpd`). The whole
+registry can be disabled (federation config ``telemetry.enabled=false``);
+disabled instruments return before taking the lock, so the opt-out path
+costs one attribute read per call site.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# latency-shaped default buckets (seconds): sub-ms RPC acks up through
+# multi-second cold-jit training rounds
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _format_value(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+class _Metric:
+    """One named family; concrete series are keyed by label-value tuples."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "Registry", name: str, help: str,
+                 labelnames: Sequence[str]):
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(labels)}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _series(self, key: Tuple[str, ...]) -> str:
+        if not key:
+            return self.name
+        pairs = ",".join(f'{k}="{_escape(v)}"'
+                         for k, v in zip(self.labelnames, key))
+        return f"{self.name}{{{pairs}}}"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, registry, name, help, labelnames):
+        super().__init__(registry, name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def remove(self, **labels) -> None:
+        """Drop one series (bounded cardinality under churn: e.g. a
+        departed learner's per-learner series must not live forever)."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
+    def _render(self, out: List[str]) -> None:
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, value in items:
+            out.append(f"{self._series(key)} {_format_value(value)}")
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labelnames,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # key -> [per-bucket counts..., +Inf count, sum]
+        self._values: Dict[Tuple[str, ...], List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._registry.enabled:
+            return
+        key = self._key(labels)
+        value = float(value)
+        with self._lock:
+            cells = self._values.get(key)
+            if cells is None:
+                cells = self._values[key] = [0.0] * (len(self.buckets) + 2)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    cells[i] += 1
+            cells[-2] += 1  # +Inf
+            cells[-1] += value
+
+    def count(self, **labels) -> float:
+        with self._lock:
+            cells = self._values.get(self._key(labels))
+            return cells[-2] if cells else 0.0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            cells = self._values.get(self._key(labels))
+            return cells[-1] if cells else 0.0
+
+    def _render(self, out: List[str]) -> None:
+        with self._lock:
+            items = sorted((k, list(v)) for k, v in self._values.items())
+        for key, cells in items:
+            for bound, count in zip(self.buckets, cells):
+                series = self._series_with(key, ("le", _format_value(bound)))
+                out.append(f"{self.name}_bucket{series} "
+                           f"{_format_value(count)}")
+            series = self._series_with(key, ("le", "+Inf"))
+            out.append(f"{self.name}_bucket{series} "
+                       f"{_format_value(cells[-2])}")
+            base = self._series(key)[len(self.name):]
+            out.append(f"{self.name}_sum{base} {_format_value(cells[-1])}")
+            out.append(f"{self.name}_count{base} {_format_value(cells[-2])}")
+
+    def _series_with(self, key: Tuple[str, ...],
+                     extra: Tuple[str, str]) -> str:
+        pairs = [f'{k}="{_escape(v)}"' for k, v in zip(self.labelnames, key)]
+        pairs.append(f'{extra[0]}="{_escape(extra[1])}"')
+        return "{" + ",".join(pairs) + "}"
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+class Registry:
+    """Named metric families; idempotent registration (a second
+    ``counter()`` call with the same name returns the first family, so
+    module-level instrumentation never double-registers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "Dict[str, _Metric]" = {}
+        self.enabled = True
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kwargs) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or (
+                        existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind} with labels {existing.labelnames}")
+                return existing
+            metric = cls(self, name, help, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def render(self) -> str:
+        """Prometheus text exposition (format version 0.0.4)."""
+        out: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for metric in metrics:
+            body: List[str] = []
+            metric._render(body)
+            if not body:
+                continue
+            if metric.help:
+                out.append(f"# HELP {metric.name} "
+                           f"{metric.help.replace(chr(10), ' ')}")
+            out.append(f"# TYPE {metric.name} {metric.kind}")
+            out.extend(body)
+        return "\n".join(out) + ("\n" if out else "")
+
+    def reset(self) -> None:
+        """Zero every series (tests); families stay registered so
+        module-level instrument handles keep working."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for metric in metrics:
+            metric._reset()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    return _REGISTRY.enabled
+
+
+def set_enabled(value: bool) -> None:
+    _REGISTRY.enabled = bool(value)
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Parse a text exposition into ``{series_name: {labels: value}}``
+    (labels as a sorted tuple of (key, value) pairs). Raises ValueError
+    on malformed lines — the scrape-compatibility check tests lean on.
+    """
+    series: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, labels, rest = _parse_series(line, lineno)
+        parts = rest.split()
+        if not parts:
+            raise ValueError(f"line {lineno}: missing value: {line!r}")
+        raw = parts[0]
+        if raw == "+Inf":
+            value = math.inf
+        elif raw == "-Inf":
+            value = -math.inf
+        else:
+            try:
+                value = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"line {lineno}: bad value {raw!r}") from None
+        series.setdefault(name, {})[labels] = value
+    return series
+
+
+def _parse_series(line: str, lineno: int):
+    brace = line.find("{")
+    if brace < 0:
+        name, _, rest = line.partition(" ")
+        if not name or not rest:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        return name, (), rest
+    name = line[:brace]
+    end = line.find("}", brace)
+    if end < 0 or not name:
+        raise ValueError(f"line {lineno}: malformed labels: {line!r}")
+    labels: List[Tuple[str, str]] = []
+    body = line[brace + 1:end]
+    pos = 0
+    while pos < len(body):
+        eq = body.find("=", pos)
+        if eq < 0 or body[eq + 1:eq + 2] != '"':
+            raise ValueError(f"line {lineno}: malformed labels: {line!r}")
+        key = body[pos:eq].strip().lstrip(",").strip()
+        pos = eq + 2
+        value = []
+        while pos < len(body):
+            ch = body[pos]
+            if ch == "\\":
+                esc = body[pos + 1:pos + 2]
+                value.append({"n": "\n", '"': '"', "\\": "\\"}.get(esc, esc))
+                pos += 2
+                continue
+            if ch == '"':
+                pos += 1
+                break
+            value.append(ch)
+            pos += 1
+        else:
+            raise ValueError(f"line {lineno}: unterminated label: {line!r}")
+        labels.append((key, "".join(value)))
+    return name, tuple(sorted(labels)), line[end + 1:].strip()

@@ -1,0 +1,334 @@
+"""Federation-wide trace spans with a process-local JSONL sink.
+
+A span is a named, timed interval with a trace id shared by every span in
+one logical operation (a federation round), a span id of its own, and its
+parent's span id — enough to stitch controller → learner → aggregation
+into one tree after the fact (rooted at the controller's round span; the
+driver collects the sink files rather than opening spans). Spans are:
+
+- cheap: ids are ``os.urandom`` hex, timestamps are ``time.time()``/
+  ``perf_counter``; a disabled tracer hands out one shared no-op span;
+- cross-thread: the active span context lives in a ``contextvars``
+  variable for same-thread nesting, and is passed EXPLICITLY wherever work
+  hops threads (the controller's scheduling executor, the learner's train
+  thread) — never inferred across a pool boundary;
+- cross-process: :func:`outbound_metadata` / :func:`extract` carry the
+  context over gRPC metadata (key ``metisfl-trace-ctx``), so a learner's
+  train span parents under the controller round span that dispatched it.
+
+Finished spans append one JSON line to ``<dir>/<service>-<pid>.jsonl``
+(per-process file: concurrent federation processes on one host must not
+interleave writes). ``python -m metisfl_tpu.telemetry`` renders the tree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+METADATA_KEY = "metisfl-trace-ctx"
+
+_CURRENT: "contextvars.ContextVar[Optional[SpanContext]]" = \
+    contextvars.ContextVar("metisfl_tpu_trace_ctx", default=None)
+
+# sentinel: "parent not given — use the calling context's active span"
+_USE_CURRENT = object()
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagatable identity of a span: enough to parent children
+    anywhere — another thread, another process, another host."""
+
+    trace_id: str
+    span_id: str
+
+    def to_wire(self) -> str:
+        return f"{self.trace_id}/{self.span_id}"
+
+    @classmethod
+    def from_wire(cls, value: str) -> Optional["SpanContext"]:
+        trace_id, sep, span_id = value.partition("/")
+        if not sep or not trace_id or not span_id:
+            return None
+        return cls(trace_id=trace_id, span_id=span_id)
+
+
+class Span:
+    """A timed interval. Use as a context manager, or call :meth:`end`
+    explicitly for spans that outlive one scope (the controller's round
+    span stays open across many scheduling-executor invocations)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "start", "_t0", "_duration_ms", "_tracer")
+
+    def __init__(self, tracer: "_Tracer", name: str,
+                 parent: Optional[SpanContext],
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.trace_id = parent.trace_id if parent else os.urandom(16).hex()
+        self.span_id = os.urandom(8).hex()
+        self.parent_id = parent.span_id if parent else ""
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+        self.start = time.time()
+        self._t0 = time.perf_counter()
+        self._duration_ms: Optional[float] = None
+        self._tracer = tracer
+
+    # -- identity ---------------------------------------------------------
+    def context(self) -> SpanContext:
+        return SpanContext(trace_id=self.trace_id, span_id=self.span_id)
+
+    @property
+    def duration_ms(self) -> float:
+        """Elapsed so far, or the final duration once ended."""
+        if self._duration_ms is not None:
+            return self._duration_ms
+        return (time.perf_counter() - self._t0) * 1e3
+
+    # -- mutation ---------------------------------------------------------
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def end(self) -> float:
+        """Finish the span (idempotent) and write it to the sink."""
+        if self._duration_ms is None:
+            self._duration_ms = (time.perf_counter() - self._t0) * 1e3
+            self._tracer._record(self)
+        return self._duration_ms
+
+    # -- scoping ----------------------------------------------------------
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None and "error" not in self.attrs:
+            self.attrs["error"] = f"{type(exc).__name__}: {exc}"
+        self.end()
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Make this span the calling context's active span, so nested
+        ``span()`` calls and outbound RPCs parent under it."""
+        token = _CURRENT.set(self.context())
+        try:
+            yield self
+        finally:
+            _CURRENT.reset(token)
+
+
+class _NullSpan:
+    """Disabled-tracer span: no ids, no sink, no context propagation —
+    but it still MEASURES, because span durations are authoritative for
+    lineage fields (RoundMetadata aggregation/phase timings) that the
+    pre-telemetry code always recorded. Opting telemetry out must not
+    zero ``experiment.json`` timings."""
+
+    __slots__ = ("_t0", "_duration_ms")
+    name = ""
+    trace_id = ""
+    span_id = ""
+    parent_id = ""
+    attrs: Dict[str, Any] = {}
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._duration_ms: Optional[float] = None
+
+    @property
+    def duration_ms(self) -> float:
+        if self._duration_ms is not None:
+            return self._duration_ms
+        return (time.perf_counter() - self._t0) * 1e3
+
+    def context(self) -> None:
+        return None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def end(self) -> float:
+        if self._duration_ms is None:
+            self._duration_ms = (time.perf_counter() - self._t0) * 1e3
+        return self._duration_ms
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end()
+
+    @contextlib.contextmanager
+    def activate(self):
+        yield self
+
+
+class _Tracer:
+    def __init__(self):
+        self.enabled = True
+        self.service = ""
+        self.dir = ""
+        self._path = ""
+        self._fh = None
+        self._lock = threading.Lock()
+
+    def configure(self, enabled: bool = True, service: str = "",
+                  dir: str = "") -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:  # pragma: no cover - close never critical
+                    pass
+                self._fh = None
+            self.enabled = bool(enabled)
+            self.service = service or self.service or "proc"
+            self.dir = dir
+            self._path = ""
+            if enabled and dir:
+                try:
+                    os.makedirs(dir, exist_ok=True)
+                except OSError as exc:
+                    # an uncreatable sink dir (remote learner with the
+                    # driver's local path, read-only mount) must degrade
+                    # to unpersisted spans, not kill the process
+                    import logging
+                    logging.getLogger("metisfl_tpu.telemetry").warning(
+                        "trace sink dir %r not creatable (%s); spans "
+                        "will not be persisted", dir, exc)
+                    return
+                self._path = os.path.join(
+                    dir, f"{self.service}-{os.getpid()}.jsonl")
+
+    def _record(self, span: Span) -> None:
+        if not self._path:
+            return
+        record = {
+            "trace": span.trace_id,
+            "span": span.span_id,
+            "parent": span.parent_id,
+            "name": span.name,
+            "service": self.service,
+            "pid": os.getpid(),
+            "start": round(span.start, 6),
+            "dur_ms": round(span._duration_ms or 0.0, 3),
+        }
+        if span.attrs:
+            record["attrs"] = span.attrs
+        line = json.dumps(record, default=str) + "\n"
+        with self._lock:
+            try:
+                if self._fh is None:
+                    if not self._path:
+                        return
+                    self._fh = open(self._path, "a", buffering=1)
+                self._fh.write(line)
+            except OSError:
+                # a torn sink (deleted dir, full disk) must never take a
+                # traced code path down with it — stop persisting
+                self._path = ""
+                self._fh = None
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+
+_TRACER = _Tracer()
+
+
+def configure(enabled: bool = True, service: str = "", dir: str = "") -> None:
+    """(Re)configure the process tracer. ``dir=""`` keeps spans in-memory
+    only (ids and durations still work — instrumentation that feeds
+    RoundMetadata does not need a sink)."""
+    _TRACER.configure(enabled=enabled, service=service, dir=dir)
+
+
+def set_enabled(value: bool) -> None:
+    """Flip tracing on/off while keeping the configured service + sink
+    dir (a disabled tracer remembers where it was writing)."""
+    _TRACER.configure(enabled=value, service=_TRACER.service,
+                      dir=_TRACER.dir)
+
+
+def flush() -> None:
+    _TRACER.flush()
+
+
+def trace_path() -> str:
+    """The JSONL file this process appends spans to ('' = no sink)."""
+    return _TRACER._path
+
+
+def span(name: str, parent: Any = _USE_CURRENT,
+         attrs: Optional[Dict[str, Any]] = None):
+    """Open a span. ``parent``: omitted → the calling context's active
+    span; ``None`` → a new root trace; a :class:`Span` or
+    :class:`SpanContext` → explicit parent (the cross-thread form)."""
+    if not _TRACER.enabled:
+        return _NullSpan()
+    if parent is _USE_CURRENT:
+        parent = _CURRENT.get()
+    elif isinstance(parent, (Span, _NullSpan)):
+        parent = parent.context()
+    return Span(_TRACER, name, parent, attrs)
+
+
+def event(name: str, duration_s: float, parent: Any = _USE_CURRENT,
+          attrs: Optional[Dict[str, Any]] = None) -> None:
+    """Record an already-measured interval as a completed span (for call
+    sites that timed themselves, e.g. the codec hot path)."""
+    if not _TRACER.enabled:
+        return
+    if parent is _USE_CURRENT:
+        parent = _CURRENT.get()
+    elif isinstance(parent, (Span, _NullSpan)):
+        parent = parent.context()
+    sp = Span(_TRACER, name, parent, attrs)
+    sp.start = time.time() - duration_s
+    sp._duration_ms = duration_s * 1e3
+    _TRACER._record(sp)
+
+
+def current_context() -> Optional[SpanContext]:
+    if not _TRACER.enabled:
+        return None
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[SpanContext]):
+    """Activate an explicit (e.g. wire-extracted) context."""
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+
+
+def outbound_metadata() -> Optional[Tuple[Tuple[str, str], ...]]:
+    """gRPC metadata carrying the active span context (None when there is
+    nothing to propagate — grpc treats ``metadata=None`` as absent)."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    return ((METADATA_KEY, ctx.to_wire()),)
+
+
+def extract(metadata: Optional[Iterable]) -> Optional[SpanContext]:
+    """Span context from gRPC invocation metadata (None when absent)."""
+    if not metadata:
+        return None
+    for item in metadata:
+        key = getattr(item, "key", None) or (item[0] if item else None)
+        if key == METADATA_KEY:
+            value = getattr(item, "value", None) or item[1]
+            return SpanContext.from_wire(str(value))
+    return None

@@ -192,6 +192,85 @@ def test_chunked_handler_error_propagates(echo_server, monkeypatch):
     client.close()
 
 
+# ---------------------------------------------------------------------- #
+# RPC telemetry (metisfl_tpu/telemetry): logical-call accounting
+# ---------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def rpc_metrics():
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.telemetry import metrics as tmetrics
+
+    tmetrics.set_enabled(True)
+    telemetry.registry().reset()
+    yield telemetry.registry()
+    telemetry.registry().reset()
+
+
+def test_oversize_retry_counts_one_logical_call(echo_server, monkeypatch,
+                                                rpc_metrics):
+    """Regression contract: the documented fail-then-retry path (unary
+    oversize → chunked retry, see _OVERSIZE_MARK) reports ONE logical
+    client call with retried="1" — not two — while the server-side
+    handler-invocation counter visibly shows both executions."""
+    from metisfl_tpu.comm import rpc
+
+    monkeypatch.setattr(rpc, "UNARY_RESPONSE_LIMIT", 100)
+    monkeypatch.setattr(rpc, "CHUNK_BYTES", 64)
+    port, state = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    payload = b"\xab" * 1000  # small request, >limit response
+    assert client.call("Echo", payload) == payload
+    calls = rpc_metrics.counter("rpc_client_calls_total", "",
+                                ("service", "method", "retried"))
+    assert calls.value(service="test.Echo", method="Echo", retried="1") == 1
+    assert calls.value(service="test.Echo", method="Echo", retried="0") == 0
+    invocations = rpc_metrics.counter("rpc_server_calls_total", "",
+                                      ("service", "method", "transport"))
+    assert invocations.value(service="test.Echo", method="Echo",
+                             transport="unary") == 1
+    assert invocations.value(service="test.Echo", method="Echo",
+                             transport="chunked") == 1
+    # the remembered-chunked second call is one more logical call, now
+    # without a retry and with exactly one more handler invocation
+    assert client.call("Echo", payload) == payload
+    assert calls.value(service="test.Echo", method="Echo", retried="0") == 1
+    assert invocations.value(service="test.Echo", method="Echo",
+                             transport="chunked") == 2
+    client.close()
+
+
+def test_async_error_without_callback_is_counted_and_logged(
+        echo_server, rpc_metrics, caplog):
+    """call_async with no error_callback must not swallow the failure:
+    warning log + rpc_client_errors_total increment."""
+    import logging as _logging
+
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    errors = rpc_metrics.counter("rpc_client_errors_total", "",
+                                 ("service", "method", "code"))
+    with caplog.at_level(_logging.WARNING, logger="metisfl_tpu.rpc"):
+        future = client.call_async("Boom", b"")
+        deadline = threading.Event()
+        for _ in range(100):
+            if errors.value(service="test.Echo", method="Boom",
+                            code="INTERNAL") >= 1:
+                break
+            deadline.wait(0.1)
+    assert errors.value(service="test.Echo", method="Boom",
+                        code="INTERNAL") == 1
+    # the failed call still counts as one logical call, keeping
+    # errors_total/calls_total a valid rate (<= 1)
+    calls = rpc_metrics.counter("rpc_client_calls_total", "",
+                                ("service", "method", "retried"))
+    assert calls.value(service="test.Echo", method="Boom", retried="0") == 1
+    assert any("no error_callback" in r.getMessage()
+               for r in caplog.records)
+    client.close()
+
+
 def _available_ram_gb() -> float:
     try:
         with open("/proc/meminfo") as f:

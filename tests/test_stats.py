@@ -43,6 +43,35 @@ def test_summarize_rounds_and_metrics():
     assert "aggregation median 50.0ms" in text
 
 
+def test_pre_telemetry_payload_renders_without_phase_columns():
+    """Backward compatibility: experiment.json written before the
+    telemetry PR (no dispatch/wait phase fields) must render exactly the
+    classic table — no disp/wait columns appear."""
+    text = summarize(_stats())
+    assert "disp" not in text and "wait" not in text
+    header = [l for l in text.splitlines() if "round" in l and "wall" in l][0]
+    assert header.split() == ["round", "wall", "cohort", "agg", "params",
+                              "uplink", "errors"]
+
+
+def test_phase_breakdown_columns_when_present():
+    """Telemetry-era payloads grow a dispatch/wait breakdown in the
+    per-round table (span-sourced phase timings)."""
+    stats = _stats()
+    stats["round_metadata"][0]["dispatch_duration_ms"] = 12.5
+    stats["round_metadata"][0]["wait_duration_ms"] = 900.0
+    # round 2 predates/lacks the fields (mixed lineage after a resume):
+    # renders as zeros rather than crashing
+    text = summarize(stats)
+    header = [l for l in text.splitlines() if "round" in l and "wall" in l][0]
+    assert header.split() == ["round", "wall", "disp", "wait", "cohort",
+                              "agg", "params", "uplink", "errors"]
+    row1 = [l for l in text.splitlines() if l.lstrip().startswith("1 ")][0]
+    assert "12.5ms" in row1 and "900.0ms" in row1
+    row2 = [l for l in text.splitlines() if l.lstrip().startswith("2 ")][0]
+    assert "0.0ms" in row2
+
+
 def test_cli_reads_experiment_json(tmp_path):
     path = tmp_path / "experiment.json"
     path.write_text(json.dumps(_stats()))

@@ -1,0 +1,396 @@
+"""Federation-wide telemetry: metrics registry, trace spans, scrape RPCs.
+
+Tier-1 smoke coverage for metisfl_tpu/telemetry: exposition format round
+trips, span trees survive the JSONL sink + CLI renderer, and a 2-round
+in-process CPU federation over REAL gRPC produces (a) parseable
+Prometheus expositions from controller and learner with RPC, round-phase
+and uplink-bytes series, and (b) one stitched trace in which the
+controller round span is an ancestor of the learner train spans.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu import telemetry
+from metisfl_tpu.telemetry import metrics as tmetrics
+from metisfl_tpu.telemetry import trace as ttrace
+from metisfl_tpu.telemetry.metrics import parse_exposition
+
+
+@pytest.fixture()
+def telem(tmp_path):
+    """Clean telemetry state with a JSONL sink under tmp_path."""
+    tmetrics.set_enabled(True)
+    telemetry.registry().reset()
+    ttrace.configure(enabled=True, service="test", dir=str(tmp_path))
+    yield tmp_path
+    ttrace.flush()
+    ttrace.configure(enabled=True, service="test", dir="")
+    tmetrics.set_enabled(True)
+
+
+def _trace_file(tmp_path):
+    files = [os.path.join(tmp_path, f) for f in os.listdir(tmp_path)
+             if f.endswith(".jsonl")]
+    assert files, "no trace sink file written"
+    return files[0]
+
+
+def _spans(tmp_path):
+    ttrace.flush()
+    out = []
+    for line in open(_trace_file(tmp_path)):
+        if line.strip():
+            out.append(json.loads(line))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# metrics registry + exposition
+# --------------------------------------------------------------------- #
+
+
+def test_exposition_renders_and_parses(telem):
+    reg = telemetry.registry()
+    c = reg.counter("t_requests_total", "test requests", ("method",))
+    g = reg.gauge("t_queue_depth", "queued items")
+    h = reg.histogram("t_latency_seconds", "latency",
+                      buckets=(0.1, 1.0, 10.0))
+    c.inc(method="a")
+    c.inc(2, method='we"ird\\label')
+    g.set(7)
+    h.observe(0.05)
+    h.observe(3.0)
+
+    text = reg.render()
+    assert "# TYPE t_requests_total counter" in text
+    assert "# TYPE t_latency_seconds histogram" in text
+    parsed = parse_exposition(text)
+    assert parsed["t_requests_total"][(("method", "a"),)] == 1
+    assert parsed["t_requests_total"][(("method", 'we"ird\\label'),)] == 2
+    assert parsed["t_queue_depth"][()] == 7
+    assert parsed["t_latency_seconds_count"][()] == 2
+    assert parsed["t_latency_seconds_sum"][()] == pytest.approx(3.05)
+    # cumulative buckets: 0.05 lands in every bucket, 3.0 only in le=10
+    assert parsed["t_latency_seconds_bucket"][(("le", "0.1"),)] == 1
+    assert parsed["t_latency_seconds_bucket"][(("le", "10"),)] == 2
+    assert parsed["t_latency_seconds_bucket"][(("le", "+Inf"),)] == 2
+
+
+def test_exposition_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_exposition("this is not { an exposition")
+
+
+def test_registry_idempotent_and_type_checked(telem):
+    reg = telemetry.registry()
+    a = reg.counter("t_twice_total", "x", ("l",))
+    assert reg.counter("t_twice_total", "x", ("l",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_twice_total", "x", ("l",))
+
+
+def test_disabled_metrics_are_noop(telem):
+    reg = telemetry.registry()
+    c = reg.counter("t_off_total", "x")
+    tmetrics.set_enabled(False)
+    try:
+        c.inc()
+        assert c.value() == 0
+    finally:
+        tmetrics.set_enabled(True)
+    c.inc()
+    assert c.value() == 1
+
+
+# --------------------------------------------------------------------- #
+# trace spans: sink round trip + CLI renderer
+# --------------------------------------------------------------------- #
+
+
+def test_span_tree_roundtrips_sink_and_cli(telem, capsys):
+    root = ttrace.span("round", parent=None, attrs={"round": 3})
+    with root.activate():
+        with ttrace.span("round.dispatch"):
+            time.sleep(0.01)
+        child = ttrace.span("learner.train", attrs={"learner": "L0"})
+        with child.activate():
+            with ttrace.span("learner.train_steps"):
+                pass
+        child.end()
+    root.end()
+
+    spans = _spans(telem)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["round"]["parent"] == ""
+    assert by_name["round.dispatch"]["parent"] == by_name["round"]["span"]
+    assert by_name["learner.train"]["parent"] == by_name["round"]["span"]
+    assert (by_name["learner.train_steps"]["parent"]
+            == by_name["learner.train"]["span"])
+    assert len({s["trace"] for s in spans}) == 1
+    assert by_name["round"]["dur_ms"] >= 10.0
+
+    from metisfl_tpu.telemetry.__main__ import main as tel_main
+    assert tel_main([str(telem)]) == 0
+    out = capsys.readouterr().out
+    assert "round" in out and "learner.train" in out
+    # children render WITH tree connectors — the last child of the root
+    # must not masquerade as a second root (regression: connector logic)
+    assert "└─ learner.train " in out
+    assert "   └─ learner.train_steps " in out
+    # the round filter CLI path works too
+    assert tel_main([str(telem), "--round", "3"]) == 0
+    assert tel_main([str(telem), "--round", "99"]) == 1
+
+
+def test_disabled_tracer_hands_out_null_spans(telem):
+    ttrace.configure(enabled=False)
+    try:
+        sp = ttrace.span("x", parent=None)
+        with sp, sp.activate():
+            assert ttrace.current_context() is None
+            time.sleep(0.01)
+        # no identity, nothing sinks — but the duration is REAL: lineage
+        # fields (RoundMetadata timings) read span durations and must
+        # survive the telemetry opt-out
+        assert sp.trace_id == "" and sp.span_id == ""
+        assert sp.end() >= 10.0
+        assert sp.duration_ms == sp.end()  # frozen after end
+    finally:
+        ttrace.configure(enabled=True, service="test", dir=str(telem))
+    assert not [f for f in os.listdir(telem) if f.endswith(".jsonl")]
+
+
+def test_trace_context_propagates_over_grpc_metadata(telem):
+    from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
+
+    seen = []
+
+    def echo(payload: bytes) -> bytes:
+        seen.append(ttrace.current_context())
+        return payload
+
+    server = RpcServer("127.0.0.1", 0)
+    server.add_service(BytesService("test.Trace", {"Echo": echo}))
+    port = server.start()
+    client = RpcClient("127.0.0.1", port, "test.Trace")
+    try:
+        with ttrace.span("outer", parent=None) as sp:
+            with sp.activate():
+                client.call("Echo", b"x")
+        assert seen and seen[0] is not None
+        assert seen[0].trace_id == sp.trace_id
+        # the server wraps the handler in its own child span whose parent
+        # is the propagated context
+        spans = _spans(telem)
+        rpc_span = [s for s in spans if s["name"] == "rpc.server/Echo"][0]
+        assert rpc_span["trace"] == sp.trace_id
+        assert rpc_span["parent"] == sp.span_id
+    finally:
+        client.close()
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# the 2-round federation smoke test (acceptance criteria)
+# --------------------------------------------------------------------- #
+
+
+def _federation_pieces():
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TerminationConfig)
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=2))
+    rng = np.random.default_rng(3)
+    shards, template = [], None
+    engines = []
+    for i in range(2):
+        x = rng.standard_normal((24, 4)).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int32)
+        ds = ArrayDataset(x, y, seed=i)
+        engine = FlaxModelOps(MLP(features=(8,), num_outputs=2), x[:2])
+        if template is None:
+            template = engine.get_variables()
+        else:
+            engine.set_variables(template)
+        shards.append(ds)
+        engines.append(engine)
+    return config, engines, shards, template
+
+
+def test_grpc_federation_two_rounds_metrics_and_trace(telem):
+    """Acceptance: scrape GetMetrics from controller AND learner, parse
+    the exposition, find RPC / round-phase / uplink series; and the JSONL
+    sink holds one stitched trace per round with the controller round
+    span an ancestor of learner train spans."""
+    from metisfl_tpu.comm.rpc import RpcClient
+    from metisfl_tpu.controller.core import Controller
+    from metisfl_tpu.controller.service import (LEARNER_SERVICE,
+                                                ControllerClient,
+                                                ControllerServer,
+                                                RpcLearnerProxy)
+    from metisfl_tpu.learner.learner import Learner
+    from metisfl_tpu.learner.service import LearnerServer
+    from metisfl_tpu.tensor.pytree import pack_model
+
+    config, engines, shards, template = _federation_pieces()
+    controller = Controller(config, lambda record: RpcLearnerProxy(record))
+    ctrl_server = ControllerServer(controller, host="127.0.0.1", port=0)
+    ctrl_port = ctrl_server.start()
+    controller.set_community_model(pack_model(template))
+
+    learner_servers, learners, clients = [], [], []
+    try:
+        for engine, shard in zip(engines, shards):
+            ctrl_client = ControllerClient("127.0.0.1", ctrl_port)
+            ctrl_client._client.retries = 2
+            ctrl_client._client.retry_sleep_s = 0.2
+            clients.append(ctrl_client)
+            learner = Learner(model_ops=engine, train_dataset=shard,
+                              controller=ctrl_client,
+                              hostname="127.0.0.1")
+            lserver = LearnerServer(learner, host="127.0.0.1", port=0)
+            lserver.start()
+            learners.append(learner)
+            learner_servers.append(lserver)
+        for learner in learners:
+            learner.join_federation()
+
+        deadline = time.time() + 120
+        while (controller.global_iteration < 2
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert controller.global_iteration >= 2, "federation stalled"
+
+        # -- (a) scrape both processes' surfaces ----------------------- #
+        scrape_client = ControllerClient("127.0.0.1", ctrl_port)
+        clients.append(scrape_client)
+        ctrl_text = scrape_client.get_metrics()
+        learner_scrape = RpcClient("127.0.0.1", learner_servers[0].port,
+                                   LEARNER_SERVICE)
+        learner_text = learner_scrape.call(
+            "GetMetrics", b"", timeout=10).decode("utf-8")
+        learner_scrape.close()
+
+        for text in (ctrl_text, learner_text):
+            parsed = parse_exposition(text)  # must parse cleanly
+            assert parsed["round_duration_seconds_count"][()] >= 2
+            assert any(k.startswith("rpc_server_latency_seconds")
+                       for k in parsed)
+            uplinks = parsed["uplink_bytes_total"]
+            assert sum(uplinks.values()) > 0
+            # round-phase breakdown series
+            phases = {labels[0][1] for labels in
+                      parsed["round_phase_duration_seconds_count"]}
+            assert {"dispatch", "wait_uplinks", "aggregate"} <= phases
+
+        # lineage carries the same phase timings (stats.py satellite)
+        meta = controller.get_runtime_metadata()[0]
+        assert meta["dispatch_duration_ms"] > 0
+        assert meta["wait_duration_ms"] > 0
+        assert meta["aggregation_duration_ms"] > 0
+        assert len(meta["aggregation_block_duration_ms"]) >= 1
+    finally:
+        # learners first: an in-flight train thread reporting its result
+        # must find the controller alive (its client would otherwise park
+        # on wait_for_ready against a dead channel)
+        for lserver in learner_servers:
+            lserver.stop(leave=False)
+        ctrl_server.stop()
+        for client in clients:
+            client.close()
+
+    # -- (b) stitched trace through the JSONL sink + CLI ---------------- #
+    spans = _spans(telem)
+    by_id = {s["span"]: s for s in spans}
+    train_spans = [s for s in spans if s["name"] == "learner.train"]
+    assert train_spans, "no learner.train spans recorded"
+    stitched = 0
+    for ts in train_spans:
+        node, hops = ts, 0
+        while node.get("parent") and node["parent"] in by_id and hops < 10:
+            node = by_id[node["parent"]]
+            hops += 1
+        if node["name"] == "round":
+            stitched += 1
+            assert node["trace"] == ts["trace"]
+    assert stitched, "no learner.train span stitched under a round span"
+
+    from metisfl_tpu.telemetry.__main__ import main as tel_main
+    assert tel_main([str(telem)]) == 0
+
+
+def test_telemetry_cli_usage_errors(capsys):
+    from metisfl_tpu.telemetry.__main__ import main as tel_main
+
+    assert tel_main([]) == 2
+    assert tel_main(["--round"]) == 2
+
+
+def test_inprocess_federation_honors_optout(tmp_path):
+    """telemetry.enabled=false: no sink files, metric instruments no-op
+    (the bench-overhead acceptance's functional half)."""
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    FederationConfig, TelemetryConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=1, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        telemetry=TelemetryConfig(enabled=False, dir=str(tmp_path / "t")),
+        termination=TerminationConfig(federation_rounds=1))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    fed = InProcessFederation(config)
+    engine = FlaxModelOps(MLP(features=(8,), num_outputs=2), x[:2])
+    fed.add_learner(engine, ArrayDataset(x, y, seed=0))
+    fed.seed_model(engine.get_variables())
+    try:
+        telemetry.registry().reset()
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=60)
+        assert telemetry.registry().render() == ""
+        assert not (tmp_path / "t").exists() or not os.listdir(
+            tmp_path / "t")
+        # lineage timings pre-date telemetry and must survive the opt-out
+        # (null spans still measure)
+        meta = fed.controller.get_runtime_metadata()[0]
+        assert meta["aggregation_duration_ms"] > 0
+        assert all(d > 0 for d in meta["aggregation_block_duration_ms"])
+        assert meta["dispatch_duration_ms"] > 0
+        # the opt-out must not stick: a later default-enabled federation
+        # in the same process re-enables metrics and tracing, and a
+        # host-configured sink dir survives the disabled interlude
+        host_dir = str(tmp_path / "host_sink")
+        ttrace.configure(enabled=False, service="test", dir=host_dir)
+        fed2 = InProcessFederation(dataclasses.replace(
+            config, telemetry=TelemetryConfig()))
+        try:
+            assert tmetrics.enabled()
+            assert ttrace.span("probe", parent=None).trace_id
+            assert ttrace.trace_path().startswith(host_dir)
+        finally:
+            fed2.shutdown()
+    finally:
+        fed.shutdown()
+        tmetrics.set_enabled(True)
+        ttrace.configure(enabled=True, service="test", dir="")
