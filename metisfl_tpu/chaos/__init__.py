@@ -1,0 +1,44 @@
+"""Deterministic fault injection for the federation RPC stack.
+
+Jepsen-style chaos as a first-class subsystem: seeded, reproducible fault
+profiles (drop → UNAVAILABLE, delay, hang, payload corruption,
+process-kill-at-phase) hooked into :mod:`metisfl_tpu.comm.rpc` on both the
+client and server side of every bytes method. The recovery machinery this
+exercises — straggler deadlines, learner rejoin, controller failover —
+is only trustworthy if the faults that trigger it are reproducible, so
+every injector runs off one seeded RNG and a fixed rule list.
+
+Activation:
+
+- env var ``METISFL_TPU_CHAOS`` holding a JSON spec (or ``@/path`` to a
+  JSON file) — read once at process start, which is how the driver arms
+  chaos in controller/learner subprocesses;
+- in-process via :func:`configure` (tests);
+- federation config ``chaos`` section (config/federation.py ChaosConfig)
+  — the driver filters rules per process and exports the env var.
+
+Zero overhead when off: :func:`get` returns ``None`` and the rpc call
+sites do one module-attribute read plus an ``is None`` check.
+"""
+
+from metisfl_tpu.chaos.injector import (
+    ENV_VAR,
+    ChaosInjector,
+    FaultInjected,
+    FaultRule,
+    configure,
+    get,
+    install_from_env,
+    reset,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "ChaosInjector",
+    "FaultInjected",
+    "FaultRule",
+    "configure",
+    "get",
+    "install_from_env",
+    "reset",
+]

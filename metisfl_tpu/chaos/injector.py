@@ -1,0 +1,218 @@
+"""Seeded fault injector wired into the RPC transport.
+
+One :class:`ChaosInjector` per process, installed via :func:`configure`
+(tests / in-process) or the ``METISFL_TPU_CHAOS`` env var (subprocesses —
+the driver exports per-process specs). ``comm/rpc.py`` calls
+:func:`get` on every client call and server handler invocation; with no
+injector installed that is one attribute read and an ``is None`` check.
+
+A spec is plain JSON::
+
+    {"seed": 7, "rules": [
+        {"fault": "kill", "side": "server", "method": "MarkTaskCompleted",
+         "max_fires": 1},
+        {"fault": "drop", "side": "client", "prob": 0.2},
+        {"fault": "corrupt", "side": "client", "method": "MarkTaskCompleted",
+         "after_calls": 2, "max_fires": 1}
+    ]}
+
+Faults:
+
+- ``drop``     — raise UNAVAILABLE without touching the wire (exercises
+  the client retry ladder / dispatch-failure liveness accounting).
+- ``delay``    — sleep ``delay_s`` then proceed.
+- ``hang``     — sleep ``delay_s`` (default 3600 s) then proceed: with the
+  transport's default deadline this surfaces as DEADLINE_EXCEEDED.
+- ``corrupt``  — flip a run of payload bytes (the integrity framing on
+  model blobs must reject the result, not deserialize garbage weights).
+- ``kill``     — ``os._exit(137)``: the crash-at-phase primitive (e.g.
+  kill the controller the first time a completion arrives = mid-round).
+
+Counting (``after_calls`` skip window, ``max_fires`` budget) is exact and
+deterministic; ``prob`` draws come from the one seeded RNG, so a fixed
+seed and call sequence replays the identical fault schedule.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from metisfl_tpu.telemetry import metrics as _tmetrics
+
+logger = logging.getLogger("metisfl_tpu.chaos")
+
+ENV_VAR = "METISFL_TPU_CHAOS"
+
+_M_FAULTS = _tmetrics.registry().counter(
+    "chaos_faults_injected_total", "Faults fired by the chaos injector",
+    ("fault", "side", "method"))
+
+_KILL_EXIT_CODE = 137  # looks like SIGKILL to the supervising driver
+
+
+class FaultInjected(Exception):
+    """An injected transport fault. Shaped like a grpc.RpcError (``code()``
+    / ``details()``) so the client retry loop and the server abort path
+    handle it exactly like a real wire error."""
+
+    def __init__(self, status: str, rule: "FaultRule"):
+        super().__init__(f"chaos: injected {rule.fault} ({status})")
+        self.status = status
+        self.rule = rule
+
+    def code(self):
+        import grpc
+
+        return grpc.StatusCode[self.status]
+
+    def details(self) -> str:
+        return str(self)
+
+
+@dataclass
+class FaultRule:
+    """One fault site. Empty ``side``/``service``/``method`` match any;
+    ``process`` is driver-side routing only (which subprocess gets the
+    rule) and is ignored by the injector itself."""
+
+    fault: str                    # drop | delay | hang | corrupt | kill
+    side: str = ""                # client | server | "" (both)
+    service: str = ""
+    method: str = ""
+    process: str = ""             # controller | learner | learner_<idx>
+    prob: float = 1.0             # firing probability per eligible call
+    after_calls: int = 0          # skip the first N matching calls
+    max_fires: int = 0            # 0 = unlimited
+    delay_s: float = 0.0          # delay/hang duration (hang: 0 → 3600)
+    # runtime counters (not part of the spec)
+    matched: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    _FAULTS = ("drop", "delay", "hang", "corrupt", "kill")
+
+    def __post_init__(self):
+        if self.fault not in self._FAULTS:
+            raise ValueError(
+                f"unknown chaos fault {self.fault!r}; have {self._FAULTS}")
+
+    def matches(self, side: str, service: str, method: str) -> bool:
+        return ((not self.side or self.side == side)
+                and (not self.service or self.service == service)
+                and (not self.method or self.method == method))
+
+
+class ChaosInjector:
+    def __init__(self, seed: int = 0, rules: Optional[List[FaultRule]] = None):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = list(rules or [])
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: Dict) -> "ChaosInjector":
+        known = {f for f in FaultRule.__dataclass_fields__
+                 if f not in ("matched", "fired")}
+        rules = []
+        for raw in spec.get("rules", []):
+            unknown = set(raw) - known
+            if unknown:
+                raise ValueError(f"chaos rule has unknown keys {sorted(unknown)}")
+            rules.append(FaultRule(**raw))
+        return cls(seed=spec.get("seed", 0), rules=rules)
+
+    def intercept(self, side: str, service: str, method: str,
+                  payload: bytes) -> bytes:
+        """Run every matching rule against this call; returns the (possibly
+        corrupted) payload, raises :class:`FaultInjected` on drop, sleeps
+        on delay/hang, exits the process on kill."""
+        for rule in self.rules:
+            with self._lock:
+                if not rule.matches(side, service, method):
+                    continue
+                rule.matched += 1
+                if rule.matched <= rule.after_calls:
+                    continue
+                if rule.max_fires and rule.fired >= rule.max_fires:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+            _M_FAULTS.inc(fault=rule.fault, side=side, method=method)
+            logger.warning("chaos: firing %s on %s %s/%s (fire %d)",
+                           rule.fault, side, service, method, rule.fired)
+            if rule.fault == "kill":
+                # flush the warning before dying — the whole point is a
+                # diagnosable crash
+                logging.shutdown()
+                os._exit(_KILL_EXIT_CODE)
+            if rule.fault == "drop":
+                raise FaultInjected("UNAVAILABLE", rule)
+            if rule.fault == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.fault == "hang":
+                time.sleep(rule.delay_s or 3600.0)
+            elif rule.fault == "corrupt":
+                payload = self._corrupt(payload)
+        return payload
+
+    @staticmethod
+    def _corrupt(payload: bytes) -> bytes:
+        if not payload:
+            return payload
+        # deterministic mid-payload byte flips: past any magic/header so
+        # the corruption lands in tensor data and only a checksum (not a
+        # structural parse error) can catch it
+        start = len(payload) // 2
+        buf = bytearray(payload)
+        for i in range(start, min(start + 8, len(buf))):
+            buf[i] ^= 0xFF
+        return bytes(buf)
+
+    def fired_total(self, fault: str = "") -> int:
+        with self._lock:
+            return sum(r.fired for r in self.rules
+                       if not fault or r.fault == fault)
+
+
+_INJECTOR: Optional[ChaosInjector] = None
+
+
+def get() -> Optional[ChaosInjector]:
+    return _INJECTOR
+
+
+def configure(spec: Optional[Dict]) -> Optional[ChaosInjector]:
+    """Install an injector from a spec dict (None uninstalls)."""
+    global _INJECTOR
+    _INJECTOR = None if spec is None else ChaosInjector.from_spec(spec)
+    if _INJECTOR is not None:
+        logger.warning("chaos injector ARMED (seed=%d, %d rule(s))",
+                       _INJECTOR.seed, len(_INJECTOR.rules))
+    return _INJECTOR
+
+
+def reset() -> None:
+    configure(None)
+
+
+def install_from_env() -> Optional[ChaosInjector]:
+    """Arm from ``METISFL_TPU_CHAOS`` (JSON, or ``@/path`` to a JSON file).
+    Called once at import by the transport — subprocess activation needs no
+    code path of its own."""
+    raw = os.environ.get(ENV_VAR, "")
+    if not raw:
+        return None
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    return configure(json.loads(raw))
+
+
+install_from_env()

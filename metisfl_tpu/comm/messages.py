@@ -199,6 +199,11 @@ class JoinReply(Message):
     learner_id: str = ""
     auth_token: str = ""
     rejoined: bool = False
+    # Controller incarnation id: a fresh uuid per controller process. A
+    # learner that observes a DIFFERENT epoch in a later task envelope
+    # knows the controller crashed and restarted, and re-attaches
+    # (re-runs join_federation) instead of trusting stale registration.
+    controller_epoch: str = ""
 
 
 @dataclass
@@ -215,6 +220,10 @@ class TrainTask(Message):
     # server variate c as a ModelBlob (empty = zeros).
     scaffold: bool = False
     control: bytes = b""
+    # controller incarnation id (see JoinReply.controller_epoch): a
+    # mismatch against the epoch the learner joined under triggers
+    # learner-side re-attach before the task runs
+    controller_epoch: str = ""
 
 
 @dataclass
@@ -256,6 +265,8 @@ class EvalTask(Message):
     # carry ONLY the federated subset; a never-trained learner must know
     # to backfill the frozen base from its own initial values
     ship_tensor_regex: str = ""
+    # controller incarnation id (see JoinReply.controller_epoch)
+    controller_epoch: str = ""
 
 
 @dataclass

@@ -28,10 +28,19 @@ from typing import Callable, Dict, Optional
 
 import grpc
 
+from metisfl_tpu import chaos as _chaos
 from metisfl_tpu.telemetry import metrics as _metrics
 from metisfl_tpu.telemetry import trace as _trace
 
 logger = logging.getLogger("metisfl_tpu.rpc")
+
+# Default per-call deadline when the caller passes timeout=None. An
+# unbounded RPC means one hung peer can park a dispatch thread forever
+# (SURVEY.md §5.3 is full of exactly that failure); every call gets a
+# bound unless the caller explicitly opts out (timeout <= 0 via
+# CommConfig.default_deadline_s <= 0). Sized for cold-jit learners and
+# multi-GB chunked model transfers, not for acks.
+DEFAULT_DEADLINE_S = 120.0
 
 # Per-method RPC metrics (telemetry registry; families are idempotent so
 # module reload is safe). Client counters are LOGICAL: one sample per
@@ -135,8 +144,20 @@ class BytesService:
     @staticmethod
     def _abort(context: grpc.ServicerContext, exc: Exception):
         code = getattr(exc, "code", None)
+        if callable(code):  # RpcError-shaped (incl. chaos FaultInjected)
+            try:
+                code = code()
+            except Exception:  # noqa: BLE001 - fall through to INTERNAL
+                code = None
         if isinstance(code, grpc.StatusCode):
             context.abort(code, str(exc))
+        if isinstance(exc, ValueError):
+            # malformed input (codec framing, blob integrity/checksum) is
+            # the caller's defect, not a server bug: reject it as
+            # INVALID_ARGUMENT so clients/retry ladders never treat a
+            # corrupt payload as a transient server failure
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"{type(exc).__name__}: {exc}")
         logger.exception("RPC handler failed")
         context.abort(grpc.StatusCode.INTERNAL,
                       f"{type(exc).__name__}: {exc}")
@@ -157,6 +178,10 @@ class BytesService:
             try:
                 with sp, sp.activate():
                     try:
+                        inj = _chaos.get()
+                        if inj is not None:
+                            request = inj.intercept("server", service,
+                                                    method, request)
                         result = fn(request)
                     except Exception as exc:
                         _M_SERVER_ERRORS.inc(service=service, method=method)
@@ -200,6 +225,10 @@ class BytesService:
                     attrs={"service": service, "transport": "chunked"})
                 with sp, sp.activate():
                     try:
+                        inj = _chaos.get()
+                        if inj is not None:
+                            request = inj.intercept("server", service,
+                                                    method, request)
                         result = fn(request)
                     except Exception as exc:
                         _M_SERVER_ERRORS.inc(service=service, method=method)
@@ -258,14 +287,25 @@ class RpcServer:
 
 
 class RpcClient:
-    """Channel to a :class:`BytesService` with retry/backoff on UNAVAILABLE."""
+    """Channel to a :class:`BytesService` with retry/backoff on UNAVAILABLE.
+
+    ``default_deadline_s``: deadline applied when a call passes
+    ``timeout=None`` (config ``comm.default_deadline_s``). ``None`` →
+    :data:`DEFAULT_DEADLINE_S`; ``<= 0`` → explicitly unbounded (the old
+    behavior, for operators who really want it).
+    """
 
     def __init__(self, host: str, port: int, service_name: str,
-                 retries: int = 10, retry_sleep_s: float = 1.0, ssl=None):
+                 retries: int = 10, retry_sleep_s: float = 1.0, ssl=None,
+                 default_deadline_s: Optional[float] = None):
         self.target = f"{host}:{port}"
         self.service_name = service_name
         self.retries = retries
         self.retry_sleep_s = retry_sleep_s
+        if default_deadline_s is None:
+            default_deadline_s = DEFAULT_DEADLINE_S
+        self.default_deadline_s = (default_deadline_s
+                                   if default_deadline_s > 0 else None)
         if ssl is not None and ssl.enabled:
             from metisfl_tpu.comm.ssl import channel_credentials
             self._channel = grpc.secure_channel(
@@ -281,7 +321,12 @@ class RpcClient:
         self._chunked_methods: set = set()
 
     def call(self, method: str, payload: bytes, timeout: Optional[float] = None,
-             wait_ready: bool = True) -> bytes:
+             wait_ready: bool = True, idempotent: bool = False) -> bytes:
+        """``idempotent=True`` additionally retries DEADLINE_EXCEEDED —
+        only safe for methods whose re-execution cannot double-apply
+        (getters, join/rejoin, health)."""
+        if timeout is None:
+            timeout = self.default_deadline_s
         chunked = (len(payload) > STREAM_THRESHOLD
                    or method in self._chunked_methods)
         attempt = 0
@@ -290,8 +335,11 @@ class RpcClient:
         try:
             while True:
                 try:
+                    inj = _chaos.get()
+                    send = (payload if inj is None else inj.intercept(
+                        "client", self.service_name, method, payload))
                     if chunked:
-                        result = self._call_chunked(method, payload, timeout,
+                        result = self._call_chunked(method, send, timeout,
                                                     wait_ready)
                     else:
                         fn = self._channel.unary_unary(
@@ -299,7 +347,7 @@ class RpcClient:
                             request_serializer=_IDENTITY,
                             response_deserializer=_IDENTITY,
                         )
-                        result = fn(payload, timeout=timeout,
+                        result = fn(send, timeout=timeout,
                                     wait_for_ready=wait_ready,
                                     metadata=_trace.outbound_metadata())
                     _M_CLIENT_BYTES.inc(len(payload),
@@ -309,7 +357,7 @@ class RpcClient:
                                         service=self.service_name,
                                         method=method, direction="received")
                     return result
-                except grpc.RpcError as exc:
+                except (grpc.RpcError, _chaos.FaultInjected) as exc:
                     code = exc.code() if hasattr(exc, "code") else None
                     if (not chunked
                             and code == grpc.StatusCode.RESOURCE_EXHAUSTED
@@ -322,11 +370,16 @@ class RpcClient:
                         retried = 1
                         self._chunked_methods.add(method)
                         continue
-                    if code == grpc.StatusCode.UNAVAILABLE and attempt < self.retries:
+                    retryable = (code == grpc.StatusCode.UNAVAILABLE
+                                 or (idempotent and code
+                                     == grpc.StatusCode.DEADLINE_EXCEEDED))
+                    if retryable and attempt < self.retries:
                         attempt += 1
                         retried = 1
-                        logger.warning("%s/%s unavailable (attempt %d/%d)",
-                                       self.target, method, attempt, self.retries)
+                        logger.warning("%s/%s %s (attempt %d/%d)",
+                                       self.target, method,
+                                       code.name.lower(), attempt,
+                                       self.retries)
                         time.sleep(self.retry_sleep_s)
                         continue
                     _M_CLIENT_ERRORS.inc(service=self.service_name,
@@ -366,6 +419,15 @@ class RpcClient:
         # _done would otherwise lose the trace parent
         ctx = _trace.current_context()
         t0 = time.perf_counter()
+        if timeout is None:
+            timeout = self.default_deadline_s
+        inj = _chaos.get()
+        if inj is not None:
+            # chaos fires synchronously on the caller's thread: a drop
+            # raises here, which dispatch paths already treat as a failed
+            # dispatch (liveness accounting)
+            payload = inj.intercept("client", self.service_name, method,
+                                    payload)
         if (len(payload) > STREAM_THRESHOLD
                 or method in self._chunked_methods):
             return self._async_chunked(method, payload, callback,

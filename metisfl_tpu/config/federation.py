@@ -108,6 +108,50 @@ class TelemetryConfig:
 
 
 @dataclass
+class CommConfig:
+    """Transport knobs (comm/rpc.py RpcClient construction).
+
+    ``default_deadline_s`` bounds every RPC whose call site passes
+    ``timeout=None`` — an unbounded default would let one hung peer park
+    a dispatch thread forever. ``<= 0`` restores unbounded calls
+    (explicit operator opt-out). DEADLINE_EXCEEDED is retried only for
+    idempotent methods (getters, join, health)."""
+
+    default_deadline_s: float = 120.0
+    retries: int = 10
+    retry_sleep_s: float = 1.0
+
+
+@dataclass
+class FailoverConfig:
+    """Driver-side controller supervision (docs/RESILIENCE.md).
+
+    The controller process is relaunched with ``--resume`` when it dies
+    mid-run: the checkpoint restores the community model, round counter,
+    AND the learner registry + auth tokens, so rejoining learners are
+    recognized as themselves. ``max_controller_restarts`` bounds the
+    budget (a deterministically-crashing controller must eventually
+    fail the run); backoff doubles per consecutive restart."""
+
+    supervise_controller: bool = True
+    max_controller_restarts: int = 3
+    restart_backoff_s: float = 1.0
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic fault injection (metisfl_tpu/chaos). ``rules`` are
+    FaultRule dicts; each may carry ``process`` ("controller",
+    "learner", or "learner_<idx>") — the driver filters rules per
+    subprocess and arms them via the METISFL_TPU_CHAOS env var. Off by
+    default; the transport's off-path cost is one attribute read."""
+
+    enabled: bool = False
+    seed: int = 0
+    rules: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
 class CheckpointConfig:
     """Controller-side global checkpoint (SURVEY.md §5.4: the reference has
     no resume flow; community model + round counter are rebuilt here)."""
@@ -161,6 +205,9 @@ class FederationConfig:
     termination: TerminationConfig = field(default_factory=TerminationConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    comm: CommConfig = field(default_factory=CommConfig)
+    failover: FailoverConfig = field(default_factory=FailoverConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     ssl: SSLConfig = field(default_factory=SSLConfig)
     train: TrainParams = field(default_factory=TrainParams)
     eval: EvalConfig = field(default_factory=EvalConfig)
@@ -194,6 +241,17 @@ class FederationConfig:
                 "asynchronous secure federations")
         if self.protocol not in ("synchronous", "semi_synchronous", "asynchronous"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
+        if self.chaos.enabled:
+            # a typo'd fault name must fail at config time, not fire-time
+            # (an injector that silently never fires "validates" nothing)
+            from metisfl_tpu.chaos.injector import ChaosInjector
+            try:
+                ChaosInjector.from_spec({"seed": self.chaos.seed,
+                                         "rules": self.chaos.rules})
+            except (TypeError, ValueError) as exc:
+                raise ValueError(f"invalid chaos rule: {exc}") from None
+        if self.failover.max_controller_restarts < 0:
+            raise ValueError("failover.max_controller_restarts must be >= 0")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
         if self.train.dp_noise_multiplier < 0.0 or self.train.dp_clip_norm < 0.0:

@@ -65,12 +65,15 @@ def main(argv=None) -> int:
 
     controller = Controller(
         config,
-        lambda record: RpcLearnerProxy(record, ssl=config.ssl),
+        lambda record: RpcLearnerProxy(record, ssl=config.ssl,
+                                       comm=config.comm),
         secure_backend=secure_backend)
+    restored = False
     if args.resume:
         if not config.checkpoint.dir:
             parser.error("--resume requires config.checkpoint.dir")
-        if not controller.restore_checkpoint():
+        restored = controller.restore_checkpoint()
+        if not restored:
             logging.getLogger("metisfl_tpu.controller").warning(
                 "--resume: no checkpoint found under %r — starting FRESH "
                 "at round 0", config.checkpoint.dir)
@@ -79,6 +82,12 @@ def main(argv=None) -> int:
                               ssl=config.ssl)
     port = server.start()
     print(f"METISFL_TPU_CONTROLLER_READY port={port}", flush=True)
+    if restored:
+        # crash-failover: re-dispatch the abandoned round to the restored
+        # registry (learners that stayed alive resume immediately; dead
+        # endpoints heal via re-attach). AFTER start(): dispatches dial
+        # out and completions dial back in through the live server.
+        controller.resume_round()
 
     signal.signal(signal.SIGTERM, lambda *_: server.stop())
     signal.signal(signal.SIGINT, lambda *_: server.stop())

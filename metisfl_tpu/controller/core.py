@@ -171,6 +171,11 @@ class Controller:
         self._lock = threading.RLock()
         self._learners: Dict[str, LearnerRecord] = {}
         self._tokens: Dict[str, str] = {}
+        # Controller incarnation id, minted fresh per process (never
+        # restored from a checkpoint — the whole point is that a restart
+        # CHANGES it). Rides in JoinReply and every task envelope so
+        # learners detect a controller crash+restart and re-attach.
+        self.controller_epoch = uuid.uuid4().hex
 
         agg = config.aggregation
         if config.secure.enabled:
@@ -251,6 +256,13 @@ class Controller:
         # transient partial-cohort failures from a deterministically broken
         # federation, which must halt instead of retraining forever
         self._agg_failures = 0
+        # guards against recursive checkpointing while restore itself
+        # replays the community model through set_community_model
+        self._in_restore = False
+        # coalesces queued async checkpoint saves: N learners joining in
+        # a burst (or re-attaching after a failover) must cost one
+        # community-blob write on the scheduling executor, not N
+        self._ckpt_queued = False
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -265,6 +277,15 @@ class Controller:
             if self._deadline_timer is not None:
                 self._deadline_timer.cancel()
         self._pool.shutdown(wait=True)
+        # A task that was already draining on the pool when the first
+        # cancel ran may have re-armed the timer (complete-round →
+        # dispatch → arm); _arm_round_deadline now refuses post-shutdown
+        # arming, but cancel again for the window between the first
+        # cancel and the shutdown flag propagating — no timer may outlive
+        # shutdown() (it would fire into the torn-down pool).
+        with self._lock:
+            if self._deadline_timer is not None:
+                self._deadline_timer.cancel()
         self._store.shutdown()
 
     # ------------------------------------------------------------------ #
@@ -293,8 +314,50 @@ class Controller:
                 if not self._shutdown.is_set():
                     self._pool.submit(self._guard, self._schedule_initial,
                                       record.learner_id)
+                self._checkpoint_async()
                 return JoinReply(learner_id=record.learner_id,
-                                 auth_token=record.auth_token, rejoined=True)
+                                 auth_token=record.auth_token, rejoined=True,
+                                 controller_epoch=self.controller_epoch)
+            # Endpoint-keyed rejoin: a credential-less join from a
+            # host:port already in the registry is the same learner
+            # reincarnated without its token (crash that lost the creds
+            # file, or a registry restored from a controller checkpoint
+            # that the learner never knew about). One process owns one
+            # endpoint, so registering a SECOND id for it would leave a
+            # ghost in the barrier and double-dispatch the endpoint; the
+            # reference's ALREADY_EXISTS rejoin is endpoint-keyed for the
+            # same reason (grpc_controller_client.py:96-107). The token
+            # rotates — the stale one stops validating. Trust model: join
+            # is open, so endpoint reclamation grants nothing an attacker
+            # could not get by registering fresh — admission control is
+            # the transport's job (TLS + network ACLs, docs/RESILIENCE.md).
+            if request.port:
+                match = next(
+                    (r for r in self._learners.values()
+                     if r.hostname == request.hostname
+                     and r.port == request.port), None)
+                if match is not None:
+                    token = uuid.uuid4().hex
+                    match.auth_token = token
+                    self._tokens[match.learner_id] = token
+                    match.num_train_examples = request.num_train_examples
+                    match.num_val_examples = request.num_val_examples
+                    match.num_test_examples = request.num_test_examples
+                    match.party_index = int(
+                        request.capabilities.get("party_index",
+                                                 match.party_index))
+                    match.proxy = self._proxy_factory(match)
+                    match.dispatch_failures = 0
+                    logger.info("learner %s re-registered from its endpoint "
+                                "%s:%d (token rotated)", match.learner_id,
+                                request.hostname, request.port)
+                    if not self._shutdown.is_set():
+                        self._pool.submit(self._guard, self._schedule_initial,
+                                          match.learner_id)
+                    self._checkpoint_async()
+                    return JoinReply(learner_id=match.learner_id,
+                                     auth_token=token, rejoined=True,
+                                     controller_epoch=self.controller_epoch)
             learner_id = f"L{len(self._tokens)}_{request.hostname}_{request.port}"
             token = uuid.uuid4().hex
             record = LearnerRecord(
@@ -315,7 +378,11 @@ class Controller:
         # scheduled off the join path.
         if not self._shutdown.is_set():
             self._pool.submit(self._guard, self._schedule_initial, learner_id)
-        return JoinReply(learner_id=learner_id, auth_token=token)
+        # registry durability: a controller crash between here and the next
+        # round checkpoint must not forget this learner's identity/token
+        self._checkpoint_async()
+        return JoinReply(learner_id=learner_id, auth_token=token,
+                         controller_epoch=self.controller_epoch)
 
     def leave(self, learner_id: str, auth_token: str) -> bool:
         """RemoveLearner (controller.cc:170-199): drop registry + models."""
@@ -385,6 +452,39 @@ class Controller:
                     self._aggregator.seed_community(self._community_flat)
             if blob.opaque:
                 self._community_opaque = dict(blob.opaque)
+        # Checkpoint the freshly seeded/replaced model immediately: the
+        # per-round auto-checkpoint only starts after round 1 completes,
+        # so a controller crash during round 1 would otherwise restore to
+        # a model-less state a failover restart cannot train from.
+        self._checkpoint_async()
+
+    def _checkpoint_async(self) -> None:
+        """Queue a checkpoint save onto the scheduling executor (off the
+        RPC path; serialized with round logic). Coalescing: while a save
+        is already queued, further requests are no-ops — the queued save
+        snapshots state at RUN time, so it covers them. No-op when
+        checkpointing is unconfigured, during restore, or at shutdown."""
+        if (not self.config.checkpoint.dir or self._in_restore
+                or self._shutdown.is_set()):
+            return
+        with self._lock:
+            if self._ckpt_queued:
+                return
+            self._ckpt_queued = True
+
+        def _save():
+            with self._lock:
+                self._ckpt_queued = False
+            try:
+                self.save_checkpoint()
+            except Exception:  # noqa: BLE001 - best-effort durability
+                logger.exception("checkpoint save failed")
+
+        try:
+            self._pool.submit(self._guard, _save)
+        except RuntimeError:  # pool already shut down
+            with self._lock:
+                self._ckpt_queued = False
 
     def community_model_bytes(self) -> Optional[bytes]:
         with self._lock:
@@ -552,6 +652,12 @@ class Controller:
         if deadline <= 0 or self._scheduler.name == "asynchronous":
             return
         with self._lock:
+            # shutdown() cancels the live timer under this lock; a round
+            # task draining on the pool concurrently with shutdown must
+            # not arm a replacement after that cancel (the regression
+            # tests/test_failover.py pins: no timer outlives shutdown)
+            if self._shutdown.is_set():
+                return
             if (not restart and self._deadline_timer is not None
                     and self._deadline_timer.is_alive()):
                 return
@@ -1141,6 +1247,7 @@ class Controller:
                         params=params,
                         scaffold=self._aggregator.name == "scaffold",
                         control=self._pack_scaffold_c(),
+                        controller_epoch=self.controller_epoch,
                     )
                     self._tasks_in_flight[task.task_id] = lid
                     self._current_meta.train_submitted_at[lid] = time.time()
@@ -1220,6 +1327,7 @@ class Controller:
                 metrics=list(cfg.metrics),
                 local_tensor_regex=self.config.train.local_tensor_regex,
                 ship_tensor_regex=self.config.train.ship_tensor_regex,
+                controller_epoch=self.controller_epoch,
             )
             with self._lock:
                 meta.eval_submitted_at[record.learner_id] = time.time()
@@ -1257,6 +1365,26 @@ class Controller:
                 "community_blob": self._community_blob or b"",
                 "round_metadata": [m.to_dict() for m in self.round_metadata],
                 "community_evaluations": self._snapshot_evaluations(),
+                # Learner registry + auth tokens (crash-failover): a
+                # restarted controller must recognize rejoining learners
+                # as THEMSELVES — same id, same token, same masking/
+                # SCAFFOLD party index — or every credentialed rejoin
+                # would register a ghost duplicate and secure-agg party
+                # maps would break. Proxies are rebuilt at restore.
+                "learners": [
+                    {"learner_id": r.learner_id,
+                     "auth_token": r.auth_token,
+                     "hostname": r.hostname,
+                     "port": r.port,
+                     "num_train_examples": r.num_train_examples,
+                     "num_val_examples": r.num_val_examples,
+                     "num_test_examples": r.num_test_examples,
+                     "completed_batches": r.completed_batches,
+                     "ms_per_step": float(r.ms_per_step),
+                     "last_result_round": r.last_result_round,
+                     "party_index": r.party_index,
+                     "local_steps_override": r.local_steps_override}
+                    for r in self._learners.values()],
             }
             # Rolling rules (FedRec) carry cross-round state; persist the
             # contribution scales so resume can rebuild wc_scaled/z from the
@@ -1305,8 +1433,31 @@ class Controller:
                 state.get("community_evaluations", []))
             self._current_meta = RoundMetadata(
                 global_iteration=self.global_iteration)
+        known_fields = {f.name for f in dataclasses.fields(LearnerRecord)}
+        for entry in state.get("learners", []):
+            record = LearnerRecord(**{k: v for k, v in entry.items()
+                                      if k in known_fields})
+            try:
+                # the checkpointed endpoint may still be live (controller
+                # crashed, learners did not): a working proxy lets the
+                # restored controller re-dispatch the in-flight round
+                # immediately; a dead endpoint surfaces as a dispatch
+                # failure and heals when the learner re-attaches
+                record.proxy = self._proxy_factory(record)
+            except Exception:  # noqa: BLE001 - proxy rebuilt on rejoin
+                logger.warning("could not rebuild proxy for %s; waiting "
+                               "for re-attach", record.learner_id)
+            with self._lock:
+                self._learners[record.learner_id] = record
+                self._tokens[record.learner_id] = record.auth_token
+        with self._lock:
+            _M_ACTIVE_LEARNERS.set(len(self._learners))
         if blob:
-            self.set_community_model(blob)
+            self._in_restore = True
+            try:
+                self.set_community_model(blob)
+            finally:
+                self._in_restore = False
         agg_scales = state.get("agg_scales")
         if agg_scales and hasattr(self._aggregator, "rehydrate"):
             # FedRec restart-correctness: without this, the rolling sum would
@@ -1328,9 +1479,37 @@ class Controller:
             # server-opt restart-correctness: moments + step counter resume
             # the exact update sequence of an uninterrupted run
             self._aggregator.restore_state(agg_state)
-        logger.info("restored checkpoint %s at round %d",
-                    path, self.global_iteration)
+        logger.info("restored checkpoint %s at round %d (%d learner(s) in "
+                    "registry, epoch %s)", path, self.global_iteration,
+                    len(self._learners), self.controller_epoch[:8])
         return True
+
+    def resume_round(self) -> bool:
+        """Kick the restored federation: dispatch a fresh round to the
+        checkpointed cohort (the crash abandoned whatever round was in
+        flight — its tasks carry the dead epoch and their completions,
+        if any arrive, fold in as regular contributions). Returns False
+        when there is nothing to resume (no community model or empty
+        registry); rejoining learners then restart rounds via their own
+        initial dispatch."""
+        with self._lock:
+            ready = (self._community_blob is not None
+                     and bool(self._learners))
+        if not ready or self._shutdown.is_set():
+            return False
+        self._pool.submit(self._guard, self._resume_dispatch)
+        return True
+
+    def _resume_dispatch(self) -> None:
+        if self._shutdown.is_set():
+            return
+        self._scheduler.reset()
+        cohort = self._sample_cohort()
+        if not cohort:
+            return
+        logger.info("resuming round %d after restore: dispatching to %s",
+                    self.global_iteration, cohort)
+        self._dispatch_train(cohort)
 
     # ------------------------------------------------------------------ #
     # statistics (driver)

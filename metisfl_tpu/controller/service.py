@@ -30,13 +30,24 @@ CONTROLLER_SERVICE = "metisfl_tpu.Controller"
 LEARNER_SERVICE = "metisfl_tpu.Learner"
 
 
+def _comm_kwargs(comm) -> dict:
+    """RpcClient kwargs from a config ``comm`` section (None → library
+    defaults) — one translation point so every client construction stays
+    deadline-bounded by default."""
+    if comm is None:
+        return {}
+    return {"default_deadline_s": comm.default_deadline_s,
+            "retries": comm.retries,
+            "retry_sleep_s": comm.retry_sleep_s}
+
+
 class RpcLearnerProxy:
     """Controller → remote learner over gRPC (async dispatch, mirroring the
     reference's CompletionQueue fan-out, controller.cc:713-759)."""
 
-    def __init__(self, record: LearnerRecord, ssl=None):
+    def __init__(self, record: LearnerRecord, ssl=None, comm=None):
         self._client = RpcClient(record.hostname, record.port, LEARNER_SERVICE,
-                                 ssl=ssl)
+                                 ssl=ssl, **_comm_kwargs(comm))
 
     def run_task(self, task: TrainTask) -> None:
         self._client.call_async("RunTask", task.to_wire())
@@ -183,12 +194,14 @@ class ControllerClient:
     """Learner/driver → controller client (reference
     grpc_controller_client.py:11-297)."""
 
-    def __init__(self, host: str, port: int, ssl=None):
-        self._client = RpcClient(host, port, CONTROLLER_SERVICE, ssl=ssl)
+    def __init__(self, host: str, port: int, ssl=None, comm=None):
+        self._client = RpcClient(host, port, CONTROLLER_SERVICE, ssl=ssl,
+                                 **_comm_kwargs(comm))
 
     def join(self, request: JoinRequest) -> JoinReply:
-        return JoinReply.from_wire(self._client.call("JoinFederation",
-                                                     request.to_wire()))
+        # idempotent: a re-sent join lands on the rejoin path
+        return JoinReply.from_wire(self._client.call(
+            "JoinFederation", request.to_wire(), idempotent=True))
 
     def leave(self, learner_id: str, auth_token: str) -> bool:
         raw = self._client.call("LeaveFederation", dumps(
@@ -203,35 +216,48 @@ class ControllerClient:
         return bool(loads(self._client.call("ReplaceCommunityModel", blob))["ok"])
 
     def get_community_model(self) -> bytes:
-        return self._client.call("GetCommunityModel", b"")
+        return self._client.call("GetCommunityModel", b"", idempotent=True)
 
     def get_statistics(self) -> dict:
-        return loads(self._client.call("GetStatistics", b""))
+        return loads(self._client.call("GetStatistics", b"",
+                                       idempotent=True))
 
-    def get_runtime_metadata(self, tail: int = 0) -> dict:
+    def get_runtime_metadata(self, tail: int = 0,
+                             timeout: Optional[float] = None,
+                             wait_ready: bool = True) -> dict:
         """{'global_iteration', 'round_metadata': last ``tail`` rounds}
-        (0 = full lineage)."""
-        raw = self._client.call("GetRuntimeMetadata", dumps({"tail": tail}))
+        (0 = full lineage). ``wait_ready=False`` + a short timeout makes
+        a poll against a dead controller fail fast instead of parking in
+        the channel's wait-for-ready — the driver's supervision loop
+        needs the failure signal to trigger the failover restart."""
+        raw = self._client.call("GetRuntimeMetadata", dumps({"tail": tail}),
+                                timeout=timeout, wait_ready=wait_ready,
+                                idempotent=True)
         return loads(raw)
 
     def get_evaluation_lineage(self, tail: int = 0) -> list:
         """Last ``tail`` evaluation entries (0 = full lineage)."""
-        raw = self._client.call("GetEvaluationLineage", dumps({"tail": tail}))
+        raw = self._client.call("GetEvaluationLineage", dumps({"tail": tail}),
+                                idempotent=True)
         return loads(raw)["community_evaluations"]
 
-    def list_learners(self) -> list:
+    def list_learners(self, timeout: Optional[float] = None,
+                      wait_ready: bool = True) -> list:
         """Registered learner endpoints [{learner_id, hostname, port}] — the
         ports learners actually bound (JoinRequest.port), for shutdown and
         monitoring (replaces any port-arithmetic assumptions driver-side)."""
-        return loads(self._client.call("ListLearners", b""))["learners"]
+        return loads(self._client.call("ListLearners", b"", timeout=timeout,
+                                       wait_ready=wait_ready,
+                                       idempotent=True))["learners"]
 
     def health(self, timeout: float = 5.0) -> dict:
-        return loads(self._client.call("GetHealthStatus", b"", timeout=timeout))
+        return loads(self._client.call("GetHealthStatus", b"",
+                                       timeout=timeout, idempotent=True))
 
     def get_metrics(self, timeout: float = 5.0) -> str:
         """The controller's Prometheus text exposition (GetMetrics RPC)."""
-        return self._client.call("GetMetrics", b"",
-                                 timeout=timeout).decode("utf-8")
+        return self._client.call("GetMetrics", b"", timeout=timeout,
+                                 idempotent=True).decode("utf-8")
 
     def shutdown_controller(self) -> bool:
         return bool(loads(self._client.call("ShutDown", b""))["ok"])

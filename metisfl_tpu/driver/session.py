@@ -31,12 +31,21 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import cloudpickle
 import numpy as np
 
+from metisfl_tpu.chaos import ENV_VAR as _CHAOS_ENV_VAR
 from metisfl_tpu.comm.messages import TrainParams
 from metisfl_tpu.config import FederationConfig
 from metisfl_tpu.controller.service import ControllerClient
+from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.tensor.pytree import pack_model
 
 logger = logging.getLogger("metisfl_tpu.driver")
+
+# Controller failover events, scrapable from the driver process's
+# registry (docs/RESILIENCE.md): each supervised relaunch-with-resume
+# increments this exactly once.
+_M_CTRL_RESTARTS = _tmetrics.registry().counter(
+    "controller_restarts_total",
+    "Supervised controller relaunches after a crash")
 
 
 @dataclass
@@ -173,6 +182,12 @@ class DriverSession:
         # last successfully observed learner endpoints — the shutdown
         # fallback when the controller has already died
         self._known_endpoints: List[dict] = []
+        # controller crash-failover supervision state
+        self._controller_restarts = 0
+        self._shutting_down = False
+        # chaos arms ORIGINAL incarnations only (see _chaos_env): learner
+        # indices that already got their armed launch
+        self._chaos_armed_learners: set = set()
 
     # ------------------------------------------------------------------ #
     # bootstrap
@@ -284,26 +299,27 @@ class DriverSession:
                 hosts=[h for h in hosts if h not in self._LOCAL_HOSTS])
             self.config.ssl.cert_path, self.config.ssl.key_path = cert, key
 
+        # Controller supervision needs a checkpoint to restore from:
+        # default the checkpoint dir into the workdir so a relaunched
+        # controller resumes the community model, round counter, AND the
+        # learner registry instead of starting a ghost federation.
+        if (self.config.failover.supervise_controller
+                and not self.config.checkpoint.dir):
+            self.config.checkpoint.dir = os.path.join(self.workdir,
+                                                      "checkpoint")
+        if self.config.checkpoint.dir:
+            os.makedirs(self.config.checkpoint.dir, exist_ok=True)
+
         config_path = os.path.join(self.workdir, "federation_config.bin")
         with open(config_path, "wb") as f:
             f.write(self.config.to_wire())
         self._config_path = config_path
 
         ctrl_host = self.config.controller_host or "localhost"
-        ctrl_launcher = self._launcher_for(ctrl_host)
-        ctrl_argv = [getattr(ctrl_launcher, "python", sys.executable),
-                     "-m", "metisfl_tpu.controller",
-                     "--config", config_path,
-                     "--port", str(self.config.controller_port)]
-        if self.resume:
-            ctrl_argv.append("--resume")
-        if isinstance(ctrl_launcher, SSHLauncher):
-            ctrl_launcher.ship([config_path] + self._ssl_files())
-        self._procs.append(ctrl_launcher.launch(
-            "controller", ctrl_argv, env=self._base_env()))
-
+        self._launch_controller(resume=self.resume)
         self._client = ControllerClient(ctrl_host, self.config.controller_port,
-                                        ssl=self.config.ssl)
+                                        ssl=self.config.ssl,
+                                        comm=self.config.comm)
         self._wait_healthy(health_retries, health_sleep_s)
 
         # ship initial model (reference _ship_model_to_controller :334-342)
@@ -316,6 +332,96 @@ class DriverSession:
         for idx in range(len(self.learner_recipes)):
             self.launch_learner(idx)
         self._started_at = time.time()
+
+    def _chaos_env(self, process: str, idx: Optional[int] = None) -> Dict[str, str]:
+        """METISFL_TPU_CHAOS env for one subprocess: the configured chaos
+        rules whose ``process`` selector matches (empty selector = every
+        process; ``learner`` = any learner; ``learner_<idx>`` = one).
+        Applied only to ORIGINAL incarnations — a supervised relaunch
+        runs clean, otherwise a kill rule would re-fire on every restart
+        and no failover could ever be proven to converge."""
+        cfg = self.config.chaos
+        if not cfg.enabled or not cfg.rules:
+            return {}
+        wanted = {"", process}
+        if idx is not None:
+            wanted.add(f"{process}_{idx}")
+        rules = [r for r in cfg.rules if r.get("process", "") in wanted]
+        if not rules:
+            return {}
+        return {_CHAOS_ENV_VAR: json.dumps({"seed": cfg.seed,
+                                            "rules": rules})}
+
+    def _launch_controller(self, resume: bool = False) -> _Proc:
+        """(Re)launch the controller; replaces any tracked (dead) process
+        of the same name. ``resume=True`` restores from the latest
+        checkpoint (community model + round counter + learner registry)
+        and re-dispatches the abandoned round."""
+        ctrl_host = self.config.controller_host or "localhost"
+        launcher = self._launcher_for(ctrl_host)
+        argv = [getattr(launcher, "python", sys.executable),
+                "-m", "metisfl_tpu.controller",
+                "--config", self._config_path,
+                "--port", str(self.config.controller_port)]
+        if resume:
+            argv.append("--resume")
+        if isinstance(launcher, SSHLauncher):
+            launcher.ship([self._config_path] + self._ssl_files())
+        env = dict(self._base_env())
+        if self._controller_restarts == 0:
+            env.update(self._chaos_env("controller"))
+        self._procs = [p for p in self._procs if p.name != "controller"]
+        proc = launcher.launch("controller", argv, env=env)
+        self._procs.append(proc)
+        return proc
+
+    def _supervise_controller(self) -> bool:
+        """Crash failover (docs/RESILIENCE.md): when the controller
+        process has died, relaunch it with ``--resume`` under a bounded
+        restart budget with doubling backoff. Returns True when a restart
+        happened this call; raises once the budget is exhausted (a
+        deterministically-crashing controller must fail the run, not
+        crash-loop forever)."""
+        ctrl = next((p for p in self._procs if p.name == "controller"), None)
+        if (ctrl is None or self._shutting_down
+                or ctrl.process.poll() is None):
+            return False
+        fo = self.config.failover
+        if not fo.supervise_controller:
+            return False  # _check_procs_alive reports the death as fatal
+        code = ctrl.process.poll()
+        if self._controller_restarts >= fo.max_controller_restarts:
+            with open(ctrl.log_path) as f:
+                tail = f.read()[-2000:]
+            raise RuntimeError(
+                f"controller died (exit {code}) with the restart budget "
+                f"({fo.max_controller_restarts}) exhausted; log tail:\n"
+                f"{tail}")
+        self._controller_restarts += 1
+        backoff = fo.restart_backoff_s * (2 ** (self._controller_restarts - 1))
+        logger.warning(
+            "controller died (exit %s); supervised restart %d/%d with "
+            "--resume in %.1fs", code, self._controller_restarts,
+            fo.max_controller_restarts, backoff)
+        time.sleep(backoff)
+        self._launch_controller(resume=True)
+        _M_CTRL_RESTARTS.inc()
+        try:
+            self._wait_healthy(30, 1.0)
+        except RuntimeError as exc:
+            # the relaunch itself died (stale port, corrupt checkpoint, a
+            # learner crashed mid-wait): consume the budget across
+            # supervision cycles instead of aborting with restarts left —
+            # the next monitor iteration re-evaluates (and the budget
+            # check above fails the run once it is truly exhausted)
+            if self._controller_restarts >= fo.max_controller_restarts:
+                raise
+            logger.warning("relaunched controller not healthy (%s); "
+                           "supervision will retry", exc)
+            return True
+        logger.info("controller restarted and healthy (restart %d)",
+                    self._controller_restarts)
+        return True
 
     def launch_learner(self, idx: int) -> _Proc:
         """(Re)launch learner ``idx`` on its configured endpoint. Ports come
@@ -336,6 +442,7 @@ class DriverSession:
                 "--advertise-host", ep.hostname or "localhost",
                 "--port", str(ep.port),
                 "--recipe", recipe_path,
+                "--rpc-deadline-s", str(self.config.comm.default_deadline_s),
                 "--credentials-dir",
                 os.path.join(self.workdir, f"{name}_creds")]
         if self.config.ssl.enabled:
@@ -354,6 +461,12 @@ class DriverSession:
             launcher.ship([recipe_path] + self._ssl_files()
                           + self._secure_files(idx))
         env = {**self._base_env(), **self.learner_env}
+        if idx not in self._chaos_armed_learners:
+            # original incarnation only: a relaunch (crash-rejoin) runs
+            # clean, or a kill rule would re-fire on every restart and
+            # the recovery under test could never converge
+            self._chaos_armed_learners.add(idx)
+            env.update(self._chaos_env("learner", idx))
         world = max(1, int(getattr(ep, "world_size", 1)))
         if world > 1:
             # multi-host learner: one process per rank (rank 0 = the
@@ -409,8 +522,10 @@ class DriverSession:
             time.sleep(sleep_s)
         raise RuntimeError(f"controller never became healthy: {last_exc}")
 
-    def _check_procs_alive(self) -> None:
+    def _check_procs_alive(self, skip: Sequence[str] = ()) -> None:
         for proc in self._procs:
+            if proc.name in skip:
+                continue
             code = proc.process.poll()
             if code is not None and code != 0:
                 with open(proc.log_path) as f:
@@ -425,17 +540,44 @@ class DriverSession:
     def monitor_federation(self, poll_every_s: float = 2.0,
                            eval_drain_timeout_s: float = 90.0) -> dict:
         term = self.config.termination
+        poll_failures = 0
         while True:
             time.sleep(poll_every_s)
-            self._check_procs_alive()
+            # crash failover first: a dead controller is either relaunched
+            # (supervision on, budget left) or reported fatally by the
+            # liveness check below. Under supervision the liveness check
+            # skips the controller entirely — a death in the gap between
+            # the two calls belongs to the NEXT supervision cycle, not to
+            # an instant abort that bypasses the restart budget.
+            self._supervise_controller()
+            self._check_procs_alive(
+                skip=("controller",)
+                if self.config.failover.supervise_controller else ())
             # poll the tail-bounded lineage RPCs — a long-running federation
             # must not ship its full history every 2 s (the unbounded
             # GetStatistics dump is fetched once, at termination)
-            progress = self._client.get_runtime_metadata(tail=1)
             try:
-                self._known_endpoints = self._client.list_learners()
-            except Exception:  # noqa: BLE001 - keep the stale snapshot
-                pass
+                # fail-fast polls (short deadline, no wait-for-ready): a
+                # dead controller must surface as an error promptly so
+                # the next iteration's supervision can relaunch it — a
+                # blocking wait-for-ready would park this loop instead
+                progress = self._client.get_runtime_metadata(
+                    tail=1, timeout=15.0, wait_ready=False)
+                try:
+                    self._known_endpoints = self._client.list_learners(
+                        timeout=15.0, wait_ready=False)
+                except Exception:  # noqa: BLE001 - keep the stale snapshot
+                    pass
+                poll_failures = 0
+            except Exception as exc:  # noqa: BLE001 - bounded retry
+                # the controller can die between the supervision check and
+                # this poll; give the next iteration's supervision a chance
+                # instead of aborting the run on one lost poll
+                poll_failures += 1
+                if poll_failures > 5:
+                    raise
+                logger.warning("monitor poll failed (%s); retrying", exc)
+                continue
 
             if progress["global_iteration"] >= term.federation_rounds > 0:
                 logger.info("termination: reached %d rounds",
@@ -600,6 +742,7 @@ class DriverSession:
         # that task can take tens of seconds), and killing followers
         # earlier aborts them mid-collective. An explicit timeout_s is
         # honored as given.
+        self._shutting_down = True  # supervision must not resurrect it now
         if timeout_s is None:
             multihost = any(int(getattr(ep, "world_size", 1)) > 1
                             for ep in self.config.learners)

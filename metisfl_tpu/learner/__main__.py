@@ -85,6 +85,12 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="plain-HTTP /metrics listener port (0 = off; "
                              "metrics stay reachable via the GetMetrics RPC)")
+    parser.add_argument("--rpc-deadline-s", type=float, default=None,
+                        help="default RPC deadline toward the controller "
+                             "(config comm.default_deadline_s, forwarded "
+                             "by the driver; <= 0 = unbounded, same "
+                             "convention as the config; omitted = library "
+                             "default)")
     args = parser.parse_args(argv)
 
     from metisfl_tpu import telemetry
@@ -164,8 +170,12 @@ def main(argv=None) -> int:
                 "found persisted credentials for %s; attempting rejoin",
                 previous_id)
 
+    comm = None
+    if args.rpc_deadline_s is not None:
+        from metisfl_tpu.config import CommConfig
+        comm = CommConfig(default_deadline_s=args.rpc_deadline_s)
     controller = ControllerClient(args.controller_host, args.controller_port,
-                                  ssl=ssl)
+                                  ssl=ssl, comm=comm)
     advertise = args.advertise_host or socket.gethostname()
     learner = Learner(
         model_ops=model_ops,
@@ -179,6 +189,13 @@ def main(argv=None) -> int:
     server = LearnerServer(learner, host=args.host, port=args.port, ssl=ssl)
     port = server.start()
     print(f"METISFL_TPU_LEARNER_READY port={port}", flush=True)
+
+    if args.credentials_dir:
+        # persist refreshed identity after every re-attach too: a
+        # controller that lost its registry hands out a NEW id, and the
+        # next learner restart must rejoin as that one
+        learner.on_join = lambda reply: save_credentials(
+            args.credentials_dir, reply.learner_id, reply.auth_token)
 
     try:
         reply = learner.join_federation(previous_id=previous_id,

@@ -20,6 +20,7 @@ cancel-on-new-task, run evaluations, ship results back. Redesigned:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -67,6 +68,10 @@ _M_TASKS = _REG.counter(
     ("outcome",))
 _M_EVALS = _REG.histogram(
     "learner_eval_duration_seconds", "Community-model evaluation time")
+_M_REATTACH = _REG.counter(
+    "learner_reattach_total",
+    "Re-attach joins after a controller crash/restart was detected",
+    ("reason",))
 
 
 class ControllerProxy(Protocol):
@@ -102,6 +107,21 @@ class Learner:
 
         self.learner_id: str = ""
         self.auth_token: str = ""
+        # controller incarnation id observed at (re)join; a different
+        # epoch in a later task envelope means the controller crashed and
+        # restarted → re-attach before proceeding
+        self.controller_epoch: str = ""
+        # invoked with the JoinReply after every reattach join —
+        # __main__ points this at credential persistence so an identity
+        # refreshed mid-run survives the NEXT learner restart too
+        self.on_join: Optional[Callable[[JoinReply], None]] = None
+        # bounded reattach loop (tests tighten these)
+        self.reattach_retries = 10
+        self.reattach_backoff_s = 1.0
+        # deliberate departure: a straggling completion rejected AFTER
+        # leave_federation must not re-register us behind the operator's
+        # back (reset by the next explicit join)
+        self._left = False
         self._executor = ThreadPoolExecutor(max_workers=1,
                                             thread_name_prefix="learner-train")
         self._cancel = threading.Event()
@@ -168,12 +188,107 @@ class Learner:
         ))
         self.learner_id = reply.learner_id
         self.auth_token = reply.auth_token
+        if reply.controller_epoch:
+            self.controller_epoch = reply.controller_epoch
+        self._left = False
         return reply
 
     def leave_federation(self) -> bool:
         if not self.learner_id:
             return False
+        self._left = True
         return self.controller.leave(self.learner_id, self.auth_token)
+
+    # ------------------------------------------------------------------ #
+    # controller-failover re-attach
+    # ------------------------------------------------------------------ #
+
+    def reattach(self, reason: str) -> bool:
+        """Re-run ``join_federation`` as ourselves after losing the
+        controller (persistent UNAVAILABLE, auth rejection, or an epoch
+        mismatch in a task envelope). A restarted controller that
+        checkpointed its registry recognizes the (previous_id, token)
+        pair and keeps our identity — including the masking/SCAFFOLD
+        party index; one that lost it assigns a fresh identity, which we
+        adopt (and hand to ``on_join`` for persistence)."""
+        previous_id, token = self.learner_id, self.auth_token
+        for attempt in range(1, max(1, self.reattach_retries) + 1):
+            if self._shutdown.is_set():
+                return False
+            try:
+                reply = self.join_federation(previous_id=previous_id,
+                                             auth_token=token)
+            except Exception as exc:  # noqa: BLE001 - retried
+                logger.warning("%s: re-attach attempt %d/%d failed: %s",
+                               previous_id, attempt, self.reattach_retries,
+                               exc)
+                self._shutdown.wait(self.reattach_backoff_s)
+                continue
+            _M_REATTACH.inc(reason=reason)
+            logger.info(
+                "%s: re-attached to controller (epoch %s, rejoined=%s, "
+                "reason=%s)", self.learner_id,
+                (reply.controller_epoch or "?")[:8], reply.rejoined, reason)
+            if self.on_join is not None:
+                try:
+                    self.on_join(reply)
+                except Exception:  # noqa: BLE001 - persistence best-effort
+                    logger.exception("on_join callback failed")
+            return True
+        logger.error("%s: re-attach gave up after %d attempts (reason=%s)",
+                     previous_id, self.reattach_retries, reason)
+        return False
+
+    def _check_controller_epoch(self, task_epoch: str) -> None:
+        """A task stamped with a different controller incarnation than the
+        one we joined: the controller restarted (and restored our
+        registration well enough to dispatch to us) — refresh the
+        registration instead of trusting the stale one."""
+        if (task_epoch and self.controller_epoch
+                and task_epoch != self.controller_epoch):
+            logger.warning(
+                "%s: task from controller epoch %s but joined under %s — "
+                "re-attaching", self.learner_id, task_epoch[:8],
+                self.controller_epoch[:8])
+            self.reattach("epoch_mismatch")
+
+    def _report_completion(self, result: TaskResult) -> bool:
+        """Deliver a TaskResult, surviving a controller crash between
+        dispatch and completion: on transport failure or rejection,
+        re-attach and resubmit once under the refreshed credentials. The
+        in-flight round's work is preserved — the new controller
+        incarnation stores the model like any other contribution."""
+        try:
+            if self.controller.task_completed(result):
+                return True
+            if self._left or self._shutdown.is_set():
+                # rejected because WE left / are shutting down — not a
+                # controller failure; do not re-register ourselves
+                return False
+            reason = "completion_rejected"
+            logger.warning("%s: completion for task %s rejected; "
+                           "re-attaching", self.learner_id, result.task_id)
+        except Exception as exc:  # noqa: BLE001 - transport failure
+            if self._left or self._shutdown.is_set():
+                # departed/stopping learners never re-register themselves,
+                # whether the delivery was rejected OR undeliverable
+                return False
+            reason = "completion_unavailable"
+            logger.warning("%s: completion delivery for task %s failed "
+                           "(%s); re-attaching", self.learner_id,
+                           result.task_id, exc)
+        if not self.reattach(reason):
+            logger.error("%s: dropping result for task %s (re-attach "
+                         "failed)", self.learner_id, result.task_id)
+            return False
+        result = dataclasses.replace(result, learner_id=self.learner_id,
+                                     auth_token=self.auth_token)
+        try:
+            return bool(self.controller.task_completed(result))
+        except Exception:  # noqa: BLE001 - the round deadline recovers
+            logger.exception("%s: completion resubmit failed for task %s",
+                             self.learner_id, result.task_id)
+            return False
 
     # ------------------------------------------------------------------ #
     # model wire I/O (+ optional HE)
@@ -413,6 +528,11 @@ class Learner:
 
     def _run_train_task(self, task: TrainTask, task_sp) -> None:
         try:
+            # on the serialized train thread, BEFORE paying for training:
+            # a task from a restarted controller refreshes registration
+            # first (the restart re-dispatches after rejoin, and that
+            # fresh task supersedes this one via the cancel event)
+            self._check_controller_epoch(task.controller_epoch)
             params = task.params
             # set BEFORE _load_model: round-2+ community blobs omit the
             # local tensors and the load must merge them back (snapshot
@@ -566,7 +686,7 @@ class Learner:
                 epoch_metrics=out.epoch_metrics,
                 control_delta=control_delta,
             )
-            self.controller.task_completed(result)
+            self._report_completion(result)
             _M_TASKS.inc(outcome="completed")
             task_sp.set_attr("outcome", "completed")
         except Exception:
@@ -624,6 +744,7 @@ class Learner:
                                    "round": task.round_id,
                                    "learner": self.learner_id})
         with eval_sp, eval_sp.activate():
+            self._check_controller_epoch(task.controller_epoch)
             self._adopt_local_regex(task.local_tensor_regex)
             if task.ship_tensor_regex:
                 # never-trained learners get the regex from the task (backfill

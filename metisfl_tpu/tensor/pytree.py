@@ -12,12 +12,14 @@ exact same tree structure.
 from __future__ import annotations
 
 import struct
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 import jax
 
+from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.tensor.spec import (
     TensorKind,
     TensorSpec,
@@ -29,7 +31,19 @@ from metisfl_tpu.tensor.spec import (
 NamedTensors = List[Tuple[str, np.ndarray]]
 
 _MAGIC = b"MTFB"  # metisfl-tpu federated blob
-_BLOB_VERSION = 1
+# v2 adds integrity framing: a <u64 body_len, u32 crc32> trailer-header
+# over the tensor body, so a bit-flipped or truncated blob is rejected at
+# the wire boundary instead of deserializing into garbage weights that
+# would silently poison an aggregation. v1 blobs (pre-integrity
+# checkpoints) still parse — unverified.
+_BLOB_VERSION = 2
+
+# Payloads rejected by the integrity framing (length or checksum). The
+# RPC layer surfaces the ValueError as INVALID_ARGUMENT; the controller's
+# malformed-result path drops the contribution without stalling the round.
+_M_CORRUPT = _tmetrics.registry().counter(
+    "corrupt_payloads_total",
+    "Model blobs rejected by length/checksum integrity framing")
 
 
 def _escape(part: str) -> str:
@@ -108,7 +122,7 @@ class ModelBlob:
         )
 
     def to_bytes(self) -> bytes:
-        chunks = [_MAGIC, struct.pack("<BI", _BLOB_VERSION, len(self.names))]
+        chunks = []
         for name, arr in self.tensors:
             nb = name.encode("utf-8")
             chunks.append(struct.pack("<H", len(nb)))
@@ -119,7 +133,13 @@ class ModelBlob:
             chunks.append(struct.pack("<H", len(nb)))
             chunks.append(nb)
             chunks.append(opaque_tensor_to_bytes(spec, payload))
-        return b"".join(chunks)
+        body = b"".join(chunks)
+        return b"".join([
+            _MAGIC,
+            struct.pack("<BI", _BLOB_VERSION, len(self.names)),
+            struct.pack("<QI", len(body), zlib.crc32(body)),
+            body,
+        ])
 
     @classmethod
     def from_bytes(cls, buf, copy: bool = True) -> "ModelBlob":
@@ -127,9 +147,28 @@ class ModelBlob:
         if bytes(view[:4]) != _MAGIC:
             raise ValueError("not a metisfl-tpu model blob")
         version, count = struct.unpack_from("<BI", view, 4)
-        if version != _BLOB_VERSION:
-            raise ValueError(f"unsupported blob version {version}")
         offset = 9
+        if version == 2:
+            try:
+                body_len, crc = struct.unpack_from("<QI", view, offset)
+            except struct.error:
+                _M_CORRUPT.inc()
+                raise ValueError("truncated model blob header") from None
+            offset += 12
+            body = view[offset:]
+            if len(body) != body_len:
+                _M_CORRUPT.inc()
+                raise ValueError(
+                    f"model blob length mismatch (framed {body_len} body "
+                    f"bytes, have {len(body)}) — truncated or spliced "
+                    "payload")
+            if zlib.crc32(body) != crc:
+                _M_CORRUPT.inc()
+                raise ValueError(
+                    "model blob checksum mismatch — corrupt payload "
+                    "rejected before deserialization")
+        elif version != 1:  # v1: legacy pre-integrity blobs parse unverified
+            raise ValueError(f"unsupported blob version {version}")
         blob = cls()
         for _ in range(count):
             (nlen,) = struct.unpack_from("<H", view, offset)

@@ -13,12 +13,14 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 from metisfl_tpu.tensor.pytree import unpack_model
 
 from tests.test_federation_inprocess import _make_federation
 
 
+@pytest.mark.slow
 def test_federation_survives_random_learner_churn():
     fed, _ = _make_federation(
         protocol="synchronous", num_learners=5,
@@ -74,20 +76,187 @@ def test_federation_survives_random_learner_churn():
         for meta in stats["round_metadata"][:target_rounds]:
             assert meta["selected_learners"]
             assert meta["aggregation_duration_ms"] >= 0
-        # the community model came through the churn finite
-        blob = fed.controller.community_model_bytes()
-        assert blob is not None
-        template = fed.learners[0].model_ops.get_variables()
-        for leaf in np.asarray(
-                [np.asarray(x).sum() for x in
-                 _leaves(unpack_model(blob, template))]):
-            assert np.isfinite(leaf)
     finally:
         stop.set()
         fed.shutdown()
+    # the community model came through the churn finite — read AFTER
+    # shutdown: an in-flight training task holds donated (deleted) engine
+    # buffers, and rejoin churn keeps dispatch active to the last moment
+    blob = fed.controller.community_model_bytes()
+    assert blob is not None
+    template = fed.learners[0].model_ops.get_variables()
+    for leaf in np.asarray(
+            [np.asarray(x).sum() for x in
+             _leaves(unpack_model(blob, template))]):
+        assert np.isfinite(leaf)
 
 
 def _leaves(tree):
     import jax
 
     return jax.tree.leaves(tree)
+
+
+# ---------------------------------------------------------------------- #
+# deterministic chaos injector (metisfl_tpu/chaos) — the fast smoke tier
+# ---------------------------------------------------------------------- #
+
+@pytest.fixture()
+def chaos():
+    from metisfl_tpu import chaos as chaos_mod
+
+    chaos_mod.reset()
+    yield chaos_mod
+    chaos_mod.reset()
+
+
+@pytest.fixture()
+def echo_server():
+    from metisfl_tpu.comm.rpc import BytesService, RpcServer
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    state = {"count": 0}
+
+    def echo(payload: bytes) -> bytes:
+        state["count"] += 1
+        return payload
+
+    def parse_blob(payload: bytes) -> bytes:
+        # the integrity-checked model path: corrupt payloads must be
+        # rejected, not deserialized into garbage weights
+        ModelBlob.from_bytes(payload)
+        return b"ok"
+
+    server = RpcServer("127.0.0.1", 0)
+    server.add_service(BytesService(
+        "chaos.Echo", {"Echo": echo, "ParseBlob": parse_blob}))
+    port = server.start()
+    yield port, state
+    server.stop()
+
+
+def test_injector_schedule_is_seed_deterministic(chaos):
+    spec = {"seed": 42, "rules": [{"fault": "drop", "prob": 0.5}]}
+
+    def schedule(seed):
+        inj = chaos.ChaosInjector.from_spec({**spec, "seed": seed})
+        fired = []
+        for _ in range(64):
+            try:
+                inj.intercept("client", "s", "M", b"x")
+                fired.append(0)
+            except chaos.FaultInjected:
+                fired.append(1)
+        return fired
+
+    assert schedule(42) == schedule(42)       # replayable
+    assert sum(schedule(42)) > 0              # and actually fires
+    assert schedule(42) != schedule(43)       # seed changes the schedule
+
+
+def test_rule_counting_is_exact(chaos):
+    inj = chaos.ChaosInjector.from_spec({"rules": [
+        {"fault": "drop", "method": "M", "after_calls": 2, "max_fires": 1}]})
+    outcomes = []
+    for _ in range(5):
+        try:
+            inj.intercept("client", "s", "M", b"x")
+            outcomes.append("ok")
+        except chaos.FaultInjected:
+            outcomes.append("drop")
+    # skips exactly 2, fires exactly once, then exhausted
+    assert outcomes == ["ok", "ok", "drop", "ok", "ok"]
+    assert inj.fired_total() == 1
+
+
+def test_client_drop_exercises_retry_ladder(chaos, echo_server):
+    """Two injected client-side drops are absorbed by the UNAVAILABLE
+    retry ladder; the server sees exactly one invocation."""
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    chaos.configure({"rules": [
+        {"fault": "drop", "side": "client", "method": "Echo",
+         "max_fires": 2}]})
+    port, state = echo_server
+    client = RpcClient("127.0.0.1", port, "chaos.Echo", retry_sleep_s=0.05)
+    try:
+        assert client.call("Echo", b"payload", timeout=30) == b"payload"
+        assert state["count"] == 1
+        assert chaos.get().fired_total("drop") == 2
+    finally:
+        client.close()
+
+
+def test_server_drop_surfaces_unavailable_and_heals(chaos, echo_server):
+    """A server-side drop aborts the handler with UNAVAILABLE; the client
+    transparently retries and the next invocation goes through."""
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    chaos.configure({"rules": [
+        {"fault": "drop", "side": "server", "method": "Echo",
+         "max_fires": 1}]})
+    port, state = echo_server
+    client = RpcClient("127.0.0.1", port, "chaos.Echo", retry_sleep_s=0.05)
+    try:
+        assert client.call("Echo", b"x", timeout=30) == b"x"
+        # the dropped invocation aborted BEFORE the handler ran; only the
+        # retry reached it
+        assert state["count"] == 1
+        assert chaos.get().fired_total("drop") == 1
+    finally:
+        client.close()
+
+
+def test_corrupted_blob_rejected_as_invalid_argument(chaos, echo_server):
+    """Chaos corruption x integrity framing: a bit-flipped ModelBlob is
+    rejected as INVALID_ARGUMENT (checksum mismatch) instead of being
+    deserialized into garbage weights — and the rejection is counted."""
+    import grpc
+
+    from metisfl_tpu.comm.rpc import RpcClient
+    from metisfl_tpu.telemetry import metrics as tmetrics
+    from metisfl_tpu.tensor.pytree import pack_model
+
+    corrupt_counter = tmetrics.registry().counter(
+        "corrupt_payloads_total", "")
+    tmetrics.set_enabled(True)
+    before = corrupt_counter.value()
+    chaos.configure({"rules": [
+        {"fault": "corrupt", "side": "client", "method": "ParseBlob"}]})
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "chaos.Echo", retries=0)
+    blob = pack_model({"w": np.arange(64, dtype=np.float32)})
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            client.call("ParseBlob", blob, timeout=30)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "checksum" in err.value.details()
+        assert corrupt_counter.value() == before + 1
+        # uncorrupted control: the same call goes through
+        chaos.reset()
+        assert client.call("ParseBlob", blob, timeout=30) == b"ok"
+    finally:
+        client.close()
+
+
+def test_env_var_arms_injector(chaos, monkeypatch):
+    import json
+
+    monkeypatch.setenv(chaos.ENV_VAR, json.dumps(
+        {"seed": 3, "rules": [{"fault": "delay", "delay_s": 0.01}]}))
+    inj = chaos.install_from_env()
+    assert inj is not None and inj.seed == 3
+    assert chaos.get() is inj
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert chaos.install_from_env() is None  # env cleared → not re-armed
+
+
+def test_unknown_fault_rejected_at_config_time(chaos):
+    from metisfl_tpu.config import ChaosConfig, FederationConfig
+
+    with pytest.raises(ValueError, match="chaos"):
+        FederationConfig(chaos=ChaosConfig(
+            enabled=True, rules=[{"fault": "explode"}]))
+    with pytest.raises(ValueError, match="chaos"):
+        FederationConfig(chaos=ChaosConfig(
+            enabled=True, rules=[{"fault": "drop", "typo_key": 1}]))

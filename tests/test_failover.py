@@ -1,0 +1,492 @@
+"""Controller crash failover (ISSUE 2): checkpointed learner registry +
+auth tokens, controller-epoch re-attach, driver-side supervised restart,
+and the deterministic chaos kill that proves the whole composition.
+
+The protocol-level tests drive a bare :class:`Controller` over no-op
+proxies (the reference's fake-learner technique); the integration test at
+the bottom runs a real 2-process-learner gRPC federation, kills the
+controller mid-round via the seeded chaos injector, and requires the run
+to finish its rounds after automatic restart + learner re-attach."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import JoinReply, JoinRequest, TaskResult, TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    ChaosConfig,
+    CheckpointConfig,
+    EvalConfig,
+    FailoverConfig,
+    FederationConfig,
+    ModelStoreConfig,
+    TerminationConfig,
+)
+from metisfl_tpu.controller.core import Controller
+from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait(predicate, timeout_s=30.0, msg="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class _RecordingProxy:
+    def __init__(self, record, sink):
+        self._record = record
+        self._sink = sink
+
+    def run_task(self, task):
+        if self._sink is not None:
+            self._sink.append((self._record.learner_id, task))
+
+    def evaluate(self, task, callback):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+def _harness(tmp_path, tag, rule="fedavg", dispatched=None):
+    config = FederationConfig(
+        protocol="asynchronous",
+        aggregation=AggregationConfig(rule=rule, scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        model_store=ModelStoreConfig(store="disk",
+                                     root=str(tmp_path / f"store_{tag}"),
+                                     lineage_length=2),
+        checkpoint=CheckpointConfig(dir=str(tmp_path / f"ckpt_{tag}"),
+                                    every_n_rounds=1),
+    )
+    return Controller(config,
+                      lambda record: _RecordingProxy(record, dispatched))
+
+
+def _fake_model(seed):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal((4, 3)).astype(np.float32),
+            "b": rng.standard_normal((3,)).astype(np.float32)}
+
+
+def _submit(ctrl, lid, token, model, rounds_before, rule="fedavg"):
+    kwargs = {}
+    if rule == "scaffold":
+        # a deterministic params-shaped control delta per round
+        delta = {name: np.full_like(arr, 0.01 * (rounds_before + 1))
+                 for name, arr in model.items()}
+        kwargs["control_delta"] = pack_model(delta)
+    assert ctrl.task_completed(TaskResult(
+        task_id=f"t{rounds_before}_{lid}", learner_id=lid, auth_token=token,
+        model=pack_model(model), completed_batches=1, **kwargs))
+    _wait(lambda: ctrl.global_iteration > rounds_before,
+          msg=f"round {rounds_before + 1}")
+
+
+# ---------------------------------------------------------------------- #
+# checkpointed registry + tokens + epoch
+# ---------------------------------------------------------------------- #
+
+def test_checkpoint_restores_registry_tokens_and_party_indices(tmp_path):
+    ctrl = _harness(tmp_path, "reg")
+    ctrl.set_community_model(pack_model(_fake_model(0)))
+    joins = [ctrl.join(JoinRequest(hostname="h", port=7000 + i,
+                                   num_train_examples=5 + i,
+                                   capabilities={"party_index": i}))
+             for i in range(3)]
+    ctrl.save_checkpoint()
+    epoch1 = ctrl.controller_epoch
+    ctrl.shutdown()
+
+    ctrl2 = _harness(tmp_path, "reg")
+    try:
+        assert ctrl2.restore_checkpoint()
+        # a restart is a NEW incarnation — learners detect it by the epoch
+        assert ctrl2.controller_epoch != epoch1
+        assert sorted(ctrl2.active_learners()) == sorted(
+            j.learner_id for j in joins)
+        # credentialed rejoin is recognized as the same learner
+        reply = ctrl2.join(JoinRequest(hostname="h", port=7000,
+                                       previous_id=joins[0].learner_id,
+                                       auth_token=joins[0].auth_token))
+        assert reply.rejoined and reply.learner_id == joins[0].learner_id
+        assert reply.controller_epoch == ctrl2.controller_epoch
+        # masking/SCAFFOLD party indices survive the crash
+        with ctrl2._lock:
+            assert ctrl2._learners[joins[1].learner_id].party_index == 1
+            assert ctrl2._learners[joins[2].learner_id].num_train_examples == 7
+        # a completion under the checkpointed token is accepted (no
+        # re-auth dance needed for learners that never noticed the crash)
+        assert ctrl2.task_completed(TaskResult(
+            task_id="t", learner_id=joins[2].learner_id,
+            auth_token=joins[2].auth_token,
+            model=pack_model(_fake_model(1)), completed_batches=1))
+    finally:
+        ctrl2.shutdown()
+
+
+def test_endpoint_rejoin_without_credentials_keeps_identity(tmp_path):
+    """A learner that lost its credentials file re-registers from the same
+    host:port: it must reclaim its old id with a rotated token instead of
+    becoming a ghost duplicate (the old token stops validating)."""
+    ctrl = _harness(tmp_path, "ep")
+    ctrl.set_community_model(pack_model(_fake_model(0)))
+    first = ctrl.join(JoinRequest(hostname="h", port=7100,
+                                  num_train_examples=5))
+    again = ctrl.join(JoinRequest(hostname="h", port=7100,
+                                  num_train_examples=9))
+    try:
+        assert again.rejoined
+        assert again.learner_id == first.learner_id
+        assert again.auth_token != first.auth_token
+        assert len(ctrl.active_learners()) == 1
+        # the stale token no longer authenticates completions
+        assert not ctrl.task_completed(TaskResult(
+            task_id="t", learner_id=first.learner_id,
+            auth_token=first.auth_token, model=b""))
+        assert ctrl.task_completed(TaskResult(
+            task_id="t", learner_id=again.learner_id,
+            auth_token=again.auth_token,
+            model=pack_model(_fake_model(2)), completed_batches=1))
+    finally:
+        ctrl.shutdown()
+
+
+def test_resume_round_redispatches_restored_cohort(tmp_path):
+    """A restored controller re-dispatches the abandoned round to the
+    checkpointed cohort, stamped with the NEW epoch."""
+    ctrl = _harness(tmp_path, "resume")
+    ctrl.set_community_model(pack_model(_fake_model(0)))
+    joins = [ctrl.join(JoinRequest(hostname="h", port=7200 + i,
+                                   num_train_examples=5))
+             for i in range(2)]
+    import os
+    ckpt = os.path.join(ctrl.config.checkpoint.dir, "controller_ckpt.bin")
+    _wait(lambda: os.path.exists(ckpt), msg="join-time checkpoint")
+    ctrl.shutdown()
+
+    dispatched = []
+    ctrl2 = _harness(tmp_path, "resume", dispatched=dispatched)
+    try:
+        assert ctrl2.restore_checkpoint()
+        assert ctrl2.resume_round()
+        _wait(lambda: len(dispatched) >= 2, msg="resume dispatch")
+        lids = {lid for lid, _ in dispatched}
+        assert lids == {j.learner_id for j in joins}
+        for _, task in dispatched:
+            assert task.controller_epoch == ctrl2.controller_epoch
+            assert task.round_id == ctrl2.global_iteration
+    finally:
+        ctrl2.shutdown()
+
+
+def test_seed_model_is_checkpointed_before_round_one(tmp_path):
+    """A crash DURING round 1 (no per-round checkpoint yet) must still
+    restore the seeded community model — otherwise a failover restart has
+    nothing to train from."""
+    import os
+    ctrl = _harness(tmp_path, "seed")
+    seed = _fake_model(3)
+    ctrl.set_community_model(pack_model(seed))
+    ckpt = os.path.join(ctrl.config.checkpoint.dir, "controller_ckpt.bin")
+    _wait(lambda: os.path.exists(ckpt), msg="seed-time checkpoint")
+    ctrl.shutdown()
+    ctrl2 = _harness(tmp_path, "seed")
+    try:
+        assert ctrl2.restore_checkpoint()
+        blob = ModelBlob.from_bytes(ctrl2.community_model_bytes())
+        for name, arr in blob.tensors:
+            np.testing.assert_array_equal(arr, seed[name])
+    finally:
+        ctrl2.shutdown()
+
+
+# ---------------------------------------------------------------------- #
+# checkpoint round-trip across aggregator families (bit-for-bit)
+# ---------------------------------------------------------------------- #
+
+def _run_federation(tmp_path, rule, tag, crash_after_two):
+    seed = _fake_model(0)
+    m0a, m1a, m0b = _fake_model(1), _fake_model(2), _fake_model(3)
+    ctrl = _harness(tmp_path, tag, rule=rule)
+    ctrl.set_community_model(pack_model(seed))
+    joins = [ctrl.join(JoinRequest(hostname="h", port=5100 + i,
+                                   num_train_examples=10))
+             for i in range(2)]
+    ids = [(j.learner_id, j.auth_token) for j in joins]
+    _submit(ctrl, ids[0][0], ids[0][1], m0a, 0, rule)
+    _submit(ctrl, ids[1][0], ids[1][1], m1a, 1, rule)
+    if crash_after_two:
+        ctrl.shutdown()  # "crash": survives only via the checkpoint
+        ctrl = _harness(tmp_path, tag, rule=rule)
+        assert ctrl.restore_checkpoint()
+        assert ctrl.global_iteration == 2
+        # endpoint rejoin (no credentials): same identities, no ghosts
+        joins = [ctrl.join(JoinRequest(hostname="h", port=5100 + i,
+                                       num_train_examples=10))
+                 for i in range(2)]
+        assert [j.learner_id for j in joins] == [lid for lid, _ in ids]
+        assert all(j.rejoined for j in joins)
+        ids = [(j.learner_id, j.auth_token) for j in joins]
+    _submit(ctrl, ids[0][0], ids[0][1], m0b, 2, rule)
+    blob = ctrl.community_model_bytes()
+    control = ctrl._pack_scaffold_c() if rule == "scaffold" else b""
+    ctrl.shutdown()
+    return blob, control
+
+
+@pytest.mark.parametrize("rule", ["fedavg", "fedrec", "fedadam", "scaffold"])
+def test_checkpoint_resume_matches_uninterrupted(tmp_path, rule):
+    """One round after a kill-and-resume, the community model must match
+    the run that never crashed — FedAvg (stateless), FedRec (rolling sums
+    rebuilt from the store), FedAdam (server-opt moments), SCAFFOLD
+    (control variates)."""
+    expected_blob, expected_c = _run_federation(
+        tmp_path, rule, f"{rule}_nocrash", False)
+    resumed_blob, resumed_c = _run_federation(
+        tmp_path, rule, f"{rule}_crash", True)
+    if rule == "fedrec":
+        # rehydrate rebuilds the rolling sums from the store's lineage;
+        # the summation order differs from the incremental build, so
+        # compare numerically (everything else is bit-for-bit)
+        expected = dict(ModelBlob.from_bytes(expected_blob).tensors)
+        resumed = dict(ModelBlob.from_bytes(resumed_blob).tensors)
+        assert expected.keys() == resumed.keys()
+        for name in expected:
+            np.testing.assert_allclose(resumed[name], expected[name],
+                                       atol=1e-6)
+    else:
+        assert resumed_blob == expected_blob
+    assert resumed_c == expected_c
+
+
+# ---------------------------------------------------------------------- #
+# shutdown / deadline-timer race (ISSUE 2 satellite)
+# ---------------------------------------------------------------------- #
+
+def test_no_deadline_timer_survives_shutdown():
+    """A round task draining on the scheduling pool concurrently with
+    shutdown() must not re-arm the straggler timer after shutdown's
+    cancel — no timer may outlive shutdown (it would fire into the
+    torn-down pool)."""
+    cfg = FederationConfig(round_deadline_secs=300.0)
+    ctrl = Controller(cfg, lambda record: None)
+    ctrl._arm_round_deadline(restart=True)
+    # simulate the racing round task: it is already queued when shutdown
+    # starts draining, and it re-arms the deadline mid-drain
+    ctrl._pool.submit(ctrl._guard,
+                      lambda: (time.sleep(0.2),
+                               ctrl._arm_round_deadline(True)))
+    ctrl.shutdown()
+    _wait(lambda: (ctrl._deadline_timer is None
+                   or not ctrl._deadline_timer.is_alive()),
+          timeout_s=5, msg="timer death after shutdown")
+    # and a post-shutdown arm attempt is refused outright
+    ctrl._arm_round_deadline(restart=True)
+    assert (ctrl._deadline_timer is None
+            or not ctrl._deadline_timer.is_alive())
+
+
+# ---------------------------------------------------------------------- #
+# learner-side re-attach
+# ---------------------------------------------------------------------- #
+
+class _AmnesiacController:
+    """Fake ControllerProxy: flipping ``known`` to False models a
+    controller that restarted WITHOUT our registration — completions are
+    rejected until the learner re-joins."""
+
+    def __init__(self):
+        self.joins = 0
+        self.known = False
+        self.completions = []
+        self.epoch = "epoch-one"
+
+    def join(self, request):
+        self.joins += 1
+        self.known = True
+        return JoinReply(learner_id="L0", auth_token=f"tok{self.joins}",
+                         rejoined=bool(request.previous_id),
+                         controller_epoch=self.epoch)
+
+    def leave(self, learner_id, auth_token):
+        self.known = False
+        return True
+
+    def task_completed(self, result):
+        if not self.known or result.auth_token != f"tok{self.joins}":
+            return False
+        self.completions.append(result)
+        return True
+
+
+def _bare_learner(ctrl):
+    from metisfl_tpu.learner.learner import Learner
+    from metisfl_tpu.models import ArrayDataset
+
+    class _Ops:
+        def get_variables(self):
+            return {"w": np.zeros(2, np.float32)}
+
+    x = np.zeros((4, 2), np.float32)
+    learner = Learner(model_ops=_Ops(), controller=ctrl,
+                      train_dataset=ArrayDataset(x, np.zeros(4, np.int32)))
+    learner.reattach_retries = 3
+    learner.reattach_backoff_s = 0.01
+    return learner
+
+
+def test_rejected_completion_reattaches_and_resubmits():
+    ctrl = _AmnesiacController()
+    learner = _bare_learner(ctrl)
+    learner.join_federation()
+    assert learner.controller_epoch == "epoch-one"
+    # controller "restarts" without the registry: old token unknown
+    ctrl.known = False
+    ctrl.epoch = "epoch-two"
+    result = TaskResult(task_id="t1", learner_id=learner.learner_id,
+                        auth_token=learner.auth_token, model=b"")
+    assert learner._report_completion(result)
+    assert ctrl.joins == 2                      # one reattach join
+    assert learner.controller_epoch == "epoch-two"
+    assert len(ctrl.completions) == 1
+    # the resubmit carries the REFRESHED credentials
+    assert ctrl.completions[0].auth_token == learner.auth_token
+
+
+def test_epoch_mismatch_triggers_reattach():
+    ctrl = _AmnesiacController()
+    learner = _bare_learner(ctrl)
+    learner.join_federation()
+    ctrl.epoch = "epoch-two"                    # controller restarted
+    learner._check_controller_epoch("epoch-two")
+    assert ctrl.joins == 2
+    assert learner.controller_epoch == "epoch-two"
+    # same epoch → no further joins
+    learner._check_controller_epoch("epoch-two")
+    assert ctrl.joins == 2
+
+
+def test_deliberate_leave_never_reattaches():
+    """A straggling completion rejected AFTER leave_federation must not
+    re-register the learner behind the operator's back — whether the
+    delivery is rejected OR raises (controller unreachable)."""
+    ctrl = _AmnesiacController()
+    learner = _bare_learner(ctrl)
+    learner.join_federation()
+    learner.leave_federation()
+    result = TaskResult(task_id="t1", learner_id=learner.learner_id,
+                        auth_token=learner.auth_token, model=b"")
+    assert not learner._report_completion(result)
+    assert ctrl.joins == 1                      # no sneaky rejoin
+    # transport failure after a deliberate leave: same guarantee
+    def _boom(result):
+        raise RuntimeError("controller unreachable")
+    ctrl.task_completed = _boom
+    assert not learner._report_completion(result)
+    assert ctrl.joins == 1
+
+
+# ---------------------------------------------------------------------- #
+# the acceptance test: chaos-killed controller, supervised failover
+# ---------------------------------------------------------------------- #
+
+def test_controller_crash_failover_midround(tmp_path):
+    """Synchronous 2-learner gRPC federation; the seeded chaos injector
+    kills the controller on its FIRST MarkTaskCompleted (= mid-round,
+    after dispatch, as uplinks arrive). The driver must detect the death,
+    relaunch with --resume, the learners must re-attach, and the run must
+    still complete its target rounds with a consistent lineage and
+    ``controller_restarts_total == 1`` scraped from telemetry."""
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.comm.rpc import RpcClient
+    from metisfl_tpu.controller.service import LEARNER_SERVICE
+    from metisfl_tpu.driver.session import DriverSession
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.telemetry import parse_exposition
+
+    rng = np.random.default_rng(11)
+    w = rng.standard_normal((4, 2)).astype(np.float32)
+
+    def make_recipe(seed):
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+
+        def recipe():
+            ops = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                               np.zeros((2, 4), np.float32), rng_seed=0)
+            return ops, ArrayDataset(x, y, seed=seed)
+
+        return recipe
+
+    template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                            np.zeros((2, 4), np.float32),
+                            rng_seed=0).get_variables()
+    config = FederationConfig(
+        controller_port=_free_port(),
+        round_deadline_secs=45.0,  # backstop if the kill strands a round
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=3,
+                                      execution_cutoff_mins=6.0),
+        failover=FailoverConfig(max_controller_restarts=2,
+                                restart_backoff_s=0.5),
+        chaos=ChaosConfig(enabled=True, seed=7, rules=[
+            {"process": "controller", "side": "server", "fault": "kill",
+             "method": "MarkTaskCompleted", "max_fires": 1}]),
+    )
+    session = DriverSession(config, template,
+                            [make_recipe(0), make_recipe(1)],
+                            workdir=str(tmp_path))
+    restarts = telemetry.registry().counter(
+        "controller_restarts_total", "")
+    base_restarts = restarts.value()
+    try:
+        session.initialize_federation()
+        stats = session.monitor_federation(poll_every_s=1.0,
+                                           eval_drain_timeout_s=0)
+        assert stats["global_iteration"] >= 3, stats["global_iteration"]
+        # exactly one supervised restart, scraped from the telemetry
+        # exposition (not just the python counter object)
+        scraped = parse_exposition(telemetry.render_metrics())
+        assert scraped["controller_restarts_total"][()] - base_restarts == 1
+        # consistent lineage: round counters strictly monotone, every
+        # round's contributions unique (no double counting)
+        iters = [m["global_iteration"] for m in stats["round_metadata"]]
+        assert iters == sorted(set(iters)), iters
+        for meta in stats["round_metadata"]:
+            selected = meta["selected_learners"]
+            assert len(selected) == len(set(selected))
+            assert set(meta["train_received_at"]) <= set(stats["learners"])
+        # no ghost registrations: still exactly two learners
+        assert len(stats["learners"]) == 2, stats["learners"]
+        # at least one learner observed the new controller epoch and
+        # re-attached (scraped over the learner's GetMetrics RPC)
+        reattaches = 0.0
+        for ep in session._client.list_learners():
+            client = RpcClient(ep["hostname"], ep["port"], LEARNER_SERVICE,
+                               retries=1)
+            try:
+                text = client.call("GetMetrics", b"", timeout=15).decode()
+            finally:
+                client.close()
+            series = parse_exposition(text).get("learner_reattach_total", {})
+            reattaches += sum(series.values())
+        assert reattaches >= 1, "no learner ever re-attached"
+    finally:
+        session.shutdown_federation()

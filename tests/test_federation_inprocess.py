@@ -377,7 +377,11 @@ def test_restore_without_checkpoint_is_fresh_start(tmp_path):
     fed, _ = _make_federation(
         num_learners=2, checkpoint=CheckpointConfig(dir=str(tmp_path / "none")))
     try:
-        assert fed.controller.restore_checkpoint() is False
+        # restore from a dir no checkpoint was ever written to (the
+        # configured dir now receives a seed-time checkpoint the moment
+        # seed_model runs — crash-before-round-1 recoverability)
+        assert fed.controller.restore_checkpoint(
+            str(tmp_path / "never")) is False
         assert fed.controller.global_iteration == 0
     finally:
         fed.shutdown()

@@ -271,6 +271,112 @@ def test_async_error_without_callback_is_counted_and_logged(
     client.close()
 
 
+# ---------------------------------------------------------------------- #
+# default deadlines (comm.default_deadline_s) + status mapping
+# ---------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def slow_server():
+    import time as _time
+
+    from metisfl_tpu.comm.rpc import BytesService, RpcServer
+
+    state = {"calls": 0}
+
+    def sleepy(payload: bytes) -> bytes:
+        _time.sleep(1.0)
+        return b"late"
+
+    def flaky(payload: bytes) -> bytes:
+        # first invocation hangs past the client deadline; the retry is fast
+        state["calls"] += 1
+        if state["calls"] == 1:
+            _time.sleep(1.0)
+        return b"ok"
+
+    def reject(payload: bytes) -> bytes:
+        raise ValueError("malformed widget")
+
+    server = RpcServer("127.0.0.1", 0)
+    server.add_service(BytesService(
+        "test.Slow", {"Sleepy": sleepy, "Flaky": flaky, "Reject": reject}))
+    port = server.start()
+    yield port, state
+    server.stop()
+
+
+def test_default_deadline_bounds_unbounded_calls(slow_server):
+    """timeout=None no longer means unbounded: the client-level default
+    deadline applies, so one hung peer cannot park a thread forever."""
+    import grpc
+
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    port, _ = slow_server
+    client = RpcClient("127.0.0.1", port, "test.Slow", retries=0,
+                       default_deadline_s=0.2)
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            client.call("Sleepy", b"")  # no explicit timeout
+        assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    finally:
+        client.close()
+
+
+def test_deadline_default_can_be_disabled(slow_server):
+    """default_deadline_s <= 0 restores the old unbounded behavior."""
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    port, _ = slow_server
+    client = RpcClient("127.0.0.1", port, "test.Slow", retries=0,
+                       default_deadline_s=0)
+    try:
+        assert client.call("Sleepy", b"") == b"late"
+    finally:
+        client.close()
+
+
+def test_deadline_exceeded_retried_only_for_idempotent(slow_server):
+    import grpc
+
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    port, state = slow_server
+    client = RpcClient("127.0.0.1", port, "test.Slow", retries=3,
+                       retry_sleep_s=0.05, default_deadline_s=0.4)
+    try:
+        # non-idempotent (default): DEADLINE_EXCEEDED is terminal
+        with pytest.raises(grpc.RpcError) as err:
+            client.call("Flaky", b"")
+        assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        state["calls"] = 0
+        # idempotent: the deadline miss is retried and the retry lands
+        assert client.call("Flaky", b"", idempotent=True) == b"ok"
+        assert state["calls"] == 2
+    finally:
+        client.close()
+
+
+def test_value_error_maps_to_invalid_argument(slow_server):
+    """Malformed-input rejections (codec framing, blob integrity) surface
+    as INVALID_ARGUMENT, not INTERNAL — retry ladders must not treat a
+    corrupt payload as a transient server failure."""
+    import grpc
+
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    port, _ = slow_server
+    client = RpcClient("127.0.0.1", port, "test.Slow", retries=0)
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            client.call("Reject", b"", timeout=10)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "malformed widget" in err.value.details()
+    finally:
+        client.close()
+
+
 def _available_ram_gb() -> float:
     try:
         with open("/proc/meminfo") as f:
