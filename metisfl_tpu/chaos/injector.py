@@ -44,6 +44,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 
 logger = logging.getLogger("metisfl_tpu.chaos")
@@ -145,9 +146,17 @@ class ChaosInjector:
                     continue
                 rule.fired += 1
             _M_FAULTS.inc(fault=rule.fault, side=side, method=method)
+            _tevents.emit(_tevents.FaultInjected, fault=rule.fault,
+                          side=side, method=method)
             logger.warning("chaos: firing %s on %s %s/%s (fire %d)",
                            rule.fault, side, service, method, rule.fired)
             if rule.fault == "kill":
+                # flight recorder first: the dying process's event ring +
+                # open spans ARE the post-mortem this kill exists to test
+                # (telemetry/postmortem.py; no-op when unconfigured)
+                from metisfl_tpu.telemetry import postmortem as _postmortem
+                _postmortem.dump("chaos_kill",
+                                 extra={"method": method, "side": side})
                 # flush the warning before dying — the whole point is a
                 # diagnosable crash
                 logging.shutdown()

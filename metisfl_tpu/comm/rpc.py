@@ -21,6 +21,7 @@ and the client transparently retries over the chunked path.
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from concurrent import futures
@@ -29,6 +30,7 @@ from typing import Callable, Dict, Optional
 import grpc
 
 from metisfl_tpu import chaos as _chaos
+from metisfl_tpu.telemetry import events as _events
 from metisfl_tpu.telemetry import metrics as _metrics
 from metisfl_tpu.telemetry import trace as _trace
 
@@ -114,12 +116,39 @@ def _iter_chunks(payload: bytes):
 
 
 class BytesService:
-    """A named set of unary bytes→bytes methods served over gRPC."""
+    """A named set of unary bytes→bytes methods served over gRPC.
+
+    Every service automatically answers ``ListMethods`` (the reference's
+    gRPC-reflection role): the dispatch table's method names plus the
+    transport capability flags — every method doubles as a chunked
+    stream, and oversize unary responses fall back to it. The reply is
+    JSON (not the wire codec) so generic tooling — the status CLI's
+    endpoint probe, a curl through grpcurl — can read it without this
+    package.
+
+    Handler contract: a handler whose response can exceed
+    :data:`UNARY_RESPONSE_LIMIT` MUST be idempotent — the oversize
+    fallback refuses the unary response after the handler already ran
+    and the client transparently re-invokes it over the chunked method,
+    so such a handler executes twice per logical call (fine for getters
+    like GetCommunityModel; a non-idempotent method must keep its
+    responses under the limit or route clients to chunked up front).
+    """
 
     def __init__(self, service_name: str,
                  handlers: Dict[str, Callable[[bytes], bytes]]):
         self.service_name = service_name
         self.handlers = dict(handlers)
+        self.handlers.setdefault("ListMethods", self._list_methods)
+
+    def _list_methods(self, raw: bytes) -> bytes:
+        methods = [
+            {"name": name, "transports": ["unary", "chunked"],
+             "oversize_unary_fallback": True}
+            for name in sorted(self.handlers)
+        ]
+        return json.dumps({"service": self.service_name,
+                           "methods": methods}).encode("utf-8")
 
     def _generic_handler(self) -> grpc.GenericRpcHandler:
         method_handlers = {}
@@ -189,7 +218,11 @@ class BytesService:
                         BytesService._abort(context, exc)
                 if len(result) > UNARY_RESPONSE_LIMIT:
                     # cannot frame this as one message — the client retries
-                    # over the chunked method on this exact status+detail
+                    # over the chunked method on this exact status+detail.
+                    # NOTE the handler has already run to completion here
+                    # and will run AGAIN on the retry: only idempotent
+                    # handlers may return oversize responses (see the
+                    # BytesService class docstring).
                     context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                                   _OVERSIZE_MARK)
                 _M_SERVER_BYTES.inc(len(result), service=service,
@@ -369,6 +402,9 @@ class RpcClient:
                         chunked = True
                         retried = 1
                         self._chunked_methods.add(method)
+                        _events.emit(_events.RetryScheduled,
+                                     service=self.service_name,
+                                     method=method, code="OVERSIZE_UNARY")
                         continue
                     retryable = (code == grpc.StatusCode.UNAVAILABLE
                                  or (idempotent and code
@@ -380,6 +416,10 @@ class RpcClient:
                                        self.target, method,
                                        code.name.lower(), attempt,
                                        self.retries)
+                        _events.emit(_events.RetryScheduled,
+                                     service=self.service_name,
+                                     method=method, code=code.name,
+                                     attempt=attempt)
                         time.sleep(self.retry_sleep_s)
                         continue
                     _M_CLIENT_ERRORS.inc(service=self.service_name,
@@ -402,23 +442,44 @@ class RpcClient:
                            wait_for_ready=wait_ready,
                            metadata=_trace.outbound_metadata()))
 
+    @staticmethod
+    def _resolve(outer: "futures.Future", result=None,
+                 exc: Optional[Exception] = None) -> None:
+        """Resolve the caller-facing wrapper future, tolerating a caller
+        that cancelled it while the call was in flight."""
+        try:
+            if exc is not None:
+                outer.set_exception(exc)
+            else:
+                outer.set_result(result)
+        except futures.InvalidStateError:  # pragma: no cover - cancelled
+            pass
+
     def call_async(self, method: str, payload: bytes,
                    callback: Optional[Callable[[bytes], None]] = None,
                    error_callback: Optional[Callable[[Exception], None]] = None,
                    timeout: Optional[float] = None,
-                   wait_ready: bool = True):
+                   wait_ready: bool = True) -> "futures.Future":
         """Non-blocking unary call (the reference's CompletionQueue pattern,
         controller.cc:713-759, via grpc futures). ``wait_ready=False`` fails
         fast with UNAVAILABLE on a dead endpoint instead of queueing.
         Payloads above STREAM_THRESHOLD (and oversize unary responses)
         route through the chunked stream on a worker thread — stream
-        draining has no grpc-future form."""
+        draining has no grpc-future form.
+
+        Returns a wrapper :class:`concurrent.futures.Future` resolved
+        only by the FINAL outcome: a unary attempt refused oversize
+        retries transparently over the chunked stream, and the wrapper
+        stays pending until that retry settles — the caller never sees a
+        failure for a call that then succeeds (the ADVICE r5 double
+        signal). Callbacks fire exactly once either way."""
         # capture the span context HERE, on the caller's thread: grpc
         # completion callbacks and the stream pool run in their own
         # (empty) contextvars contexts, so an oversize retry issued from
         # _done would otherwise lose the trace parent
         ctx = _trace.current_context()
         t0 = time.perf_counter()
+        outer: "futures.Future" = futures.Future()
         if timeout is None:
             timeout = self.default_deadline_s
         inj = _chaos.get()
@@ -430,9 +491,10 @@ class RpcClient:
                                     payload)
         if (len(payload) > STREAM_THRESHOLD
                 or method in self._chunked_methods):
-            return self._async_chunked(method, payload, callback,
-                                       error_callback, timeout, wait_ready,
-                                       ctx=ctx, t0=t0)
+            self._async_chunked(method, payload, callback,
+                                error_callback, timeout, wait_ready,
+                                ctx=ctx, t0=t0, outer=outer)
+            return outer
         fn = self._channel.unary_unary(
             f"/{self.service_name}/{method}",
             request_serializer=_IDENTITY,
@@ -449,11 +511,16 @@ class RpcClient:
                         and exc.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
                         and _OVERSIZE_MARK in (exc.details() or "")):
                     self._chunked_methods.add(method)
+                    _events.emit(_events.RetryScheduled,
+                                 service=self.service_name,
+                                 method=method, code="OVERSIZE_UNARY")
                     # still ONE logical call — the chunked leg records it
-                    # (with retried="1"), not this failed unary attempt
+                    # (with retried="1"), not this failed unary attempt;
+                    # the wrapper future resolves only with ITS outcome
                     self._async_chunked(method, payload, callback,
                                         error_callback, timeout, wait_ready,
-                                        retried="1", ctx=ctx, t0=t0)
+                                        retried="1", ctx=ctx, t0=t0,
+                                        outer=outer)
                     return
                 # never invisible: count the failure whether or not the
                 # caller asked to hear about it — and keep the logical-call
@@ -462,6 +529,7 @@ class RpcClient:
                                      method=method,
                                      code=_error_code_name(exc))
                 self._record_client_call(method, "0", t0)
+                self._resolve(outer, exc=exc)
                 if error_callback is not None:
                     error_callback(exc)
                 else:
@@ -470,11 +538,12 @@ class RpcClient:
                 return
             self._record_client_call(method, "0", t0, sent=len(payload),
                                      received=len(result))
+            self._resolve(outer, result=result)
             if callback is not None:
                 callback(result)
 
         future.add_done_callback(_done)
-        return future
+        return outer
 
     def _record_client_call(self, method: str, retried: str, t0: float,
                             sent: Optional[int] = None,
@@ -495,12 +564,14 @@ class RpcClient:
 
     def _async_chunked(self, method, payload, callback, error_callback,
                        timeout, wait_ready, retried: str = "0",
-                       ctx=None, t0: Optional[float] = None):
+                       ctx=None, t0: Optional[float] = None,
+                       outer: Optional["futures.Future"] = None):
         # ``ctx``/``t0`` arrive from call_async's caller thread (a grpc
         # completion thread has no useful contextvars state); direct
         # callers fall back to capturing here. ``retried="1"`` marks this
         # leg as the transparent continuation of a failed unary attempt —
-        # one logical call either way.
+        # one logical call either way, and ``outer`` (the caller-facing
+        # wrapper future) resolves only with THIS leg's final outcome.
         if ctx is None:
             ctx = _trace.current_context()
         if t0 is None:
@@ -516,6 +587,8 @@ class RpcClient:
                                      method=method,
                                      code=_error_code_name(exc))
                 self._record_client_call(method, retried, t0)
+                if outer is not None:
+                    self._resolve(outer, exc=exc)
                 if error_callback is not None:
                     error_callback(exc)
                 else:
@@ -525,10 +598,29 @@ class RpcClient:
             self._record_client_call(method, retried, t0,
                                      sent=len(payload),
                                      received=len(result))
+            if outer is not None:
+                self._resolve(outer, result=result)
             if callback is not None:
                 callback(result)
 
-        return self._stream_pool.submit(_run)
+        try:
+            return self._stream_pool.submit(_run)
+        except RuntimeError as exc:
+            # pool already shut down (client.close() raced the oversize
+            # retry issued from a grpc completion thread): the wrapper
+            # future must still settle — a swallowed submit failure would
+            # leave the caller blocked on it forever
+            _M_CLIENT_ERRORS.inc(service=self.service_name, method=method,
+                                 code="UNKNOWN")
+            self._record_client_call(method, retried, t0)
+            if outer is not None:
+                self._resolve(outer, exc=exc)
+            if error_callback is not None:
+                error_callback(exc)
+            else:
+                logger.warning("async chunked RPC %s could not be "
+                               "scheduled: %s", method, exc)
+            return None
 
     def close(self) -> None:
         self._stream_pool.shutdown(wait=False)

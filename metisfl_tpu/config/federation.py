@@ -91,10 +91,23 @@ class SecureAggConfig:
 
 
 @dataclass
+class EventsConfig:
+    """Structured event journal (telemetry/events.py): typed federation
+    events (joins, rounds, dispatches, retries, faults) in a bounded
+    in-memory ring + JSONL sink (under ``telemetry.dir``). The ring tail
+    rides in ``DescribeFederation`` snapshots and post-mortem bundles.
+    ``enabled=false`` makes every emit call site a one-attribute-check
+    no-op (telemetry.enabled=false implies it)."""
+
+    enabled: bool = True
+    ring_size: int = 512
+
+
+@dataclass
 class TelemetryConfig:
     """Federation-wide observability (metisfl_tpu/telemetry): trace spans
-    + metrics registry. ``enabled=false`` opts the whole subsystem out
-    (instrument call sites become attribute-check no-ops)."""
+    + metrics registry + event journal. ``enabled=false`` opts the whole
+    subsystem out (instrument call sites become attribute-check no-ops)."""
 
     enabled: bool = True
     # JSONL trace-sink directory. "" → spans are not persisted (ids and
@@ -105,6 +118,12 @@ class TelemetryConfig:
     # learners take --metrics-port on their CLI instead (N learners on
     # one host cannot share a configured port)
     http_port: int = 0
+    # event journal (telemetry/events.py)
+    events: EventsConfig = field(default_factory=EventsConfig)
+    # flight-recorder bundle directory (telemetry/postmortem.py): crash /
+    # chaos-kill / failover post-mortems land here. "" → recorder off;
+    # the driver fills this in with <workdir>/postmortem.
+    postmortem_dir: str = ""
 
 
 @dataclass

@@ -42,7 +42,10 @@ def main(argv=None) -> int:
             config = FederationConfig.from_wire(f.read())
 
     from metisfl_tpu import telemetry
-    telemetry.apply_config(config.telemetry, service="controller")
+    import hashlib
+    config_hash = hashlib.sha256(config.to_wire()).hexdigest()[:16]
+    telemetry.apply_config(config.telemetry, service="controller",
+                           config_hash=config_hash)
     metrics_http = None
     if config.telemetry.enabled and config.telemetry.http_port > 0:
         from metisfl_tpu.telemetry.httpd import start_metrics_http
@@ -95,6 +98,7 @@ def main(argv=None) -> int:
     if metrics_http is not None:
         metrics_http.close()
     telemetry.trace.flush()
+    telemetry.events.flush()
     return 0
 
 

@@ -53,6 +53,7 @@ from metisfl_tpu.scaling import apply_staleness_decay, make_scaler
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
 from metisfl_tpu.selection import make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
+from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.tensor.pytree import ModelBlob
@@ -77,6 +78,22 @@ _M_ACTIVE_LEARNERS = _REG.gauge(
     "controller_active_learners", "Currently registered learners")
 _M_AGG_FAILURES = _REG.counter(
     "aggregation_failures_total", "Aggregation attempts that raised")
+_M_STRAGGLER = _REG.gauge(
+    "learner_straggler_score",
+    "Round-relative straggler score: EWMA train duration over the "
+    "cohort median (1.0 = typical, >1 = slower)", ("learner",))
+
+# EWMA smoothing for per-learner train/eval durations (straggler
+# analytics): ~the last 3-4 rounds dominate, so a recovered learner's
+# score decays within a few rounds instead of dragging forever
+_EWMA_ALPHA = 0.3
+
+
+def _ewma(prev: float, observation: float) -> float:
+    """First observation seeds the average; later ones alpha-blend."""
+    if prev <= 0.0:
+        return observation
+    return _EWMA_ALPHA * observation + (1.0 - _EWMA_ALPHA) * prev
 
 
 class LearnerProxy(Protocol):
@@ -116,6 +133,10 @@ class LearnerRecord:
     party_index: int = -1
     # per-learner train overrides (semi-sync step budgets)
     local_steps_override: int = 0
+    # EWMA dispatch→completion durations (straggler analytics; feeds the
+    # DescribeFederation snapshot and learner_straggler_score)
+    ewma_train_s: float = 0.0
+    ewma_eval_s: float = 0.0
     proxy: Optional[LearnerProxy] = None
 
 
@@ -247,6 +268,14 @@ class Controller:
                                         thread_name_prefix="ctrl-sched")
         self._shutdown = threading.Event()
         self._tasks_in_flight: Dict[str, str] = {}  # task_id -> learner_id
+        # task_id -> dispatch wall-clock, maintained in lockstep with
+        # _tasks_in_flight: DescribeFederation reports in-flight ages and
+        # completions feed the per-learner EWMA train durations from it
+        self._task_dispatched_at: Dict[str, float] = {}
+        # coarse live phase for the status plane ("what is the controller
+        # doing RIGHT NOW"): idle | dispatch | wait_uplinks | select |
+        # aggregate | halted
+        self._phase = "idle"
         # straggler-deadline state: each dispatch bumps the serial so a
         # deadline timer from a completed round never fires on the next one
         self._round_serial = 0
@@ -307,6 +336,10 @@ class Controller:
                 record.proxy = self._proxy_factory(record)
                 record.dispatch_failures = 0  # fresh endpoint, assume live
                 logger.info("learner %s rejoined", record.learner_id)
+                _tevents.emit(_tevents.LearnerJoined,
+                              learner_id=record.learner_id,
+                              hostname=record.hostname, port=record.port,
+                              rejoined=True)
                 # Re-dispatch the current community model so a crash-restarted
                 # learner rejoins the in-flight round instead of idling until
                 # the next dispatch (the reference leaves the sync round
@@ -351,6 +384,10 @@ class Controller:
                     logger.info("learner %s re-registered from its endpoint "
                                 "%s:%d (token rotated)", match.learner_id,
                                 request.hostname, request.port)
+                    _tevents.emit(_tevents.LearnerJoined,
+                                  learner_id=match.learner_id,
+                                  hostname=match.hostname, port=match.port,
+                                  rejoined=True)
                     if not self._shutdown.is_set():
                         self._pool.submit(self._guard, self._schedule_initial,
                                           match.learner_id)
@@ -374,6 +411,8 @@ class Controller:
             _M_ACTIVE_LEARNERS.set(len(self._learners))
         logger.info("learner %s joined (%d train examples)",
                     learner_id, request.num_train_examples)
+        _tevents.emit(_tevents.LearnerJoined, learner_id=learner_id,
+                      hostname=request.hostname, port=request.port)
         # Control handoff exactly like controller.cc:163-164: initial task is
         # scheduled off the join path.
         if not self._shutdown.is_set():
@@ -392,11 +431,21 @@ class Controller:
                 return False
             del self._learners[learner_id]
             _M_ACTIVE_LEARNERS.set(len(self._learners))
+            # a departed learner's tasks can never complete: without this
+            # prune (and with no round deadline configured) they would sit
+            # in the in-flight map forever, and DescribeFederation would
+            # report ghost tasks with ever-growing ages
+            for tid in [t for t, lid in self._tasks_in_flight.items()
+                        if lid == learner_id]:
+                self._tasks_in_flight.pop(tid, None)
+                self._task_dispatched_at.pop(tid, None)
         # bounded metric cardinality under churn: a departed learner's
         # per-learner series must not accumulate for the process lifetime
         _M_UPLINK.remove(learner=learner_id)
+        _M_STRAGGLER.remove(learner=learner_id)
         self._store.erase([learner_id])
         logger.info("learner %s left", learner_id)
+        _tevents.emit(_tevents.LearnerLost, learner_id=learner_id)
         # Re-evaluate the round barrier: if the departed learner was the last
         # pending one, no completion event would ever release the round.
         if not self._shutdown.is_set():
@@ -550,6 +599,13 @@ class Controller:
             if result.processing_ms_per_step > 0:
                 record.ms_per_step = result.processing_ms_per_step
             self._tasks_in_flight.pop(result.task_id, None)
+            dispatched_at = self._task_dispatched_at.pop(result.task_id, 0.0)
+            if dispatched_at:
+                # EWMA dispatch→completion duration (straggler analytics).
+                # Expired-task completions count too — a straggler's late
+                # arrival is exactly the observation the score needs.
+                record.ewma_train_s = _ewma(record.ewma_train_s,
+                                            max(0.0, start - dispatched_at))
             # A completion for a task the deadline already expired: keep the
             # model (fresh data for later rounds) but do not advance the
             # current round's barrier — and keep its timings out of the
@@ -564,6 +620,10 @@ class Controller:
             # and prunes the series after — an unlocked inc here could
             # interleave and resurrect a departed learner's series
             _M_UPLINK.inc(len(result.model), learner=result.learner_id)
+        _tevents.emit(_tevents.TaskCompleted, task_id=result.task_id,
+                      learner_id=result.learner_id, round=result.round_id,
+                      stale=stale, uplink_bytes=len(result.model))
+        self._update_straggler_gauge()
 
         if stale and self._topk_uplink():
             # a topk payload is a delta against the community model AT
@@ -692,6 +752,12 @@ class Controller:
             while len(self._expired_tasks) > 512:
                 self._expired_tasks.pop(next(iter(self._expired_tasks)))
             self._tasks_in_flight.clear()
+            # keep dispatch stamps only for tasks a late completion can
+            # still reference (the bounded expired set) — the EWMA pop
+            # needs them, everything else would leak
+            self._task_dispatched_at = {
+                tid: t for tid, t in self._task_dispatched_at.items()
+                if tid in self._expired_tasks}
         cohort = self._scheduler.expire_pending(self.active_learners())
         dropped = sorted(set(pending.values()))
         if cohort:
@@ -765,14 +831,23 @@ class Controller:
                 # aggregation-failure retry opens a second wait barrier
                 # and both belong to this round's total
                 self._current_meta.wait_duration_ms += wait_sp.duration_ms
+        with self._lock:
+            self._phase = "select"
         select_sp = _ttrace.span("round.select", parent=self._round_span,
                                  attrs={"cohort": len(cohort)})
         with select_sp:
             selected = self._selector.select(cohort, self.active_learners())
         _M_PHASE.observe(select_sp.duration_ms / 1e3, phase="select")
+        with self._lock:
+            self._phase = "aggregate"
         try:
             self._compute_community_model(selected)
             self._agg_failures = 0
+            with self._lock:
+                agg_ms = self._current_meta.aggregation_duration_ms
+            _tevents.emit(_tevents.AggregationDone,
+                          round=self.global_iteration,
+                          selected=len(selected), duration_ms=round(agg_ms, 3))
         except Exception as exc:
             _M_AGG_FAILURES.inc()
             self._agg_failures += 1
@@ -791,6 +866,7 @@ class Controller:
                 # debugging THIS round needs it in the sink
                 with self._lock:
                     round_sp, self._round_span = self._round_span, None
+                    self._phase = "halted"
                 if round_sp is not None:
                     round_sp.set_attr("error", f"aggregation halted: {exc!r}")
                     round_sp.end()
@@ -1217,6 +1293,7 @@ class Controller:
         # sampling means it can be a strict subset of the active learners).
         self._scheduler.notify_dispatched(list(learner_ids))
         with self._lock:
+            self._phase = "dispatch"
             if not self._current_meta.started_at:
                 # first dispatch of this round == round start
                 # (reference controller.cc:406-418); the round span is the
@@ -1226,6 +1303,9 @@ class Controller:
                 self._round_span = _ttrace.span(
                     "round", parent=None,
                     attrs={"round": self.global_iteration})
+                _tevents.emit(_tevents.RoundStarted,
+                              round=self.global_iteration,
+                              cohort=len(learner_ids))
             round_span = self._round_span
         dispatch_sp = _ttrace.span("round.dispatch", parent=round_span,
                                    attrs={"learners": len(learner_ids)})
@@ -1250,14 +1330,20 @@ class Controller:
                         controller_epoch=self.controller_epoch,
                     )
                     self._tasks_in_flight[task.task_id] = lid
+                    self._task_dispatched_at[task.task_id] = time.time()
                     self._current_meta.train_submitted_at[lid] = time.time()
                     proxy = record.proxy
+                # journaled BEFORE the send: if the send (or an injected
+                # fault) kills the process, the flight recorder still
+                # shows what was dispatched
+                _tevents.emit(_tevents.TaskDispatched, task_id=task.task_id,
+                              learner_id=lid, round=task.round_id)
                 try:
                     if hasattr(proxy, "run_task_with_callback"):
                         # async transports surface failures via callback
                         proxy.run_task_with_callback(
-                            task, lambda exc, lid=lid:
-                            self._note_dispatch_failure(lid, exc))
+                            task, lambda exc, lid=lid, tid=task.task_id:
+                            self._note_dispatch_failure(lid, exc, tid))
                     else:
                         proxy.run_task(task)
                 except Exception as exc:
@@ -1267,9 +1353,10 @@ class Controller:
                     # deadline / membership changes, and _sample_cohort skips
                     # learners past the consecutive-failure limit.
                     logger.exception("train dispatch to %s failed", lid)
-                    self._note_dispatch_failure(lid, exc)
+                    self._note_dispatch_failure(lid, exc, task.task_id)
         _M_PHASE.observe(dispatch_sp.duration_ms / 1e3, phase="dispatch")
         with self._lock:
+            self._phase = "wait_uplinks"
             # accumulate: join/rejoin re-dispatches add to the same round
             self._current_meta.dispatch_duration_ms += dispatch_sp.duration_ms
             if self._wait_span is None and learner_ids:
@@ -1277,8 +1364,17 @@ class Controller:
                                                parent=round_span)
         self._arm_round_deadline(restart=restart_deadline)
 
-    def _note_dispatch_failure(self, learner_id: str, exc: Exception) -> None:
+    def _note_dispatch_failure(self, learner_id: str, exc: Exception,
+                               task_id: str = "") -> None:
         with self._lock:
+            if task_id:
+                # the task never reached the learner, so no completion can
+                # ever pop it — without this (and with no round deadline)
+                # it would be a forever-"in-flight" ghost in the status
+                # plane. The scheduler's round barrier is unaffected: it
+                # tracks the dispatched cohort, not task ids.
+                self._tasks_in_flight.pop(task_id, None)
+                self._task_dispatched_at.pop(task_id, None)
             record = self._learners.get(learner_id)
             if record is None:
                 return
@@ -1336,7 +1432,13 @@ class Controller:
                         entry=entry, meta=meta):
                 with self._lock:
                     entry["evaluations"][lid] = result.evaluations
-                    meta.eval_received_at[lid] = time.time()
+                    now = time.time()
+                    meta.eval_received_at[lid] = now
+                    rec = self._learners.get(lid)
+                    sent = meta.eval_submitted_at.get(lid, 0.0)
+                    if rec is not None and sent:
+                        rec.ewma_eval_s = _ewma(rec.ewma_eval_s,
+                                                max(0.0, now - sent))
 
             try:
                 with eval_sp.activate():
@@ -1383,7 +1485,11 @@ class Controller:
                      "ms_per_step": float(r.ms_per_step),
                      "last_result_round": r.last_result_round,
                      "party_index": r.party_index,
-                     "local_steps_override": r.local_steps_override}
+                     "local_steps_override": r.local_steps_override,
+                     # straggler analytics survive a failover restart so
+                     # scores do not reset to "everyone is typical"
+                     "ewma_train_s": float(r.ewma_train_s),
+                     "ewma_eval_s": float(r.ewma_eval_s)}
                     for r in self._learners.values()],
             }
             # Rolling rules (FedRec) carry cross-round state; persist the
@@ -1510,6 +1616,85 @@ class Controller:
         logger.info("resuming round %d after restore: dispatching to %s",
                     self.global_iteration, cohort)
         self._dispatch_train(cohort)
+
+    # ------------------------------------------------------------------ #
+    # live status plane (DescribeFederation)
+    # ------------------------------------------------------------------ #
+
+    def _straggler_scores(self) -> Dict[str, float]:
+        """Round-relative straggler scores: each learner's EWMA train
+        duration over the registry median (1.0 = typical, >1 = slower,
+        0.0 = no observation yet). Call with ``self._lock`` held."""
+        from statistics import median
+
+        ewmas = {lid: r.ewma_train_s for lid, r in self._learners.items()}
+        positive = [v for v in ewmas.values() if v > 0.0]
+        mid = median(positive) if positive else 0.0
+        return {lid: (v / mid if (v > 0.0 and mid > 0.0) else 0.0)
+                for lid, v in ewmas.items()}
+
+    def _update_straggler_gauge(self) -> None:
+        # set() under the controller lock, like _M_UPLINK.inc: leave()
+        # deletes the record under this lock and prunes the series after,
+        # so an unlocked set here could interleave and resurrect a
+        # departed learner's series (unbounded cardinality under churn)
+        with self._lock:
+            for lid, score in self._straggler_scores().items():
+                _M_STRAGGLER.set(round(score, 4), learner=lid)
+
+    def describe(self, event_tail: int = 50) -> Dict[str, Any]:
+        """Live federation snapshot for the ``DescribeFederation`` RPC /
+        ``python -m metisfl_tpu.status`` watch CLI: current round + phase,
+        per-learner liveness and straggler analytics, in-flight tasks,
+        store occupancy, and the event-ring tail. Read-only and cheap —
+        safe to poll every couple of seconds."""
+        now = time.time()
+        with self._lock:
+            scores = self._straggler_scores()
+            limit = self.config.max_dispatch_failures
+            learners = [
+                {
+                    "learner_id": r.learner_id,
+                    "hostname": r.hostname,
+                    "port": r.port,
+                    # liveness mirrors _sample_cohort's exclusion rule
+                    "live": limit <= 0 or r.dispatch_failures < limit,
+                    "dispatch_failures": r.dispatch_failures,
+                    "num_train_examples": r.num_train_examples,
+                    "last_result_round": r.last_result_round,
+                    "ewma_train_s": round(r.ewma_train_s, 3),
+                    "ewma_eval_s": round(r.ewma_eval_s, 3),
+                    "straggler_score": round(scores.get(lid, 0.0), 4),
+                }
+                for lid, r in sorted(self._learners.items())
+            ]
+            in_flight = [
+                {"task_id": tid, "learner_id": lid,
+                 "age_s": round(max(
+                     0.0, now - self._task_dispatched_at.get(tid, now)), 3)}
+                for tid, lid in self._tasks_in_flight.items()
+            ]
+            snapshot = {
+                "controller_epoch": self.controller_epoch,
+                "round": self.global_iteration,
+                "phase": self._phase,
+                "protocol": self.config.protocol,
+                "round_started_at": self._current_meta.started_at,
+                "aggregation_rule": self._aggregator.name,
+                "shutdown": self._shutdown.is_set(),
+            }
+        # store occupancy OUTSIDE our lock (the store has its own)
+        occupancy = {lid: self._store.size(lid)
+                     for lid in self._store.learner_ids()}
+        snapshot.update({
+            "learners": learners,
+            "in_flight": in_flight,
+            "store": {"models": occupancy,
+                      "total": sum(occupancy.values())},
+            "events": _tevents.tail(event_tail) if event_tail else [],
+            "time": round(now, 6),
+        })
+        return snapshot
 
     # ------------------------------------------------------------------ #
     # statistics (driver)

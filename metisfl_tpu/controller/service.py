@@ -113,6 +113,7 @@ class ControllerServer:
             "ListLearners": self._list_learners,
             "GetHealthStatus": self._health,
             "GetMetrics": self._get_metrics,
+            "DescribeFederation": self._describe,
             "ShutDown": self._shutdown_rpc,
         }))
         self._shutdown_event = threading.Event()
@@ -165,6 +166,13 @@ class ControllerServer:
         # plain-HTTP scrapers use telemetry.httpd instead)
         from metisfl_tpu.telemetry import render_metrics
         return render_metrics().encode("utf-8")
+
+    def _describe(self, raw: bytes) -> bytes:
+        # live status snapshot (round/phase, per-learner straggler
+        # analytics, in-flight tasks, event-ring tail) — the status
+        # plane behind python -m metisfl_tpu.status
+        tail = int(loads(raw).get("event_tail", 50)) if raw else 50
+        return dumps(self.controller.describe(event_tail=tail))
 
     def _shutdown_rpc(self, raw: bytes) -> bytes:
         # ack first, then tear down off-thread (servicer :364-375 pattern)
@@ -258,6 +266,28 @@ class ControllerClient:
         """The controller's Prometheus text exposition (GetMetrics RPC)."""
         return self._client.call("GetMetrics", b"", timeout=timeout,
                                  idempotent=True).decode("utf-8")
+
+    def describe_federation(self, event_tail: int = 50,
+                            timeout: Optional[float] = None,
+                            wait_ready: bool = True) -> dict:
+        """Live status snapshot (Controller.describe): round/phase,
+        per-learner liveness + straggler scores, in-flight tasks, store
+        occupancy, event-ring tail. Fail-fast polling works like
+        get_runtime_metadata: short ``timeout`` + ``wait_ready=False``."""
+        raw = self._client.call("DescribeFederation",
+                                dumps({"event_tail": int(event_tail)}),
+                                timeout=timeout, wait_ready=wait_ready,
+                                idempotent=True)
+        return loads(raw)
+
+    def list_methods(self, timeout: float = 5.0) -> dict:
+        """The service's RPC surface (ListMethods reflection): method
+        names + transport capability flags, JSON-encoded so non-codec
+        tooling can probe it too."""
+        import json as _json
+        raw = self._client.call("ListMethods", b"", timeout=timeout,
+                                idempotent=True)
+        return _json.loads(raw.decode("utf-8"))
 
     def shutdown_controller(self) -> bool:
         return bool(loads(self._client.call("ShutDown", b""))["ok"])

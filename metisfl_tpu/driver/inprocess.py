@@ -74,9 +74,18 @@ class InProcessFederation:
         # tracer is only reconfigured when the config says something (a
         # sink dir, or an explicit opt-out) — a default config must not
         # clobber a sink the host process already set up.
+        from metisfl_tpu.telemetry import events as _tevents
         from metisfl_tpu.telemetry import metrics as _tmetrics
         from metisfl_tpu.telemetry import trace as _ttrace
         _tmetrics.set_enabled(config.telemetry.enabled)
+        # the event journal follows THIS config's flags either way (its
+        # own opt-out composes under the subsystem-wide one), and the
+        # ring size is honored even on the keep-host-sink path below
+        _tevents.set_enabled(config.telemetry.enabled
+                             and config.telemetry.events.enabled)
+        if config.telemetry.events.ring_size:
+            _tevents.journal().set_ring_size(
+                config.telemetry.events.ring_size)
         if not config.telemetry.enabled or config.telemetry.dir:
             from metisfl_tpu import telemetry
             telemetry.apply_config(config.telemetry, service="inprocess")

@@ -35,7 +35,9 @@ from metisfl_tpu.chaos import ENV_VAR as _CHAOS_ENV_VAR
 from metisfl_tpu.comm.messages import TrainParams
 from metisfl_tpu.config import FederationConfig
 from metisfl_tpu.controller.service import ControllerClient
+from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import postmortem as _tpostmortem
 from metisfl_tpu.tensor.pytree import pack_model
 
 logger = logging.getLogger("metisfl_tpu.driver")
@@ -286,6 +288,19 @@ class DriverSession:
                                                      "telemetry")
         if self.config.telemetry.enabled and self.config.telemetry.dir:
             os.makedirs(self.config.telemetry.dir, exist_ok=True)
+        # flight recorder: bundle dir defaults into the workdir so
+        # controller/learner crash bundles land in the experiment dir the
+        # driver already collects (docs/OBSERVABILITY.md). The driver
+        # process arms its own recorder too — failover relaunches dump a
+        # driver-side bundle with the FailoverBegan event tail.
+        if (self.config.telemetry.enabled
+                and not self.config.telemetry.postmortem_dir):
+            self.config.telemetry.postmortem_dir = os.path.join(
+                self.workdir, "postmortem")
+        if self.config.telemetry.enabled:
+            os.makedirs(self.config.telemetry.postmortem_dir, exist_ok=True)
+            _tpostmortem.configure(self.config.telemetry.postmortem_dir,
+                                   service="driver", install_hooks=False)
         # TLS: generate the federation's self-signed pair on first boot
         # (reference driver keygen posture, ssl_configurator.py:21-30)
         if self.config.ssl.enabled and not self.config.ssl.cert_path:
@@ -403,6 +418,14 @@ class DriverSession:
             "controller died (exit %s); supervised restart %d/%d with "
             "--resume in %.1fs", code, self._controller_restarts,
             fo.max_controller_restarts, backoff)
+        # journal + flight-record the failover from the driver's side:
+        # the dead controller dumped (or couldn't); the supervisor's own
+        # bundle records WHEN it saw the death and what it did about it
+        _tevents.emit(_tevents.FailoverBegan,
+                      restart=self._controller_restarts, exit_code=code)
+        _tpostmortem.dump("failover_relaunch",
+                          extra={"exit_code": code,
+                                 "restart": self._controller_restarts})
         time.sleep(backoff)
         self._launch_controller(resume=True)
         _M_CTRL_RESTARTS.inc()
@@ -453,8 +476,14 @@ class DriverSession:
                      os.path.join(self.workdir, f"learner_{idx}_secure.bin")]
         if not self.config.telemetry.enabled:
             argv += ["--telemetry-off"]
-        elif self.config.telemetry.dir:
-            argv += ["--telemetry-dir", self.config.telemetry.dir]
+        else:
+            if self.config.telemetry.dir:
+                argv += ["--telemetry-dir", self.config.telemetry.dir]
+            if not self.config.telemetry.events.enabled:
+                argv += ["--events-off"]
+            if self.config.telemetry.postmortem_dir:
+                argv += ["--postmortem-dir",
+                         self.config.telemetry.postmortem_dir]
         if isinstance(launcher, SSHLauncher):
             # remote host: copy the recipe + TLS/secure material to the same
             # absolute paths (metisfl_tpu itself must be installed remotely)
@@ -734,6 +763,38 @@ class DriverSession:
                     logger.warning("could not collect trace file %s", name)
         return dest
 
+    def collect_postmortems(self) -> List[str]:
+        """Post-mortem bundle paths collected into the experiment dir.
+        Local processes already write into
+        ``telemetry.postmortem_dir`` (defaulted to
+        ``<workdir>/postmortem``); a custom dir outside the workdir is
+        copied in so the experiment directory stays self-contained."""
+        src = self.config.telemetry.postmortem_dir
+        if not (self.config.telemetry.enabled and src
+                and os.path.isdir(src)):
+            return []
+        import glob as _glob
+        import shutil as _shutil
+        dest = os.path.join(self.workdir, "postmortem")
+        paths = sorted(_glob.glob(os.path.join(src, "*.json")))
+        if os.path.abspath(src) != os.path.abspath(dest) and paths:
+            os.makedirs(dest, exist_ok=True)
+            collected = []
+            for p in paths:
+                target = os.path.join(dest, os.path.basename(p))
+                try:
+                    _shutil.copyfile(p, target)
+                    collected.append(target)
+                except OSError:
+                    logger.warning("could not collect bundle %s", p)
+            paths = collected
+        if paths:
+            logger.warning(
+                "%d post-mortem bundle(s) in %s — render with "
+                "python -m metisfl_tpu.telemetry --postmortem %s",
+                len(paths), dest, dest)
+        return paths
+
     def shutdown_federation(self, timeout_s: Optional[float] = None) -> None:
         # Default drain budget: 15 s, or 150 s when any learner is a
         # multi-host world — its leader can only release the followers
@@ -790,6 +851,10 @@ class DriverSession:
             self.collect_traces()
         except Exception:  # noqa: BLE001 - collection must not fail shutdown
             logger.exception("trace collection failed")
+        try:
+            self.collect_postmortems()
+        except Exception:  # noqa: BLE001 - collection must not fail shutdown
+            logger.exception("post-mortem collection failed")
 
     def run(self) -> dict:
         """initialize → monitor → save stats → shutdown, one call."""

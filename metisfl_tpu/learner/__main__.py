@@ -79,9 +79,16 @@ def main(argv=None) -> int:
                         help="JSONL trace-sink directory (the driver points "
                              "this at <workdir>/telemetry)")
     parser.add_argument("--telemetry-off", action="store_true",
-                        help="disable spans + metrics (federation config "
-                             "telemetry.enabled=false, forwarded by the "
-                             "driver)")
+                        help="disable spans + metrics + events (federation "
+                             "config telemetry.enabled=false, forwarded by "
+                             "the driver)")
+    parser.add_argument("--events-off", action="store_true",
+                        help="disable only the event journal (federation "
+                             "config telemetry.events.enabled=false)")
+    parser.add_argument("--postmortem-dir", default="",
+                        help="flight-recorder bundle directory (the driver "
+                             "points this at <workdir>/postmortem; crash/"
+                             "chaos-kill bundles land here)")
     parser.add_argument("--metrics-port", type=int, default=0,
                         help="plain-HTTP /metrics listener port (0 = off; "
                              "metrics stay reachable via the GetMetrics RPC)")
@@ -94,10 +101,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from metisfl_tpu import telemetry
-    from metisfl_tpu.config import TelemetryConfig
+    from metisfl_tpu.config import EventsConfig, TelemetryConfig
     telemetry.apply_config(
         TelemetryConfig(enabled=not args.telemetry_off,
-                        dir=args.telemetry_dir),
+                        dir=args.telemetry_dir,
+                        events=EventsConfig(enabled=not args.events_off),
+                        postmortem_dir=args.postmortem_dir),
         service=f"learner-{args.port or os.getpid()}")
     metrics_http = None
     if not args.telemetry_off and args.metrics_port > 0:
@@ -229,6 +238,7 @@ def main(argv=None) -> int:
         if metrics_http is not None:
             metrics_http.close()
         telemetry.trace.flush()
+        telemetry.events.flush()
     return 0
 
 

@@ -41,6 +41,7 @@ from metisfl_tpu.comm.messages import (
 )
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.ops import FlaxModelOps
+from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.tensor.spec import resolve_ship_dtype
@@ -189,6 +190,16 @@ class Learner:
         self.learner_id = reply.learner_id
         self.auth_token = reply.auth_token
         if reply.controller_epoch:
+            if (self.controller_epoch
+                    and reply.controller_epoch != self.controller_epoch):
+                # journal the incarnation change: a post-mortem reading
+                # this learner's ring can tell WHICH controller each of
+                # its tasks belonged to
+                _tevents.emit(_tevents.EpochChanged,
+                              learner_id=reply.learner_id,
+                              old_epoch=self.controller_epoch[:8],
+                              new_epoch=reply.controller_epoch[:8],
+                              reason="join_reply")
             self.controller_epoch = reply.controller_epoch
         self._left = False
         return reply
@@ -250,6 +261,11 @@ class Learner:
                 "%s: task from controller epoch %s but joined under %s — "
                 "re-attaching", self.learner_id, task_epoch[:8],
                 self.controller_epoch[:8])
+            _tevents.emit(_tevents.EpochChanged,
+                          learner_id=self.learner_id,
+                          old_epoch=self.controller_epoch[:8],
+                          new_epoch=task_epoch[:8],
+                          reason="task_envelope")
             self.reattach("epoch_mismatch")
 
     def _report_completion(self, result: TaskResult) -> bool:
@@ -746,10 +762,13 @@ class Learner:
         with eval_sp, eval_sp.activate():
             self._check_controller_epoch(task.controller_epoch)
             self._adopt_local_regex(task.local_tensor_regex)
-            if task.ship_tensor_regex:
-                # never-trained learners get the regex from the task (backfill
-                # reads the immutable construction tree — no snapshot needed)
-                self._ship_regex = task.ship_tensor_regex
+            # Unconditional, mirroring the train path (ADVICE r5):
+            # never-trained learners get the regex from the task (backfill
+            # reads the immutable construction tree — no snapshot needed),
+            # and a task WITHOUT one clears any stale regex from an
+            # earlier configuration instead of silently reactivating
+            # subset semantics on a full blob.
+            self._ship_regex = task.ship_tensor_regex
             # Evaluate on an explicit variables tree so a concurrently running
             # training task never races on the engine's model slot.
             variables = self._load_model(task.model)
@@ -787,8 +806,8 @@ class Learner:
         inputs or a named local split."""
         t0 = time.time()
         self._adopt_local_regex(task.local_tensor_regex)
-        if task.ship_tensor_regex:
-            self._ship_regex = task.ship_tensor_regex
+        # unconditional, like run_eval: a regex-less task clears stale state
+        self._ship_regex = task.ship_tensor_regex
         variables = self._load_model(task.model) if task.model else None
         if task.inputs:
             blob = ModelBlob.from_bytes(task.inputs)

@@ -81,6 +81,17 @@ def summarize(stats: Dict[str, Any]) -> str:
             lines.append(f"round errors ({len(errors)}):")
             lines.extend(f"  - {e}" for e in errors[:10])
 
+        straggler = straggler_summary(stats)
+        if straggler:
+            lines.append("")
+            lines.append("per-learner train durations (dispatch → uplink; "
+                         "rel = mean over cohort median):")
+            for row in straggler:
+                lines.append(
+                    f"  {row['learner']:<28} mean={row['mean_s']:.2f}s "
+                    f"max={row['max_s']:.2f}s rel={row['rel']:.2f}x "
+                    f"over {row['rounds']} round(s)")
+
     series = metric_series(stats)
     if series:
         lines.append("")
@@ -96,6 +107,39 @@ def summarize(stats: Dict[str, Any]) -> str:
                 f"  {key}: first={vals[0]:.4f} best={best:.4f} "
                 f"last={vals[-1]:.4f} over {len(vals)} evaluated rounds")
     return "\n".join(lines)
+
+
+def straggler_summary(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Post-hoc straggler analytics from round metadata: per-learner
+    dispatch→uplink durations (``train_submitted_at`` vs
+    ``train_received_at``), slowest first, with the mean normalized by
+    the cohort median (the same round-relative score the live
+    ``DescribeFederation`` snapshot reports as ``straggler_score``).
+    Empty when the lineage has no paired timestamps."""
+    per_learner: Dict[str, List[float]] = {}
+    for meta in stats.get("round_metadata", []):
+        submitted = meta.get("train_submitted_at", {}) or {}
+        received = meta.get("train_received_at", {}) or {}
+        for lid, t_in in received.items():
+            t_out = submitted.get(lid)
+            if t_out is None:
+                continue
+            dur = float(t_in) - float(t_out)
+            if dur >= 0:
+                per_learner.setdefault(lid, []).append(dur)
+    if not per_learner:
+        return []
+    means = {lid: sum(v) / len(v) for lid, v in per_learner.items()}
+    med = median(means.values())
+    rows = [
+        {"learner": lid, "mean_s": means[lid],
+         "max_s": max(per_learner[lid]),
+         "rel": (means[lid] / med) if med > 0 else 0.0,
+         "rounds": len(per_learner[lid])}
+        for lid in per_learner
+    ]
+    rows.sort(key=lambda r: -r["mean_s"])
+    return rows
 
 
 def metric_series(stats: Dict[str, Any]) -> Dict[str, List[float]]:

@@ -1,4 +1,4 @@
-"""Federation-wide telemetry: trace spans + metrics registry.
+"""Federation-wide telemetry: trace spans + metrics registry + events.
 
 Zero-dependency observability for the federation runtime (ROADMAP
 north-star: a production service must tell you *where* a round is stuck
@@ -13,16 +13,27 @@ while it is stuck, not after the experiment ends):
   histograms with Prometheus text exposition, served via the
   ``GetMetrics`` RPC on controller and learner and the optional
   plain-HTTP ``/metrics`` listener (:mod:`metisfl_tpu.telemetry.httpd`).
+- :mod:`metisfl_tpu.telemetry.events` — typed, structured event journal
+  (joins, rounds, dispatches, retries, faults) in a bounded ring buffer
+  + JSONL sink; the tail rides in ``DescribeFederation`` snapshots and
+  post-mortem bundles.
+- :mod:`metisfl_tpu.telemetry.postmortem` — the flight recorder: on an
+  unhandled crash, chaos kill, or failover relaunch, a process dumps its
+  event tail + open spans + metrics into ``<workdir>/postmortem/``.
 - ``python -m metisfl_tpu.telemetry <trace dir or .jsonl>`` renders a
-  round's span tree from the sink.
+  round's span tree from the sink; ``--postmortem`` renders the
+  pre-crash timeline from bundles; ``python -m metisfl_tpu.status``
+  live-watches a running federation over ``DescribeFederation``.
 
 Everything is opt-out via federation config ``telemetry.enabled=false``
-(:func:`apply_config`); the disabled paths are attribute-check cheap.
+(:func:`apply_config`), and the event journal separately via
+``telemetry.events.enabled=false``; the disabled paths are
+attribute-check cheap.
 """
 
 from __future__ import annotations
 
-from metisfl_tpu.telemetry import metrics, trace
+from metisfl_tpu.telemetry import events, metrics, postmortem, trace
 from metisfl_tpu.telemetry.metrics import parse_exposition, registry
 from metisfl_tpu.telemetry.trace import (
     METADATA_KEY,
@@ -36,6 +47,8 @@ from metisfl_tpu.telemetry.trace import (
 __all__ = [
     "metrics",
     "trace",
+    "events",
+    "postmortem",
     "registry",
     "parse_exposition",
     "span",
@@ -54,18 +67,29 @@ def render_metrics() -> str:
     return registry().render()
 
 
-def apply_config(telemetry_config, service: str = "") -> None:
+def apply_config(telemetry_config, service: str = "",
+                 config_hash: str = "") -> None:
     """Configure process-wide telemetry from a federation config's
     ``telemetry`` section (config/federation.py TelemetryConfig): one call
     in each process entry point (controller/learner ``__main__``,
-    in-process federation, tests)."""
+    in-process federation, tests). ``config_hash`` stamps post-mortem
+    bundles so incidents from different configs are tellable apart."""
     enabled = bool(getattr(telemetry_config, "enabled", True))
     metrics.set_enabled(enabled)
+    sink_dir = getattr(telemetry_config, "dir", "")
+    ev_cfg = getattr(telemetry_config, "events", None)
+    ev_enabled = enabled and bool(getattr(ev_cfg, "enabled", True))
+    events.configure(enabled=ev_enabled, service=service,
+                     dir=sink_dir if ev_enabled else "",
+                     ring_size=int(getattr(ev_cfg, "ring_size", 0) or 0))
     if enabled:
-        trace.configure(enabled=True, service=service,
-                        dir=getattr(telemetry_config, "dir", ""))
+        trace.configure(enabled=True, service=service, dir=sink_dir)
     else:
         # disable without forgetting any previously configured sink dir:
         # a later re-enable (set_enabled / a default-enabled config in
         # the same process) restores it
         trace.set_enabled(False)
+    pm_dir = getattr(telemetry_config, "postmortem_dir", "")
+    if enabled and pm_dir:
+        postmortem.configure(pm_dir, service=service,
+                             config_hash=config_hash)

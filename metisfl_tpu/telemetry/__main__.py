@@ -9,6 +9,15 @@ wire-propagated trace ids.
     python -m metisfl_tpu.telemetry /tmp/metisfl_tpu_x/telemetry
     python -m metisfl_tpu.telemetry traces.jsonl --round 3
     python -m metisfl_tpu.telemetry traces.jsonl --trace 01ab... --attrs
+
+``--postmortem`` switches to flight-recorder mode: the arguments are
+post-mortem bundle files (or directories of them — typically the
+``postmortem/`` dir a driver run leaves in its workdir) and the output
+is each crashed process's pre-crash timeline — its event-journal tail,
+the spans that were still open when it died, and its last metrics
+snapshot:
+
+    python -m metisfl_tpu.telemetry --postmortem <workdir>/postmortem
 """
 
 from __future__ import annotations
@@ -17,6 +26,7 @@ import glob
 import json
 import os
 import sys
+import time
 from typing import Any, Dict, Iterable, List, Optional
 
 
@@ -96,7 +106,79 @@ def _root_round(spans: List[dict]) -> Optional[int]:
     return None
 
 
+def render_postmortem(bundle: dict, show_metrics: bool = False) -> str:
+    """One flight-recorder bundle (telemetry/postmortem.py) as text: the
+    incident header, the pre-crash event timeline, and the spans that
+    never closed."""
+    from metisfl_tpu.telemetry import events as _events
+
+    lines: List[str] = []
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(float(bundle.get("time", 0.0))))
+    lines.append(
+        f"bundle {os.path.basename(bundle.get('_path', '?'))}  "
+        f"service={bundle.get('service', '?')} pid={bundle.get('pid', '?')} "
+        f"reason={bundle.get('reason', '?')} time={when}"
+        + (f" config={bundle['config_hash']}"
+           if bundle.get("config_hash") else ""))
+    extra = bundle.get("extra") or {}
+    if extra:
+        lines.append("  " + " ".join(f"{k}={v}" for k, v in extra.items()))
+    records = bundle.get("events", [])
+    if records:
+        t0 = float(records[0].get("ts", 0.0))
+        lines.append(f"  events ({len(records)}, "
+                     f"seq {records[0].get('seq', '?')}"
+                     f"..{records[-1].get('seq', '?')}):")
+        for record in records:
+            lines.append("    " + _events.format_record(record, t0=t0))
+    else:
+        lines.append("  events: (journal empty or disabled)")
+    open_spans = bundle.get("open_spans", [])
+    if open_spans:
+        lines.append(f"  open spans at death ({len(open_spans)}):")
+        for sp in open_spans:
+            attrs = sp.get("attrs") or {}
+            attr_s = ("  {" + " ".join(f"{k}={v}" for k, v in attrs.items())
+                      + "}") if attrs else ""
+            lines.append(
+                f"    {sp.get('name', '?')} "
+                f"(open {_fmt_dur(float(sp.get('open_ms', 0.0)))}) "
+                f"trace={str(sp.get('trace', ''))[:8]}{attr_s}")
+    metrics_text = bundle.get("metrics", "")
+    n_series = sum(1 for line in metrics_text.splitlines()
+                   if line and not line.startswith("#"))
+    lines.append(f"  metrics snapshot: {n_series} series"
+                 + ("" if show_metrics else
+                    " (re-run with --metrics to print)"))
+    if show_metrics and metrics_text:
+        lines.extend("    " + line for line in metrics_text.splitlines())
+    return "\n".join(lines)
+
+
+def _postmortem_main(argv: List[str]) -> int:
+    from metisfl_tpu.telemetry import postmortem as _postmortem
+
+    show_metrics = "--metrics" in argv
+    argv = [a for a in argv if a != "--metrics"]
+    if not argv:
+        print("usage: python -m metisfl_tpu.telemetry --postmortem "
+              "<bundle.json | postmortem-dir>... [--metrics]",
+              file=sys.stderr)
+        return 2
+    bundles = _postmortem.load_bundles(argv)
+    if not bundles:
+        print("no post-mortem bundles found", file=sys.stderr)
+        return 1
+    for bundle in bundles:
+        print(render_postmortem(bundle, show_metrics=show_metrics))
+        print()
+    return 0
+
+
 def main(argv: List[str]) -> int:
+    if "--postmortem" in argv:
+        return _postmortem_main([a for a in argv if a != "--postmortem"])
     show_attrs = "--attrs" in argv
     argv = [a for a in argv if a != "--attrs"]
     want_trace = want_round = None

@@ -1,0 +1,296 @@
+"""Structured event journal: the federation's flight-data recorder.
+
+Third telemetry layer next to spans (how long did it take) and metrics
+(how much / how often): a typed, ordered record of *what the process was
+doing* — learners joining, rounds starting, tasks dispatching, retries
+scheduling, faults firing. Spans and metrics answer performance
+questions after the fact; the journal answers "what was in flight when
+it died" and feeds the live `DescribeFederation` snapshot.
+
+Events are frozen dataclasses (one class per kind, typed fields), stamped
+at emit time with a process-monotonic ``seq`` and a wall-clock ``ts``,
+and kept in a bounded in-memory ring buffer. With a sink directory
+configured, each event additionally appends one JSON line to
+``<dir>/<service>-<pid>-events.jsonl`` (same per-process-file +
+torn-sink-tolerant posture as :mod:`metisfl_tpu.telemetry.trace`). The
+ring tail is exported over ``DescribeFederation`` and into post-mortem
+bundles (:mod:`metisfl_tpu.telemetry.postmortem`).
+
+Overhead contract: a disabled journal costs one attribute read per call
+site — :func:`emit` returns before the event dataclass is even
+constructed (federation config ``telemetry.events.enabled=false``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, ClassVar, Dict, List, Optional, Type
+
+DEFAULT_RING_SIZE = 512
+
+
+# --------------------------------------------------------------------- #
+# event catalog (docs/OBSERVABILITY.md "Events, status, and post-mortems")
+# --------------------------------------------------------------------- #
+
+@dataclass(frozen=True)
+class LearnerJoined:
+    kind: ClassVar[str] = "learner_joined"
+    learner_id: str
+    hostname: str = ""
+    port: int = 0
+    rejoined: bool = False
+
+
+@dataclass(frozen=True)
+class LearnerLost:
+    kind: ClassVar[str] = "learner_lost"
+    learner_id: str
+    reason: str = "leave"
+
+
+@dataclass(frozen=True)
+class RoundStarted:
+    kind: ClassVar[str] = "round_started"
+    round: int
+    cohort: int = 0
+
+
+@dataclass(frozen=True)
+class TaskDispatched:
+    kind: ClassVar[str] = "task_dispatched"
+    task_id: str
+    learner_id: str
+    round: int = 0
+
+
+@dataclass(frozen=True)
+class TaskCompleted:
+    kind: ClassVar[str] = "task_completed"
+    task_id: str
+    learner_id: str
+    round: int = 0
+    stale: bool = False
+    uplink_bytes: int = 0
+
+
+@dataclass(frozen=True)
+class RetryScheduled:
+    """A transparent RPC-client retry (UNAVAILABLE backoff, idempotent
+    DEADLINE_EXCEEDED, or the unary-oversize → chunked fallback)."""
+
+    kind: ClassVar[str] = "retry_scheduled"
+    service: str
+    method: str
+    code: str = ""
+    attempt: int = 0
+
+
+@dataclass(frozen=True)
+class FaultInjected:
+    kind: ClassVar[str] = "fault_injected"
+    fault: str
+    side: str = ""
+    method: str = ""
+
+
+@dataclass(frozen=True)
+class EpochChanged:
+    """A learner observed a controller-incarnation change (crash+restart)."""
+
+    kind: ClassVar[str] = "epoch_changed"
+    learner_id: str
+    old_epoch: str = ""
+    new_epoch: str = ""
+    reason: str = ""
+
+
+@dataclass(frozen=True)
+class AggregationDone:
+    kind: ClassVar[str] = "aggregation_done"
+    round: int
+    selected: int = 0
+    duration_ms: float = 0.0
+
+
+@dataclass(frozen=True)
+class FailoverBegan:
+    """The driver began a supervised controller relaunch."""
+
+    kind: ClassVar[str] = "failover_began"
+    restart: int
+    exit_code: Optional[int] = None
+
+
+EVENT_TYPES: Dict[str, type] = {
+    cls.kind: cls
+    for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
+                TaskCompleted, RetryScheduled, FaultInjected, EpochChanged,
+                AggregationDone, FailoverBegan)
+}
+
+
+# --------------------------------------------------------------------- #
+# journal
+# --------------------------------------------------------------------- #
+
+class Journal:
+    """Bounded ring of event records + optional JSONL sink. A *record* is
+    the emitted event's fields plus ``{seq, ts, kind}`` — plain dicts so
+    the ring tail serializes straight into RPC snapshots and bundles."""
+
+    def __init__(self):
+        self.enabled = True
+        self.service = ""
+        self._ring: "collections.deque[dict]" = collections.deque(
+            maxlen=DEFAULT_RING_SIZE)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._path = ""
+        self._fh = None
+
+    def configure(self, enabled: bool = True, service: str = "",
+                  dir: str = "", ring_size: int = 0) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:  # pragma: no cover - close never critical
+                    pass
+                self._fh = None
+            self.enabled = bool(enabled)
+            self.service = service or self.service or "proc"
+            if ring_size and ring_size != self._ring.maxlen:
+                self._ring = collections.deque(self._ring,
+                                               maxlen=int(ring_size))
+            self._path = ""
+            if enabled and dir:
+                try:
+                    os.makedirs(dir, exist_ok=True)
+                except OSError as exc:
+                    import logging
+                    logging.getLogger("metisfl_tpu.telemetry").warning(
+                        "event sink dir %r not creatable (%s); events stay "
+                        "ring-only", dir, exc)
+                    return
+                self._path = os.path.join(
+                    dir, f"{self.service}-{os.getpid()}-events.jsonl")
+
+    def emit(self, event_cls: Type, **fields) -> Optional[dict]:
+        """Construct + journal one event; returns the record, or None
+        when the journal is disabled (the hot-path no-op)."""
+        if not self.enabled:
+            return None
+        event = event_cls(**fields)  # typed validation at the call site
+        record = {"kind": event.kind, "ts": round(time.time(), 6)}
+        record.update(asdict(event))
+        with self._lock:
+            self._seq += 1
+            record["seq"] = self._seq
+            self._ring.append(record)
+            path = self._path
+        if path:
+            self._sink(record)
+        return record
+
+    def _sink(self, record: dict) -> None:
+        line = json.dumps(record, default=str) + "\n"
+        with self._lock:
+            try:
+                if self._fh is None:
+                    if not self._path:
+                        return
+                    self._fh = open(self._path, "a", buffering=1)
+                self._fh.write(line)
+            except OSError:
+                # a torn sink (deleted dir, full disk) must never take an
+                # instrumented code path down with it — stop persisting
+                self._path = ""
+                self._fh = None
+
+    def set_ring_size(self, ring_size: int) -> None:
+        """Resize the ring without touching the sink configuration (the
+        in-process federation honors ``events.ring_size`` while leaving
+        any host-configured sink alone)."""
+        with self._lock:
+            if ring_size and ring_size != self._ring.maxlen:
+                self._ring = collections.deque(self._ring,
+                                               maxlen=int(ring_size))
+
+    def tail(self, n: int = 0) -> List[dict]:
+        """The last ``n`` records (0 = the whole ring), oldest first."""
+        with self._lock:
+            records = list(self._ring)
+        return records[-n:] if n > 0 else records
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def reset(self) -> None:
+        """Drop the ring + seq counter (tests)."""
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+
+
+_JOURNAL = Journal()
+
+
+def journal() -> Journal:
+    return _JOURNAL
+
+
+def configure(enabled: bool = True, service: str = "", dir: str = "",
+              ring_size: int = 0) -> None:
+    _JOURNAL.configure(enabled=enabled, service=service, dir=dir,
+                       ring_size=ring_size)
+
+
+def set_enabled(value: bool) -> None:
+    _JOURNAL.enabled = bool(value)
+
+
+def enabled() -> bool:
+    return _JOURNAL.enabled
+
+
+def emit(event_cls: Type, **fields) -> Optional[dict]:
+    """Module-level emit: ``events.emit(events.RoundStarted, round=3)``.
+    One attribute check when the journal is off."""
+    if not _JOURNAL.enabled:
+        return None
+    return _JOURNAL.emit(event_cls, **fields)
+
+
+def tail(n: int = 0) -> List[dict]:
+    return _JOURNAL.tail(n)
+
+
+def flush() -> None:
+    _JOURNAL.flush()
+
+
+def event_path() -> str:
+    """The JSONL file this process appends events to ('' = ring-only)."""
+    return _JOURNAL._path
+
+
+def format_record(record: Dict[str, Any], t0: Optional[float] = None) -> str:
+    """One human line per record (status CLI + post-mortem viewer):
+    ``+12.345s #17 task_dispatched learner_id=L0 task_id=ab12``."""
+    ts = float(record.get("ts", 0.0))
+    rel = f"+{ts - t0:8.3f}s" if t0 is not None else (
+        time.strftime("%H:%M:%S", time.localtime(ts)))
+    seq = record.get("seq", "?")
+    kind = record.get("kind", "?")
+    skip = {"ts", "seq", "kind"}
+    fields = " ".join(f"{k}={v}" for k, v in record.items()
+                      if k not in skip and v not in ("", None))
+    return f"{rel}  #{seq:<5} {kind:<18} {fields}".rstrip()

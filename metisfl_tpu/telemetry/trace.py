@@ -66,7 +66,7 @@ class Span:
     span stays open across many scheduling-executor invocations)."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
-                 "start", "_t0", "_duration_ms", "_tracer")
+                 "start", "_t0", "_duration_ms", "_tracer", "__weakref__")
 
     def __init__(self, tracer: "_Tracer", name: str,
                  parent: Optional[SpanContext],
@@ -100,6 +100,7 @@ class Span:
         """Finish the span (idempotent) and write it to the sink."""
         if self._duration_ms is None:
             self._duration_ms = (time.perf_counter() - self._t0) * 1e3
+            self._tracer._closed(self)
             self._tracer._record(self)
         return self._duration_ms
 
@@ -177,6 +178,55 @@ class _Tracer:
         self._path = ""
         self._fh = None
         self._lock = threading.Lock()
+        # live (un-ended) spans, weakly held so an abandoned span can
+        # still be collected: the flight recorder's "what was open when
+        # the process died" snapshot (telemetry/postmortem.py)
+        self._open: "Dict[int, Any]" = {}
+
+    def _opened(self, span: "Span") -> None:
+        import weakref
+        with self._lock:
+            # Bound dead-ref growth from spans abandoned without end():
+            # no weakref GC callback (it could re-enter this non-reentrant
+            # lock from a collection triggered while holding it), so prune
+            # lazily once the map grows past a generous live-span count.
+            if len(self._open) > 512:
+                self._open = {k: r for k, r in self._open.items()
+                              if r() is not None}
+            self._open[id(span)] = weakref.ref(span)
+
+    def _closed(self, span: "Span") -> None:
+        with self._lock:
+            self._open.pop(id(span), None)
+
+    def open_spans(self) -> list:
+        """Snapshot of live spans as records (ages keep ticking — the
+        caller sees elapsed-so-far durations). Also prunes entries whose
+        span was garbage-collected without ``end()``."""
+        out = []
+        with self._lock:
+            dead = [k for k, r in self._open.items() if r() is None]
+            for k in dead:
+                del self._open[k]
+            refs = list(self._open.values())
+        for ref in refs:
+            span = ref()
+            if span is None or span._duration_ms is not None:
+                continue
+            record = {
+                "trace": span.trace_id,
+                "span": span.span_id,
+                "parent": span.parent_id,
+                "name": span.name,
+                "service": self.service,
+                "start": round(span.start, 6),
+                "open_ms": round(span.duration_ms, 3),
+            }
+            if span.attrs:
+                record["attrs"] = dict(span.attrs)
+            out.append(record)
+        out.sort(key=lambda r: r["start"])
+        return out
 
     def configure(self, enabled: bool = True, service: str = "",
                   dir: str = "") -> None:
@@ -191,6 +241,7 @@ class _Tracer:
             self.service = service or self.service or "proc"
             self.dir = dir
             self._path = ""
+            self._open.clear()  # a reconfigure starts a fresh lifetime
             if enabled and dir:
                 try:
                     os.makedirs(dir, exist_ok=True)
@@ -267,6 +318,12 @@ def trace_path() -> str:
     return _TRACER._path
 
 
+def open_spans() -> list:
+    """Live (un-ended) spans as records — the flight recorder's
+    "what was in flight" snapshot (telemetry/postmortem.py)."""
+    return _TRACER.open_spans()
+
+
 def span(name: str, parent: Any = _USE_CURRENT,
          attrs: Optional[Dict[str, Any]] = None):
     """Open a span. ``parent``: omitted → the calling context's active
@@ -278,7 +335,11 @@ def span(name: str, parent: Any = _USE_CURRENT,
         parent = _CURRENT.get()
     elif isinstance(parent, (Span, _NullSpan)):
         parent = parent.context()
-    return Span(_TRACER, name, parent, attrs)
+    sp = Span(_TRACER, name, parent, attrs)
+    # only factory-made spans are tracked as open: event() spans below are
+    # born already-finished and must never show up in open_spans()
+    _TRACER._opened(sp)
+    return sp
 
 
 def event(name: str, duration_s: float, parent: Any = _USE_CURRENT,

@@ -8,6 +8,7 @@ the bottom runs a real 2-process-learner gRPC federation, kills the
 controller mid-round via the seeded chaos injector, and requires the run
 to finish its rounds after automatic restart + learner re-attach."""
 
+import os
 import socket
 import time
 
@@ -404,13 +405,19 @@ def test_deliberate_leave_never_reattaches():
 # the acceptance test: chaos-killed controller, supervised failover
 # ---------------------------------------------------------------------- #
 
-def test_controller_crash_failover_midround(tmp_path):
+def test_controller_crash_failover_midround(tmp_path, capsys):
     """Synchronous 2-learner gRPC federation; the seeded chaos injector
     kills the controller on its FIRST MarkTaskCompleted (= mid-round,
     after dispatch, as uplinks arrive). The driver must detect the death,
     relaunch with --resume, the learners must re-attach, and the run must
     still complete its target rounds with a consistent lineage and
-    ``controller_restarts_total == 1`` scraped from telemetry."""
+    ``controller_restarts_total == 1`` scraped from telemetry.
+
+    Flight-recorder acceptance (ISSUE 3): the dying controller dumps a
+    post-mortem bundle into ``<workdir>/postmortem/`` whose event tail
+    reconstructs the dispatched round (RoundStarted + TaskDispatched),
+    the driver adds its own ``failover_relaunch`` bundle, and
+    ``python -m metisfl_tpu.telemetry --postmortem`` renders both."""
     from metisfl_tpu import telemetry
     from metisfl_tpu.comm.rpc import RpcClient
     from metisfl_tpu.controller.service import LEARNER_SERVICE
@@ -488,5 +495,43 @@ def test_controller_crash_failover_midround(tmp_path):
             series = parse_exposition(text).get("learner_reattach_total", {})
             reattaches += sum(series.values())
         assert reattaches >= 1, "no learner ever re-attached"
+
+        # ---- flight recorder: the killed controller left a bundle ----
+        import json as _json
+
+        from metisfl_tpu.telemetry.__main__ import main as viewer_main
+
+        pm_dir = os.path.join(str(tmp_path), "postmortem")
+        bundles = session.collect_postmortems()
+        assert bundles, f"no post-mortem bundles in {pm_dir}"
+        by_reason = {}
+        for path in bundles:
+            with open(path) as f:
+                bundle = _json.load(f)
+            by_reason.setdefault(bundle["reason"], []).append(bundle)
+        assert "chaos_kill" in by_reason, sorted(by_reason)
+        kill = by_reason["chaos_kill"][0]
+        assert kill["service"] == "controller"
+        # the event tail reconstructs the dispatched round: the round
+        # started and its tasks went out before the kill fired
+        kinds = [e["kind"] for e in kill["events"]]
+        assert "round_started" in kinds, kinds
+        assert "task_dispatched" in kinds, kinds
+        assert "fault_injected" in kinds, kinds
+        round_no = next(e["round"] for e in kill["events"]
+                        if e["kind"] == "round_started")
+        dispatched = [e for e in kill["events"]
+                      if e["kind"] == "task_dispatched"
+                      and e["round"] == round_no]
+        assert len(dispatched) == 2, dispatched  # both learners
+        # it died mid-round: the round span never closed
+        assert any(sp["name"] == "round" for sp in kill["open_spans"])
+        # the supervising driver recorded the relaunch on its side
+        assert "failover_relaunch" in by_reason, sorted(by_reason)
+        # and the viewer renders the timeline
+        assert viewer_main(["--postmortem", pm_dir]) == 0
+        out = capsys.readouterr().out
+        assert "reason=chaos_kill" in out
+        assert "round_started" in out and "task_dispatched" in out
     finally:
         session.shutdown_federation()

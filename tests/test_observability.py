@@ -1,0 +1,331 @@
+"""Event journal, live status plane, and flight recorder (ISSUE 3).
+
+Units for the typed event journal (ring bounds, seq monotonicity,
+sink, opt-out inertness) and the post-mortem bundle format + viewer;
+integration for ``DescribeFederation`` — both direct (2-learner
+in-process federation with straggler analytics) and over real gRPC with
+the ``python -m metisfl_tpu.status`` CLI and ``ListMethods``
+reflection riding along. The chaos-kill bundle proof lives in
+``tests/test_failover.py`` next to the failover it composes with.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from metisfl_tpu import telemetry
+from metisfl_tpu.comm.messages import TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    EventsConfig,
+    FederationConfig,
+    TelemetryConfig,
+    TerminationConfig,
+)
+from metisfl_tpu.telemetry import events as tevents
+from metisfl_tpu.telemetry import postmortem as tpostmortem
+from metisfl_tpu.telemetry import trace as ttrace
+from metisfl_tpu.telemetry import metrics as tmetrics
+
+
+@pytest.fixture()
+def journal():
+    """Clean, enabled telemetry state (journal ring-only, tracer sinkless,
+    metrics on); restores the same defaults after."""
+    def _reset():
+        tevents.configure(enabled=True, service="test", dir="",
+                          ring_size=512)
+        tevents.journal().reset()
+        ttrace.configure(enabled=True, service="test", dir="")
+        tmetrics.set_enabled(True)
+
+    _reset()
+    yield tevents.journal()
+    _reset()
+
+
+# --------------------------------------------------------------------- #
+# event journal units
+# --------------------------------------------------------------------- #
+
+
+def test_ring_bounds_and_seq_monotonicity(journal):
+    tevents.configure(enabled=True, ring_size=4)
+    for i in range(7):
+        tevents.emit(tevents.TaskDispatched, task_id=f"t{i}",
+                     learner_id="L0", round=i)
+    tail = tevents.tail()
+    assert len(tail) == 4  # bounded
+    seqs = [r["seq"] for r in tail]
+    assert seqs == sorted(seqs) and seqs[-1] == 7  # monotone, no reuse
+    assert [r["task_id"] for r in tail] == ["t3", "t4", "t5", "t6"]
+    assert tevents.tail(2) == tail[-2:]
+
+
+def test_typed_events_carry_their_fields(journal):
+    record = tevents.emit(tevents.EpochChanged, learner_id="L1",
+                          old_epoch="aaaa", new_epoch="bbbb",
+                          reason="task_envelope")
+    assert record["kind"] == "epoch_changed"
+    assert record["old_epoch"] == "aaaa" and record["reason"] == "task_envelope"
+    with pytest.raises(TypeError):
+        # typo'd fields fail at the call site, not silently journal junk
+        tevents.emit(tevents.RoundStarted, roundd=3)
+
+
+def test_disabled_journal_is_inert(journal):
+    tevents.set_enabled(False)
+    assert tevents.emit(tevents.RoundStarted, round=1) is None
+    assert tevents.tail() == []
+    tevents.set_enabled(True)
+    assert tevents.emit(tevents.RoundStarted, round=2) is not None
+
+
+def test_jsonl_sink_roundtrips(journal, tmp_path):
+    tevents.configure(enabled=True, service="sinky", dir=str(tmp_path))
+    tevents.emit(tevents.FaultInjected, fault="drop", side="client",
+                 method="Echo")
+    tevents.flush()
+    path = tevents.event_path()
+    assert os.path.basename(path).startswith("sinky-")
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert lines and lines[-1]["kind"] == "fault_injected"
+    assert lines[-1]["fault"] == "drop"
+
+
+def test_apply_config_wires_events_and_optouts(journal, tmp_path):
+    cfg = TelemetryConfig(enabled=True, dir=str(tmp_path),
+                          events=EventsConfig(enabled=False, ring_size=8))
+    telemetry.apply_config(cfg, service="cfged")
+    assert not tevents.enabled()
+    cfg.events.enabled = True
+    telemetry.apply_config(cfg, service="cfged")
+    assert tevents.enabled()
+    assert tevents.journal()._ring.maxlen == 8
+    # telemetry.enabled=false implies the journal off too
+    telemetry.apply_config(TelemetryConfig(enabled=False), service="cfged")
+    assert not tevents.enabled()
+    tmetrics.set_enabled(True)
+
+
+# --------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------- #
+
+
+def test_postmortem_bundle_and_viewer(journal, tmp_path, capsys):
+    from metisfl_tpu.telemetry.__main__ import main as viewer_main
+
+    tevents.emit(tevents.RoundStarted, round=5, cohort=3)
+    tevents.emit(tevents.TaskDispatched, task_id="tt", learner_id="L2",
+                 round=5)
+    open_sp = ttrace.span("round", parent=None, attrs={"round": 5})
+    tpostmortem.configure(str(tmp_path), service="unit",
+                          config_hash="cafe", install_hooks=False)
+    path = tpostmortem.dump("unit_test", extra={"note": "x"})
+    open_sp.end()
+    bundle = json.load(open(path))
+    assert bundle["service"] == "unit" and bundle["reason"] == "unit_test"
+    assert bundle["config_hash"] == "cafe"
+    kinds = [e["kind"] for e in bundle["events"]]
+    assert "round_started" in kinds and "task_dispatched" in kinds
+    # the un-ended round span shows up as open at dump time
+    assert any(sp["name"] == "round" for sp in bundle["open_spans"])
+    assert "# TYPE" in bundle["metrics"] or bundle["metrics"] == ""
+
+    assert viewer_main(["--postmortem", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "round_started" in out and "task_dispatched" in out
+    assert "reason=unit_test" in out and "open spans" in out
+
+    assert viewer_main(["--postmortem", str(tmp_path / "nope")]) == 1
+
+
+def test_postmortem_unconfigured_is_noop(journal):
+    rec = tpostmortem._Recorder()
+    assert rec.dump("whatever") is None
+
+
+# --------------------------------------------------------------------- #
+# live status plane
+# --------------------------------------------------------------------- #
+
+
+def _federation(rounds=2, events_enabled=True):
+    from metisfl_tpu.driver import InProcessFederation
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from tests.test_federation_inprocess import _shards
+
+    config = FederationConfig(
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=16, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(batch_size=64, datasets=["test"]),
+        termination=TerminationConfig(federation_rounds=rounds),
+        telemetry=TelemetryConfig(
+            events=EventsConfig(enabled=events_enabled)),
+    )
+    fed = InProcessFederation(config)
+    shards, test = _shards(2)
+    template = None
+    for shard in shards:
+        engine = FlaxModelOps(MLP(features=(8,), num_outputs=3),
+                              shard.x[:2], rng_seed=0)
+        if template is None:
+            template = engine.get_variables()
+        fed.add_learner(engine, shard, test_dataset=test)
+    fed.seed_model(template)
+    return fed
+
+
+def test_describe_federation_live_snapshot(journal):
+    """Acceptance: DescribeFederation on a live in-process 2-learner
+    federation — the round advances, every learner carries a straggler
+    score, the gauge is exported, and the event ring reconstructs the
+    round lifecycle."""
+    fed = _federation(rounds=2)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(2, timeout_s=120)
+        snap = fed.controller.describe()
+    finally:
+        fed.shutdown()
+    assert snap["round"] >= 2
+    assert snap["phase"] in ("dispatch", "wait_uplinks", "select",
+                             "aggregate", "idle")
+    assert len(snap["learners"]) == 2
+    for learner in snap["learners"]:
+        assert learner["live"] is True
+        assert learner["straggler_score"] > 0
+        assert learner["ewma_train_s"] > 0
+    # scores are median-relative: their geometric middle is ~1
+    scores = sorted(l["straggler_score"] for l in snap["learners"])
+    assert scores[0] <= 1.0 <= scores[-1] + 1e-9
+    assert snap["store"]["total"] >= 2
+    kinds = {e["kind"] for e in snap["events"]}
+    assert {"learner_joined", "round_started", "task_dispatched",
+            "task_completed", "aggregation_done"} <= kinds
+    # the gauge surface (scrapable while the run is live)
+    text = telemetry.render_metrics()
+    assert "learner_straggler_score{" in text
+
+
+def test_events_disabled_keeps_hot_paths_inert(journal):
+    """Acceptance: telemetry.events.enabled=false makes every
+    instrumented hot path a no-op — the federation still runs, the
+    journal stays empty, and DescribeFederation ships an empty tail."""
+    fed = _federation(rounds=1, events_enabled=False)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=120)
+        snap = fed.controller.describe()
+    finally:
+        fed.shutdown()
+    assert snap["round"] >= 1
+    assert snap["events"] == []
+    assert tevents.tail() == []
+    # straggler analytics do not depend on the journal
+    assert all(l["straggler_score"] > 0 for l in snap["learners"])
+
+
+def test_describe_federation_over_grpc_with_status_cli(journal, capsys):
+    """The RPC + CLI layers over describe(): a gRPC-served controller
+    answers DescribeFederation and ListMethods, and the status CLI's
+    --once --probe mode renders the table from a live endpoint."""
+    from metisfl_tpu import status as status_cli
+    from metisfl_tpu.controller.core import Controller
+    from metisfl_tpu.controller.service import (ControllerClient,
+                                                ControllerServer)
+
+    config = FederationConfig(
+        train=TrainParams(batch_size=4, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        termination=TerminationConfig(federation_rounds=1),
+    )
+    controller = Controller(config, proxy_factory=lambda record: None)
+    server = ControllerServer(controller, host="127.0.0.1", port=0)
+    port = server.start()
+    client = ControllerClient("127.0.0.1", port)
+    try:
+        snap = client.describe_federation(timeout=10.0)
+        assert snap["round"] == 0 and snap["phase"] == "idle"
+        assert snap["controller_epoch"] == controller.controller_epoch
+        reflection = client.list_methods(timeout=10.0)
+        names = {m["name"] for m in reflection["methods"]}
+        assert {"DescribeFederation", "ListMethods", "JoinFederation",
+                "GetMetrics"} <= names
+        assert all(m["oversize_unary_fallback"]
+                   for m in reflection["methods"])
+
+        rc = status_cli.main(["--host", "127.0.0.1", "--port", str(port),
+                              "--once", "--probe"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"round={snap['round']}" in out
+        assert "phase=idle" in out
+        assert "DescribeFederation" in out  # the reflection probe rendered
+    finally:
+        client.close()
+        server.stop()
+
+    # a dead endpoint fails fast with a diagnostic, not a hang
+    rc = status_cli.main(["--host", "127.0.0.1", "--port", str(port),
+                          "--once"])
+    assert rc == 1
+
+
+def test_status_render_snapshot_is_self_contained():
+    """render_snapshot needs no live federation (pure formatting)."""
+    from metisfl_tpu.status import render_snapshot
+
+    snap = {
+        "controller_epoch": "abcdef012345", "round": 7,
+        "phase": "wait_uplinks", "protocol": "synchronous",
+        "aggregation_rule": "fedavg", "time": 1000.0,
+        "round_started_at": 990.0,
+        "learners": [
+            {"learner_id": "L0", "live": True, "straggler_score": 2.5,
+             "ewma_train_s": 5.0, "ewma_eval_s": 0.4,
+             "dispatch_failures": 0, "last_result_round": 6},
+            {"learner_id": "L1", "live": False, "straggler_score": 0.8,
+             "ewma_train_s": 1.6, "ewma_eval_s": 0.2,
+             "dispatch_failures": 3, "last_result_round": 4},
+        ],
+        "in_flight": [{"task_id": "deadbeefcafe", "learner_id": "L0",
+                       "age_s": 9.5}],
+        "store": {"models": {"L0": 2, "L1": 2}, "total": 4},
+        "events": [{"seq": 1, "ts": 995.0, "kind": "round_started",
+                    "round": 7, "cohort": 2}],
+    }
+    text = render_snapshot(snap, target="host:1", events=5)
+    assert "round=7" in text and "phase=wait_uplinks" in text
+    assert "2.50x" in text          # the straggler column
+    assert "NO" in text             # dead learner flagged
+    assert "L0:deadbeef" in text    # in-flight task with age
+    assert "round_started" in text  # event tail
+
+
+def test_straggler_summary_post_hoc():
+    """stats.py's post-hoc analytics agree with the timestamps."""
+    from metisfl_tpu.stats import straggler_summary, summarize
+
+    stats = {
+        "global_iteration": 2,
+        "learners": ["L0", "L1"],
+        "round_metadata": [
+            {"global_iteration": i,
+             "started_at": 0.0, "completed_at": 10.0,
+             "selected_learners": ["L0", "L1"],
+             "train_submitted_at": {"L0": 0.0, "L1": 0.0},
+             "train_received_at": {"L0": 2.0, "L1": 6.0}}
+            for i in range(2)
+        ],
+    }
+    rows = straggler_summary(stats)
+    assert rows[0]["learner"] == "L1" and rows[0]["mean_s"] == 6.0
+    assert rows[0]["rel"] == pytest.approx(1.5)  # 6 / median(2,6)=4
+    text = summarize(stats)
+    assert "per-learner train durations" in text and "L1" in text

@@ -177,6 +177,53 @@ def test_async_chunked(echo_server, monkeypatch):
     client.close()
 
 
+def test_async_future_resolves_with_final_outcome(echo_server, monkeypatch):
+    """Regression (ADVICE r5 double signal): the future call_async returns
+    must resolve only with the FINAL outcome. On the unary-oversize →
+    chunked retry the old code handed back the grpc future of the FAILED
+    unary attempt, so a caller inspecting it saw RESOURCE_EXHAUSTED for a
+    call that then succeeded via callback."""
+    from metisfl_tpu.comm import rpc
+
+    monkeypatch.setattr(rpc, "UNARY_RESPONSE_LIMIT", 100)
+    monkeypatch.setattr(rpc, "CHUNK_BYTES", 64)
+    port, state = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    payload = b"\xab" * 1000  # small request, >limit response
+    future = client.call_async("Echo", payload)
+    assert future.result(timeout=30) == payload  # NOT the oversize error
+    assert future.exception() is None
+    assert state["count"] == 2  # unary attempt + chunked retry happened
+
+    # plain success resolves the wrapper too
+    small = client.call_async("Boom", b"", error_callback=lambda e: None)
+    with pytest.raises(Exception, match="kaboom"):
+        small.result(timeout=30)
+
+    # remembered-chunked path: straight to the stream, still one future
+    again = client.call_async("Echo", payload)
+    assert again.result(timeout=30) == payload
+    client.close()
+
+
+def test_list_methods_reflection(echo_server):
+    """Every BytesService answers ListMethods (gRPC-reflection parity):
+    JSON method names + transport capability flags, including itself."""
+    import json
+
+    port, _ = echo_server
+    client = RpcClient("127.0.0.1", port, "test.Echo")
+    raw = client.call("ListMethods", b"", timeout=10)
+    reflection = json.loads(raw.decode("utf-8"))
+    assert reflection["service"] == "test.Echo"
+    names = {m["name"] for m in reflection["methods"]}
+    assert {"Echo", "Boom", "ListMethods"} <= names
+    for m in reflection["methods"]:
+        assert m["transports"] == ["unary", "chunked"]
+        assert m["oversize_unary_fallback"] is True
+    client.close()
+
+
 def test_chunked_handler_error_propagates(echo_server, monkeypatch):
     import grpc
 
