@@ -799,6 +799,45 @@ def bench_e2e_round(rounds: int = 4, learners: int = 3):
     return out
 
 
+def bench_health(num_learners: int = 16, rounds: int = 3):
+    """Learning-health plane cost (telemetry/health.py): the per-uplink
+    statistics pass (update norm + per-layer breakdown + cosine) and the
+    per-round cohort fold at bench model size — the O(params) host work
+    every health-enabled uplink pays, tracked here so a regression shows
+    up in BENCH_r*.json instead of silently taxing every round."""
+    from metisfl_tpu.telemetry.health import HealthMonitor
+
+    params = sum(int(np.prod(s)) for s in MODEL_SHAPES.values())
+    models = synth_models(num_learners, seed=9)
+    reference = synth_models(1, seed=10)[0]
+    monitor = HealthMonitor()
+    monitor.note_community(reference)
+
+    observe_times = []
+    fold_times = []
+    for r in range(rounds):
+        for i, model in enumerate(models):
+            t0 = time.perf_counter()
+            monitor.observe_update(f"learner_{i}", model, reference,
+                                   train_metrics={"loss": 1.0 - 0.1 * r})
+            observe_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        health, _anomalies = monitor.complete_round(
+            r, reference, {f"learner_{i}": 1.0
+                           for i in range(num_learners)})
+        fold_times.append(time.perf_counter() - t0)
+        assert len(health["divergence_score"]) == num_learners
+    return {
+        "health_params": params,
+        "health_learners": num_learners,
+        "health_observe_ms": round(
+            1e3 * sum(observe_times) / len(observe_times), 3),
+        "health_observe_max_ms": round(1e3 * max(observe_times), 3),
+        "health_round_fold_ms": round(
+            1e3 * sum(fold_times) / len(fold_times), 3),
+    }
+
+
 def bench_cohort(sizes=(1024, 4096), stride: int = 64):
     """The FedStride memory-bounding claim at cohort scale (VERDICT r4 #6,
     reference federated_stride.h rationale): 1k-4k distinct 1.64M-param
@@ -942,6 +981,7 @@ _SECTIONS = {
     "decode": lambda a: bench_decode(),
     "e2e": lambda a: bench_e2e_round(),
     "cohort": lambda a: bench_cohort(),
+    "health": lambda a: bench_health(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1127,7 +1167,7 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 # remaining sections to CPU.
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
-                     "e2e": 600, "cohort": 1200, "lora": 600}
+                     "e2e": 600, "cohort": 1200, "health": 240, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1174,7 +1214,7 @@ WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
 _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
-_HOST_SECTIONS = ("ckks", "store", "cohort")
+_HOST_SECTIONS = ("ckks", "store", "cohort", "health")
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_partial.json")
 

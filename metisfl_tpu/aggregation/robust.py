@@ -35,11 +35,14 @@ claim more weight.
 from __future__ import annotations
 
 import functools
-from typing import Sequence
+import logging
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger("metisfl_tpu.aggregation.robust")
 
 from metisfl_tpu.aggregation.base import (
     Pytree,
@@ -89,12 +92,35 @@ def _krum_scores(flat: jnp.ndarray, f: int) -> jnp.ndarray:
 
 
 class _RobustBase:
-    """Common whole-cohort aggregation shell."""
+    """Common whole-cohort aggregation shell.
+
+    ``advisory_scores`` (telemetry.health.advisory): the controller's
+    learning-health divergence scores for the cohort, recorded on
+    ``last_advisory`` and logged — strictly informational, the combine
+    is bit-identical with or without them (robustness stays a property
+    of the rule's math, not of a telemetry signal)."""
 
     required_lineage = 1
     requires_full_cohort = True
+    last_advisory: Optional[Dict[str, float]] = None
 
-    def aggregate(self, models, state=None, learner_ids=None) -> Pytree:
+    def _note_advisory(self, learner_ids,
+                       advisory_scores: Optional[Dict[str, float]]) -> None:
+        if advisory_scores is None:
+            return
+        self.last_advisory = dict(advisory_scores)
+        if learner_ids:
+            flagged = [lid for lid in learner_ids
+                       if advisory_scores.get(lid, 0.0) >= 1.0]
+            if flagged:
+                logger.info(
+                    "%s aggregating a cohort containing divergence-"
+                    "flagged learner(s) %s (advisory; combine unchanged)",
+                    self.name, flagged)
+
+    def aggregate(self, models, state=None, learner_ids=None,
+                  advisory_scores=None) -> Pytree:
+        self._note_advisory(learner_ids, advisory_scores)
         cohort = [lineage[0] for lineage, _scale in models]
         if not cohort:
             raise ValueError(f"{self.name} called with no models")
@@ -208,7 +234,9 @@ class Krum(_RobustBase):
         m = self._select_count(len(cohort))
         return [cohort[int(i)] for i in np.argsort(scores)[:m]]
 
-    def aggregate(self, models, state=None, learner_ids=None) -> Pytree:
+    def aggregate(self, models, state=None, learner_ids=None,
+                  advisory_scores=None) -> Pytree:
+        self._note_advisory(learner_ids, advisory_scores)
         cohort = [lineage[0] for lineage, _scale in models]
         if not cohort:
             raise ValueError(f"{self.name} called with no models")

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from metisfl_tpu.telemetry import events as _tevents
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import metrics as _tmetrics
 
 logger = logging.getLogger("metisfl_tpu.chaos")
@@ -52,7 +53,7 @@ logger = logging.getLogger("metisfl_tpu.chaos")
 ENV_VAR = "METISFL_TPU_CHAOS"
 
 _M_FAULTS = _tmetrics.registry().counter(
-    "chaos_faults_injected_total", "Faults fired by the chaos injector",
+    _tel.M_CHAOS_FAULTS_INJECTED_TOTAL, "Faults fired by the chaos injector",
     ("fault", "side", "method"))
 
 _KILL_EXIT_CODE = 137  # looks like SIGKILL to the supervising driver

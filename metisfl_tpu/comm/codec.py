@@ -19,6 +19,7 @@ from typing import Any
 
 import numpy as np
 
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import trace as _ttrace
 
@@ -26,9 +27,9 @@ from metisfl_tpu.telemetry import trace as _ttrace
 # payloads big enough to matter in a round trace — every tiny ack would
 # otherwise flood the JSONL sink
 _M_CODEC = _tmetrics.registry().histogram(
-    "codec_duration_seconds", "Message codec encode/decode time", ("op",))
+    _tel.M_CODEC_DURATION_SECONDS, "Message codec encode/decode time", ("op",))
 _M_CODEC_BYTES = _tmetrics.registry().counter(
-    "codec_bytes_total", "Message codec bytes by operation", ("op",))
+    _tel.M_CODEC_BYTES_TOTAL, "Message codec bytes by operation", ("op",))
 _SPAN_MIN_BYTES = 1 << 18
 
 _T_NONE = 0x00

@@ -240,6 +240,11 @@ class TaskResult(Message):
     completed_epochs: float = 0.0
     completed_batches: int = 0
     processing_ms_per_step: float = 0.0
+    # Final train-task metrics and the per-epoch trajectory. Consumed
+    # controller-side: recorded into RoundMetadata (experiment.json,
+    # stats.py per-learner convergence tables) and — train_metrics'
+    # "loss" specifically — folded into the learning-health plane's
+    # cohort loss quantiles (telemetry/health.py).
     train_metrics: Dict[str, float] = field(default_factory=dict)
     epoch_metrics: List[Dict[str, float]] = field(default_factory=list)
     # SCAFFOLD client control-variate delta (c_i_new - c_i, ModelBlob);

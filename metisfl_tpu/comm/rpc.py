@@ -31,6 +31,7 @@ import grpc
 
 from metisfl_tpu import chaos as _chaos
 from metisfl_tpu.telemetry import events as _events
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import metrics as _metrics
 from metisfl_tpu.telemetry import trace as _trace
 
@@ -52,28 +53,28 @@ DEFAULT_DEADLINE_S = 120.0
 # so the oversize path visibly costs two invocations for one call.
 _REG = _metrics.registry()
 _M_CLIENT_CALLS = _REG.counter(
-    "rpc_client_calls_total", "Logical client calls (retries collapsed)",
+    _tel.M_RPC_CLIENT_CALLS_TOTAL, "Logical client calls (retries collapsed)",
     ("service", "method", "retried"))
 _M_CLIENT_LATENCY = _REG.histogram(
-    "rpc_client_latency_seconds", "Logical client call latency",
+    _tel.M_RPC_CLIENT_LATENCY_SECONDS, "Logical client call latency",
     ("service", "method"))
 _M_CLIENT_BYTES = _REG.counter(
-    "rpc_client_bytes_total", "Client payload bytes by direction",
+    _tel.M_RPC_CLIENT_BYTES_TOTAL, "Client payload bytes by direction",
     ("service", "method", "direction"))
 _M_CLIENT_ERRORS = _REG.counter(
-    "rpc_client_errors_total", "Client calls that raised after retries",
+    _tel.M_RPC_CLIENT_ERRORS_TOTAL, "Client calls that raised after retries",
     ("service", "method", "code"))
 _M_SERVER_CALLS = _REG.counter(
-    "rpc_server_calls_total", "Handler invocations",
+    _tel.M_RPC_SERVER_CALLS_TOTAL, "Handler invocations",
     ("service", "method", "transport"))
 _M_SERVER_LATENCY = _REG.histogram(
-    "rpc_server_latency_seconds", "Server handler latency",
+    _tel.M_RPC_SERVER_LATENCY_SECONDS, "Server handler latency",
     ("service", "method"))
 _M_SERVER_BYTES = _REG.counter(
-    "rpc_server_bytes_total", "Server payload bytes by direction",
+    _tel.M_RPC_SERVER_BYTES_TOTAL, "Server payload bytes by direction",
     ("service", "method", "direction"))
 _M_SERVER_ERRORS = _REG.counter(
-    "rpc_server_errors_total", "Handler invocations that raised",
+    _tel.M_RPC_SERVER_ERRORS_TOTAL, "Handler invocations that raised",
     ("service", "method"))
 
 

@@ -104,6 +104,28 @@ class EventsConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Learning-health plane (telemetry/health.py): per-uplink update
+    statistics (norms, cohort alignment), per-learner EWMA divergence
+    scores (cohort-median/MAD robust z, the convergence analogue of the
+    straggler score), and per-round convergence snapshots. Controller-
+    side and host-numpy only; ``enabled=false`` leaves the uplink hot
+    path at one attribute check (secure aggregation implies off — the
+    payloads are opaque ciphertext)."""
+
+    enabled: bool = True
+    # EWMA blend for per-learner divergence scores (~last 3-4 rounds
+    # dominate, matching the straggler analytics)
+    alpha: float = 0.3
+    # robust-z threshold past which an uplink emits UpdateAnomalous
+    anomaly_threshold: float = 3.0
+    # advisory hook: pass the scores to selection + robust aggregation
+    # (informational — results are bit-identical either way; the rules
+    # record/log which flagged learners entered the cohort)
+    advisory: bool = False
+
+
+@dataclass
 class TelemetryConfig:
     """Federation-wide observability (metisfl_tpu/telemetry): trace spans
     + metrics registry + event journal. ``enabled=false`` opts the whole
@@ -120,6 +142,8 @@ class TelemetryConfig:
     http_port: int = 0
     # event journal (telemetry/events.py)
     events: EventsConfig = field(default_factory=EventsConfig)
+    # learning-health plane (telemetry/health.py)
+    health: HealthConfig = field(default_factory=HealthConfig)
     # flight-recorder bundle directory (telemetry/postmortem.py): crash /
     # chaos-kill / failover post-mortems land here. "" → recorder off;
     # the driver fills this in with <workdir>/postmortem.
@@ -271,6 +295,14 @@ class FederationConfig:
                 raise ValueError(f"invalid chaos rule: {exc}") from None
         if self.failover.max_controller_restarts < 0:
             raise ValueError("failover.max_controller_restarts must be >= 0")
+        if not 0.0 < self.telemetry.health.alpha <= 1.0:
+            # a typo'd blend weight would silently freeze (0) or unsmooth
+            # (>1 oscillates) every divergence score
+            raise ValueError("telemetry.health.alpha must be in (0, 1]")
+        if self.telemetry.health.anomaly_threshold <= 0.0:
+            # threshold 0 would flag EVERY above-median update anomalous
+            raise ValueError(
+                "telemetry.health.anomaly_threshold must be > 0")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
         if self.train.dp_noise_multiplier < 0.0 or self.train.dp_clip_norm < 0.0:

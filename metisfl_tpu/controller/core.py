@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import os
 import random
 import resource
@@ -53,9 +54,11 @@ from metisfl_tpu.scaling import apply_staleness_decay, make_scaler
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
 from metisfl_tpu.selection import make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import trace as _ttrace
+from metisfl_tpu.telemetry.health import HealthMonitor, finite_metrics
 from metisfl_tpu.tensor.pytree import ModelBlob
 from metisfl_tpu.tensor.spec import quantify
 
@@ -63,25 +66,35 @@ logger = logging.getLogger("metisfl_tpu.controller")
 
 # Round-lifecycle metrics: scraped live via GetMetrics / the /metrics
 # listener while the lineage equivalents (RoundMetadata) stay post-hoc.
+# Names come from the shared constants in telemetry/__init__.py — a typo
+# fails at import instead of minting a new series (SURVEY.md §5.5).
 _REG = _tmetrics.registry()
 _M_ROUND_DURATION = _REG.histogram(
-    "round_duration_seconds", "Federation round wall-clock")
-_M_ROUNDS = _REG.counter("rounds_total", "Completed federation rounds")
+    _tel.M_ROUND_DURATION_SECONDS, "Federation round wall-clock")
+_M_ROUNDS = _REG.counter(_tel.M_ROUNDS_TOTAL, "Completed federation rounds")
 _M_PHASE = _REG.histogram(
-    "round_phase_duration_seconds",
+    _tel.M_ROUND_PHASE_DURATION_SECONDS,
     "Per-phase round durations (dispatch/wait_uplinks/select/aggregate/"
     "aggregate_block/store_insert)", ("phase",))
 _M_UPLINK = _REG.counter(
-    "uplink_bytes_total", "Model bytes received from learners",
+    _tel.M_UPLINK_BYTES_TOTAL, "Model bytes received from learners",
     ("learner",))
 _M_ACTIVE_LEARNERS = _REG.gauge(
-    "controller_active_learners", "Currently registered learners")
+    _tel.M_CONTROLLER_ACTIVE_LEARNERS, "Currently registered learners")
 _M_AGG_FAILURES = _REG.counter(
-    "aggregation_failures_total", "Aggregation attempts that raised")
+    _tel.M_AGGREGATION_FAILURES_TOTAL, "Aggregation attempts that raised")
 _M_STRAGGLER = _REG.gauge(
-    "learner_straggler_score",
+    _tel.M_LEARNER_STRAGGLER_SCORE,
     "Round-relative straggler score: EWMA train duration over the "
     "cohort median (1.0 = typical, >1 = slower)", ("learner",))
+_M_DIVERGENCE = _REG.gauge(
+    _tel.M_LEARNER_DIVERGENCE_SCORE,
+    "Learning-health divergence score: EWMA of the cohort-median/MAD "
+    "robust z of each update's deviation from the cohort mean "
+    "(0 = typical, higher = pulling against the cohort)", ("learner",))
+_M_ROUND_UPDATE_NORM = _REG.gauge(
+    _tel.M_ROUND_UPDATE_NORM,
+    "L2 norm of the latest community-model update (telemetry/health.py)")
 
 # EWMA smoothing for per-learner train/eval durations (straggler
 # analytics): ~the last 3-4 rounds dominate, so a recovered learner's
@@ -172,6 +185,19 @@ class RoundMetadata:
     # smaller uplinks; the reference tracks only decoded tensor sizes)
     uplink_bytes: Dict[str, int] = field(default_factory=dict)
     peak_rss_kb: int = 0
+    # per-learner training metrics as shipped in TaskResult: the final
+    # train_metrics dict and the per-epoch trajectory. Previously
+    # collected on the wire but dropped controller-side — now they land
+    # in experiment.json so stats.py can render per-learner convergence
+    # (absent in pre-health payloads; readers fall back gracefully).
+    train_metrics: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    epoch_metrics: Dict[str, List[Dict[str, float]]] = field(
+        default_factory=dict)
+    # learning-health snapshot for this round (telemetry/health.py):
+    # community update norm, effective step, participation entropy,
+    # per-learner update norms / cohort cosines / divergence scores.
+    # Empty when telemetry.health is off or under secure aggregation.
+    health: Dict[str, Any] = field(default_factory=dict)
     # non-fatal round errors (e.g. partial-cohort secure aggregation after a
     # deadline) — surfaced in lineage instead of vanishing into a log line
     errors: List[str] = field(default_factory=list)
@@ -292,6 +318,20 @@ class Controller:
         # a burst (or re-attaching after a failover) must cost one
         # community-blob write on the scheduling executor, not N
         self._ckpt_queued = False
+
+        # Learning-health plane (telemetry/health.py): per-uplink update
+        # statistics + per-learner divergence scores. None when opted
+        # out or under secure aggregation (opaque payloads) — the uplink
+        # hot path then costs exactly one attribute check.
+        hc = getattr(config.telemetry, "health", None)
+        self._health: Optional[HealthMonitor] = None
+        if (config.telemetry.enabled and hc is not None
+                and getattr(hc, "enabled", False)
+                and not config.secure.enabled):
+            self._health = HealthMonitor(
+                alpha=hc.alpha, anomaly_threshold=hc.anomaly_threshold)
+        self._health_advisory = bool(
+            self._health is not None and getattr(hc, "advisory", False))
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -440,9 +480,9 @@ class Controller:
                 self._tasks_in_flight.pop(tid, None)
                 self._task_dispatched_at.pop(tid, None)
         # bounded metric cardinality under churn: a departed learner's
-        # per-learner series must not accumulate for the process lifetime
-        _M_UPLINK.remove(learner=learner_id)
-        _M_STRAGGLER.remove(learner=learner_id)
+        # per-learner series (uplink bytes, straggler AND divergence
+        # scores) must not accumulate for the process lifetime
+        self._prune_learner_series(learner_id)
         self._store.erase([learner_id])
         logger.info("learner %s left", learner_id)
         _tevents.emit(_tevents.LearnerLost, learner_id=learner_id)
@@ -451,6 +491,16 @@ class Controller:
         if not self._shutdown.is_set():
             self._pool.submit(self._guard, self._handle_membership_change)
         return True
+
+    def _prune_learner_series(self, learner_id: str) -> None:
+        """Drop every per-learner gauge/counter series and health state
+        for a learner that left or was replaced — long-churn runs must
+        not accumulate stale labels in the exposition."""
+        _M_UPLINK.remove(learner=learner_id)
+        _M_STRAGGLER.remove(learner=learner_id)
+        _M_DIVERGENCE.remove(learner=learner_id)
+        if self._health is not None:
+            self._health.drop(learner_id)
 
     def active_learners(self) -> List[str]:
         with self._lock:
@@ -501,6 +551,10 @@ class Controller:
                     self._aggregator.seed_community(self._community_flat)
             if blob.opaque:
                 self._community_opaque = dict(blob.opaque)
+        if self._health is not None and blob.tensors:
+            # anchor the health plane's delta reference at the seeded
+            # model (round/effective-step norms measure from here)
+            self._health.note_community(dict(blob.tensors))
         # Checkpoint the freshly seeded/replaced model immediately: the
         # per-round auto-checkpoint only starts after round 1 completes,
         # so a controller crash during round 1 would otherwise restore to
@@ -666,10 +720,46 @@ class Controller:
                 # from a different task
                 record.completed_batches = result.completed_batches
                 record.last_result_round = result.round_id
+            if self._health is not None and isinstance(model, dict) and model:
+                # learning-health statistics for this uplink (host numpy,
+                # read-only — the stored model is untouched). Reference is
+                # the live community model: under sync/semi-sync exactly
+                # what the task trained from; a late/async uplink measures
+                # against wherever the federation has moved since, which
+                # is the divergence that matters for the NEXT aggregation.
+                # The dict is safe to read un-copied: community updates
+                # REPLACE _community_flat, they never mutate it in place.
+                with self._lock:
+                    reference = self._community_flat or {}
+                try:
+                    self._health.observe_update(
+                        result.learner_id, model, reference,
+                        train_metrics=result.train_metrics)
+                except Exception:  # noqa: BLE001 - telemetry never fatal
+                    logger.exception("health statistics failed for %s",
+                                     result.learner_id)
         if not stale:
             with self._lock:
                 self._current_meta.model_insertion_duration_ms[result.learner_id] = (
                     (time.time() - start) * 1e3)
+                # surface the shipped training metrics into the round's
+                # lineage (experiment.json) — previously dropped on the
+                # controller floor (ISSUE 4 satellite). Values that are
+                # not finite floats are skipped: a zero-step task ships
+                # loss=NaN (strict JSON parsers reject NaN tokens), and
+                # a raising conversion here would swallow schedule_next
+                # via _guard and stall the sync barrier forever — the
+                # wire never validates these learner-shipped dicts
+                if result.train_metrics:
+                    finite = finite_metrics(result.train_metrics)
+                    if finite:
+                        self._current_meta.train_metrics[
+                            result.learner_id] = finite
+                if result.epoch_metrics and isinstance(
+                        result.epoch_metrics, (list, tuple)):
+                    self._current_meta.epoch_metrics[result.learner_id] = [
+                        finite_metrics(epoch)
+                        for epoch in result.epoch_metrics]
         if stale:
             logger.info("late completion from %s for expired task %s stored "
                         "but not scheduled", result.learner_id, result.task_id)
@@ -836,7 +926,15 @@ class Controller:
         select_sp = _ttrace.span("round.select", parent=self._round_span,
                                  attrs={"cohort": len(cohort)})
         with select_sp:
-            selected = self._selector.select(cohort, self.active_learners())
+            if self._health_advisory:
+                # advisory only: the default selector records the scores
+                # for operators/tests without changing its selection
+                selected = self._selector.select(
+                    cohort, self.active_learners(),
+                    advisory_scores=self._health.scores())
+            else:
+                selected = self._selector.select(cohort,
+                                                 self.active_learners())
         _M_PHASE.observe(select_sp.duration_ms / 1e3, phase="select")
         with self._lock:
             self._phase = "aggregate"
@@ -848,6 +946,7 @@ class Controller:
             _tevents.emit(_tevents.AggregationDone,
                           round=self.global_iteration,
                           selected=len(selected), duration_ms=round(agg_ms, 3))
+            self._fold_round_health()
         except Exception as exc:
             _M_AGG_FAILURES.inc()
             self._agg_failures += 1
@@ -1039,11 +1138,19 @@ class Controller:
         elif getattr(self._aggregator, "requires_full_cohort", False):
             # Robust rules (median / trimmed_mean / krum): a median cannot
             # fold stride-wise.
-            pairs, _ = collect_all_pairs()
+            pairs, present_ids = collect_all_pairs()
             if not pairs:
                 logger.warning("no stored models for cohort %s", list(selected))
                 return
-            community = self._aggregator.aggregate(pairs)
+            if self._health_advisory:
+                # advisory hook (telemetry.health.advisory): the rule
+                # records which flagged learners entered the cohort —
+                # the combine itself is bit-identical either way
+                community = self._aggregator.aggregate(
+                    pairs, learner_ids=present_ids,
+                    advisory_scores=self._health.scores())
+            else:
+                community = self._aggregator.aggregate(pairs)
         elif hasattr(self._aggregator, "accumulate"):
             # Fold rules (FedAvg and the ServerOpt family wrapping it):
             # accumulate block-by-block so only one stride block of models is
@@ -1502,6 +1609,12 @@ class Controller:
                 state["agg_state"] = self._aggregator.export_state()
             if self._scaffold_c is not None:
                 state["scaffold_c"] = self._pack_scaffold_c()
+            if self._health is not None:
+                # divergence scores + last-uplink summaries + the latest
+                # round snapshot survive a failover restart (same posture
+                # as the straggler EWMAs above) — scores must not reset
+                # to "everyone is typical" after a crash
+                state["health"] = self._health.export_state()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # unique temp per writer: concurrent saves (per-round auto-checkpoint
@@ -1585,6 +1698,13 @@ class Controller:
             # server-opt restart-correctness: moments + step counter resume
             # the exact update sequence of an uninterrupted run
             self._aggregator.restore_state(agg_state)
+        health_state = state.get("health")
+        if health_state and self._health is not None:
+            self._health.restore_state(health_state)
+            with self._lock:
+                for lid, score in self._health.scores().items():
+                    if lid in self._learners:
+                        _M_DIVERGENCE.set(round(score, 4), learner=lid)
         logger.info("restored checkpoint %s at round %d (%d learner(s) in "
                     "registry, epoch %s)", path, self.global_iteration,
                     len(self._learners), self.controller_epoch[:8])
@@ -1633,6 +1753,49 @@ class Controller:
         return {lid: (v / mid if (v > 0.0 and mid > 0.0) else 0.0)
                 for lid, v in ewmas.items()}
 
+    def _fold_round_health(self) -> None:
+        """Learning-health cohort fold for the round that just aggregated
+        (telemetry/health.py): per-learner cohort cosines + robust-z
+        divergence scores, the round's convergence snapshot into
+        ``RoundMetadata.health``, the ``learner_divergence_score`` /
+        ``round_update_norm`` gauges, and the ``UpdateAnomalous`` /
+        ``RoundHealth`` journal events. Runs on the scheduling executor
+        with ``global_iteration`` still naming the completing round;
+        never raises (telemetry must not trip the aggregation-failure
+        retry path)."""
+        if self._health is None:
+            return
+        try:
+            with self._lock:
+                # replaced, never mutated in place — safe un-copied
+                community = self._community_flat or {}
+                scales = dict(self._current_meta.scales)
+            health, anomalies = self._health.complete_round(
+                self.global_iteration, community, scales)
+            with self._lock:
+                self._current_meta.health = health
+                # set() under the controller lock for the same
+                # churn-prune race reason as the straggler gauge
+                for lid, score in health["divergence_score"].items():
+                    if lid in self._learners:
+                        _M_DIVERGENCE.set(score, learner=lid)
+            _M_ROUND_UPDATE_NORM.set(health["round_update_norm"])
+            for anomaly in anomalies:
+                logger.warning(
+                    "learner %s update anomalous in round %d (robust z "
+                    "%.2f >= %.2f; divergence score %.2f)",
+                    anomaly["learner_id"], anomaly["round"], anomaly["raw"],
+                    self._health.anomaly_threshold, anomaly["score"])
+                _tevents.emit(_tevents.UpdateAnomalous, **anomaly)
+            _tevents.emit(
+                _tevents.RoundHealth, round=health["round"],
+                update_norm=health["round_update_norm"],
+                effective_step=health["effective_step"],
+                participation_entropy=health["participation_entropy"],
+                anomalous=len(anomalies))
+        except Exception:  # noqa: BLE001 - telemetry never fails a round
+            logger.exception("round health fold failed")
+
     def _update_straggler_gauge(self) -> None:
         # set() under the controller lock, like _M_UPLINK.inc: leave()
         # deletes the record under this lock and prunes the series after,
@@ -1649,6 +1812,11 @@ class Controller:
         store occupancy, and the event-ring tail. Read-only and cheap —
         safe to poll every couple of seconds."""
         now = time.time()
+        div_scores: Dict[str, float] = {}
+        div_last: Dict[str, Dict[str, Any]] = {}
+        if self._health is not None:
+            div_scores = self._health.scores()
+            div_last = self._health.last_stats()
         with self._lock:
             scores = self._straggler_scores()
             limit = self.config.max_dispatch_failures
@@ -1665,6 +1833,13 @@ class Controller:
                     "ewma_train_s": round(r.ewma_train_s, 3),
                     "ewma_eval_s": round(r.ewma_eval_s, 3),
                     "straggler_score": round(scores.get(lid, 0.0), 4),
+                    # learning-health analytics (0.0 until observed;
+                    # keys present iff the health plane is on)
+                    **({"divergence_score":
+                        round(div_scores.get(lid, 0.0), 4),
+                        "last_update_norm":
+                        div_last.get(lid, {}).get("update_norm", 0.0)}
+                       if self._health is not None else {}),
                 }
                 for lid, r in sorted(self._learners.items())
             ]
@@ -1694,6 +1869,9 @@ class Controller:
             "events": _tevents.tail(event_tail) if event_tail else [],
             "time": round(now, 6),
         })
+        if self._health is not None:
+            # latest round's convergence snapshot ({} before round 1)
+            snapshot["health"] = self._health.snapshot()
         return snapshot
 
     # ------------------------------------------------------------------ #

@@ -168,9 +168,10 @@ class ControllerServer:
         return render_metrics().encode("utf-8")
 
     def _describe(self, raw: bytes) -> bytes:
-        # live status snapshot (round/phase, per-learner straggler
-        # analytics, in-flight tasks, event-ring tail) — the status
-        # plane behind python -m metisfl_tpu.status
+        # live status snapshot (round/phase, per-learner straggler +
+        # divergence analytics, the learning-health round snapshot,
+        # in-flight tasks, event-ring tail) — the status plane behind
+        # python -m metisfl_tpu.status
         tail = int(loads(raw).get("event_tail", 50)) if raw else 50
         return dumps(self.controller.describe(event_tail=tail))
 

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import cloudpickle
 import numpy as np
 
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.chaos import ENV_VAR as _CHAOS_ENV_VAR
 from metisfl_tpu.comm.messages import TrainParams
 from metisfl_tpu.config import FederationConfig
@@ -46,7 +47,7 @@ logger = logging.getLogger("metisfl_tpu.driver")
 # registry (docs/RESILIENCE.md): each supervised relaunch-with-resume
 # increments this exactly once.
 _M_CTRL_RESTARTS = _tmetrics.registry().counter(
-    "controller_restarts_total",
+    _tel.M_CONTROLLER_RESTARTS_TOTAL,
     "Supervised controller relaunches after a crash")
 
 

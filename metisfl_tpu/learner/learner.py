@@ -41,6 +41,7 @@ from metisfl_tpu.comm.messages import (
 )
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.ops import FlaxModelOps
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import trace as _ttrace
@@ -55,22 +56,22 @@ logger = logging.getLogger("metisfl_tpu.learner")
 
 _REG = _tmetrics.registry()
 _M_TRAIN_DURATION = _REG.histogram(
-    "learner_train_duration_seconds", "End-to-end train-task time")
+    _tel.M_LEARNER_TRAIN_DURATION_SECONDS, "End-to-end train-task time")
 _M_TRAIN_STEP_MS = _REG.histogram(
-    "learner_step_milliseconds", "Median per-optimizer-step time",
+    _tel.M_LEARNER_STEP_MILLISECONDS, "Median per-optimizer-step time",
     buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
              5000))
 _M_JIT_COMPILE = _REG.histogram(
-    "learner_jit_compile_seconds",
+    _tel.M_LEARNER_JIT_COMPILE_SECONDS,
     "Estimated jit-compile overhead per train task (task wall-clock "
     "minus steps x steady-state step time)")
 _M_TASKS = _REG.counter(
-    "learner_tasks_total", "Train tasks by outcome",
+    _tel.M_LEARNER_TASKS_TOTAL, "Train tasks by outcome",
     ("outcome",))
 _M_EVALS = _REG.histogram(
-    "learner_eval_duration_seconds", "Community-model evaluation time")
+    _tel.M_LEARNER_EVAL_DURATION_SECONDS, "Community-model evaluation time")
 _M_REATTACH = _REG.counter(
-    "learner_reattach_total",
+    _tel.M_LEARNER_REATTACH_TOTAL,
     "Re-attach joins after a controller crash/restart was detected",
     ("reason",))
 

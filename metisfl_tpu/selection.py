@@ -9,13 +9,23 @@ the rest of the federation); otherwise exactly the scheduled set.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 class ScheduledCardinalitySelector:
     name = "scheduled_cardinality"
 
-    def select(self, scheduled: Sequence[str], active: Sequence[str]) -> List[str]:
+    def __init__(self):
+        # latest advisory divergence scores the controller handed over
+        # (telemetry.health.advisory) — recorded for operators/tests;
+        # this selector's choice is deliberately unaffected by them
+        self.last_advisory_scores: Optional[Dict[str, float]] = None
+
+    def select(self, scheduled: Sequence[str], active: Sequence[str],
+               advisory_scores: Optional[Dict[str, float]] = None,
+               ) -> List[str]:
+        if advisory_scores is not None:
+            self.last_advisory_scores = dict(advisory_scores)
         if len(scheduled) < 2:
             return list(active)
         return [lid for lid in scheduled if lid in set(active)]

@@ -8,8 +8,12 @@ cohort, aggregation time, model size) and per-metric convergence
 summaries from the ``experiment.json`` a driver writes, plus an optional
 ``--plot out.png`` convergence figure (metric curves over evaluated
 rounds + per-round wall-clock/aggregation bars) when matplotlib is
-available. Usable as a library via :func:`summarize` /
-:func:`metric_series` / :func:`plot_convergence`.
+available. Payloads from a health-enabled controller additionally carry
+per-round ``health`` snapshots and per-learner ``train_metrics``/
+``epoch_metrics``, rendered as a per-learner learning-health table
+(:func:`learning_health_summary`, :func:`epoch_loss_series`); older
+payloads render exactly as before. Usable as a library via
+:func:`summarize` / :func:`metric_series` / :func:`plot_convergence`.
 """
 
 from __future__ import annotations
@@ -92,6 +96,24 @@ def summarize(stats: Dict[str, Any]) -> str:
                     f"max={row['max_s']:.2f}s rel={row['rel']:.2f}x "
                     f"over {row['rounds']} round(s)")
 
+        health = learning_health_summary(stats)
+        if health:
+            lines.append("")
+            lines.append("per-learner learning health (divergence score = "
+                         "EWMA cohort-relative robust z; telemetry/health):")
+            for row in health:
+                loss = ""
+                if row["first_loss"] is not None:
+                    loss = (f" loss {row['first_loss']:.4f}"
+                            f"→{row['last_loss']:.4f}")
+                anom = (f" anomalous in {row['anomalous_rounds']} round(s)"
+                        if row["anomalous_rounds"] else "")
+                lines.append(
+                    f"  {row['learner']:<28} div last={row['last_div']:.2f} "
+                    f"max={row['max_div']:.2f} "
+                    f"upd_norm mean={row['mean_update_norm']:.3g}"
+                    f"{loss}{anom}")
+
     series = metric_series(stats)
     if series:
         lines.append("")
@@ -140,6 +162,75 @@ def straggler_summary(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
     ]
     rows.sort(key=lambda r: -r["mean_s"])
     return rows
+
+
+def learning_health_summary(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Post-hoc per-learner convergence/health table from round metadata:
+    divergence scores and update norms (``health`` snapshots written by
+    telemetry/health.py) joined with the per-learner train-loss
+    trajectory (``train_metrics``/``epoch_metrics`` — the fields
+    TaskResult always shipped and the controller now records). Sorted by
+    last divergence score, highest first. Backward compatible: payloads
+    written before the health plane (no ``health``/``train_metrics``
+    keys) return []."""
+    per: Dict[str, Dict[str, Any]] = {}
+
+    def row(lid: str) -> Dict[str, Any]:
+        return per.setdefault(lid, {
+            "learner": lid, "last_div": 0.0, "max_div": 0.0,
+            "update_norms": [], "anomalous_rounds": 0,
+            "first_loss": None, "last_loss": None})
+
+    for meta in stats.get("round_metadata", []):
+        health = meta.get("health") or {}
+        for lid, score in (health.get("divergence_score") or {}).items():
+            r = row(lid)
+            r["last_div"] = float(score)
+            r["max_div"] = max(r["max_div"], float(score))
+        for lid, norm in (health.get("update_norms") or {}).items():
+            row(lid)["update_norms"].append(float(norm))
+        for lid in health.get("anomalous") or []:
+            row(lid)["anomalous_rounds"] += 1
+        # train-loss trajectory: prefer the per-epoch records (finest
+        # resolution); the task-level train_metrics (a MEAN over the
+        # whole task) only fills in for learners with no epoch data
+        # this round — it must not overwrite the final-epoch loss
+        had_epochs = set()
+        for lid, epochs in (meta.get("epoch_metrics") or {}).items():
+            losses = [e["loss"] for e in epochs if "loss" in e]
+            if losses:
+                had_epochs.add(lid)
+                r = row(lid)
+                if r["first_loss"] is None:
+                    r["first_loss"] = float(losses[0])
+                r["last_loss"] = float(losses[-1])
+        for lid, tm in (meta.get("train_metrics") or {}).items():
+            if "loss" in tm and lid not in had_epochs:
+                r = row(lid)
+                if r["first_loss"] is None:
+                    r["first_loss"] = float(tm["loss"])
+                r["last_loss"] = float(tm["loss"])
+    if not per:
+        return []
+    rows = []
+    for r in per.values():
+        norms = r.pop("update_norms")
+        r["mean_update_norm"] = (sum(norms) / len(norms)) if norms else 0.0
+        rows.append(r)
+    rows.sort(key=lambda r: -r["last_div"])
+    return rows
+
+
+def epoch_loss_series(stats: Dict[str, Any]) -> Dict[str, List[float]]:
+    """``{learner: [per-epoch train losses across all rounds, in round
+    order]}`` from the ``epoch_metrics`` now recorded in round metadata.
+    Empty for pre-health payloads (backward compatible)."""
+    series: Dict[str, List[float]] = {}
+    for meta in stats.get("round_metadata", []):
+        for lid, epochs in (meta.get("epoch_metrics") or {}).items():
+            series.setdefault(lid, []).extend(
+                float(e["loss"]) for e in epochs if "loss" in e)
+    return series
 
 
 def metric_series(stats: Dict[str, Any]) -> Dict[str, List[float]]:

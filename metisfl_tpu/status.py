@@ -12,8 +12,12 @@ that a stalled run say *where* it is stuck while it is stuck:
 Each refresh shows the current round + phase, per-learner liveness and
 straggler analytics (EWMA train/eval durations and the round-relative
 ``straggler_score`` also exported as the ``learner_straggler_score``
-gauge), in-flight tasks with ages, store occupancy, and the tail of the
-controller's event journal. ``--probe`` additionally reflects each
+gauge), learning-health analytics when the controller runs the health
+plane (a ``health:`` line with the latest round's update norm /
+effective step / participation entropy / cohort loss, plus per-learner
+``diverg``/``upd_norm`` columns mirroring the
+``learner_divergence_score`` gauge), in-flight tasks with ages, store
+occupancy, and the tail of the controller's event journal. ``--probe`` additionally reflects each
 registered endpoint's RPC surface over the ``ListMethods`` RPC
 (service-discovery parity with the reference's gRPC reflection).
 """
@@ -48,18 +52,42 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
         f"{age}  protocol={snap.get('protocol', '?')}  "
         f"rule={snap.get('aggregation_rule', '?')}  "
         f"learners={live}/{len(learners)} live")
+    health = snap.get("health") or {}
+    if health:
+        # learning-health line (telemetry/health.py round snapshot);
+        # pre-health controllers ship no "health" key and render as before
+        loss = health.get("cohort_loss") or {}
+        loss_cell = f"  loss_p50={loss['p50']:.4f}" if "p50" in loss else ""
+        anomalous = health.get("anomalous") or []
+        anom_cell = f"  ANOMALOUS={','.join(anomalous)}" if anomalous else ""
+        lines.append(
+            f"health: upd_norm={health.get('round_update_norm', 0.0):.4g}  "
+            f"eff_step={health.get('effective_step', 0.0):.4g}  "
+            f"entropy={health.get('participation_entropy', 0.0):.2f}"
+            f"{loss_cell}{anom_cell}")
+    has_div = any("divergence_score" in l for l in learners)
     if learners:
         lines.append("")
+        div_header = f"{'diverg':>7} {'upd_norm':>8} " if has_div else ""
         lines.append(f"{'learner':<28} {'live':>4} {'straggler':>9} "
+                     f"{div_header}"
                      f"{'ewma_train':>10} {'ewma_eval':>9} {'fails':>5} "
                      f"{'last_round':>10} {'stored':>6}")
         stored = (snap.get("store") or {}).get("models", {})
         for l in learners:
             score = float(l.get("straggler_score", 0.0))
+            div_cells = ""
+            if has_div:
+                div = float(l.get("divergence_score", 0.0))
+                norm = float(l.get("last_update_norm", 0.0))
+                div_cells = (
+                    f"{(f'{div:.2f}' if div > 0 else '-'):>7} "
+                    f"{(f'{norm:.3g}' if norm > 0 else '-'):>8} ")
             lines.append(
                 f"{l.get('learner_id', '?'):<28} "
                 f"{'yes' if l.get('live') else 'NO':>4} "
                 f"{(f'{score:.2f}x' if score > 0 else '-'):>9} "
+                f"{div_cells}"
                 f"{_fmt_s(float(l.get('ewma_train_s', 0.0))):>10} "
                 f"{_fmt_s(float(l.get('ewma_eval_s', 0.0))):>9} "
                 f"{l.get('dispatch_failures', 0):>5} "

@@ -19,17 +19,18 @@ import numpy as np
 
 from metisfl_tpu.store.base import EvictionPolicy
 from metisfl_tpu.store.disk import _MISS, DiskModelStore
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import metrics as _tmetrics
 
 _REG = _tmetrics.registry()
 _M_CACHE_HITS = _REG.counter(
-    "store_cache_hits_total", "Model-store cache hits")
+    _tel.M_STORE_CACHE_HITS_TOTAL, "Model-store cache hits")
 _M_CACHE_MISSES = _REG.counter(
-    "store_cache_misses_total", "Model-store cache misses (disk reads)")
+    _tel.M_STORE_CACHE_MISSES_TOTAL, "Model-store cache misses (disk reads)")
 _M_CACHE_BYTES = _REG.gauge(
-    "store_cache_resident_bytes", "Decoded models resident in the cache")
+    _tel.M_STORE_CACHE_RESIDENT_BYTES, "Decoded models resident in the cache")
 _M_CACHE_ENTRIES = _REG.gauge(
-    "store_cache_entries", "Models resident in the cache")
+    _tel.M_STORE_CACHE_ENTRIES, "Models resident in the cache")
 
 
 def _value_nbytes(value: Any) -> int:

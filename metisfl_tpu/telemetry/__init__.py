@@ -20,6 +20,12 @@ while it is stuck, not after the experiment ends):
 - :mod:`metisfl_tpu.telemetry.postmortem` — the flight recorder: on an
   unhandled crash, chaos kill, or failover relaunch, a process dumps its
   event tail + open spans + metrics into ``<workdir>/postmortem/``.
+- :mod:`metisfl_tpu.telemetry.health` — the learning-health plane:
+  per-uplink update statistics, per-learner divergence scores, and
+  per-round convergence snapshots, computed controller-side and
+  surfaced through every plane above (opt-out via
+  ``telemetry.health.enabled=false``; controller-local, so
+  :func:`apply_config` has nothing process-global to arm for it).
 - ``python -m metisfl_tpu.telemetry <trace dir or .jsonl>`` renders a
   round's span tree from the sink; ``--postmortem`` renders the
   pre-crash timeline from bundles; ``python -m metisfl_tpu.status``
@@ -33,7 +39,7 @@ attribute-check cheap.
 
 from __future__ import annotations
 
-from metisfl_tpu.telemetry import events, metrics, postmortem, trace
+from metisfl_tpu.telemetry import events, health, metrics, postmortem, trace
 from metisfl_tpu.telemetry.metrics import parse_exposition, registry
 from metisfl_tpu.telemetry.trace import (
     METADATA_KEY,
@@ -44,10 +50,62 @@ from metisfl_tpu.telemetry.trace import (
     span,
 )
 
+# --------------------------------------------------------------------- #
+# Canonical metric series names. SURVEY.md §5.5 flags stringly-typed
+# metric names as a reference defect (config/federation.py:16 cites it):
+# every registration site and every scrape-side consumer imports these,
+# so a typo fails at import time instead of silently minting a new
+# series. The full catalog (types, labels, semantics) lives in
+# docs/OBSERVABILITY.md "Metric names and labels".
+# --------------------------------------------------------------------- #
+
+# controller round lifecycle (controller/core.py)
+M_ROUND_DURATION_SECONDS = "round_duration_seconds"
+M_ROUNDS_TOTAL = "rounds_total"
+M_ROUND_PHASE_DURATION_SECONDS = "round_phase_duration_seconds"
+M_UPLINK_BYTES_TOTAL = "uplink_bytes_total"
+M_CONTROLLER_ACTIVE_LEARNERS = "controller_active_learners"
+M_AGGREGATION_FAILURES_TOTAL = "aggregation_failures_total"
+M_LEARNER_STRAGGLER_SCORE = "learner_straggler_score"
+# learning-health plane (controller/core.py + telemetry/health.py)
+M_LEARNER_DIVERGENCE_SCORE = "learner_divergence_score"
+M_ROUND_UPDATE_NORM = "round_update_norm"
+# learner runtime (learner/learner.py)
+M_LEARNER_TRAIN_DURATION_SECONDS = "learner_train_duration_seconds"
+M_LEARNER_STEP_MILLISECONDS = "learner_step_milliseconds"
+M_LEARNER_JIT_COMPILE_SECONDS = "learner_jit_compile_seconds"
+M_LEARNER_TASKS_TOTAL = "learner_tasks_total"
+M_LEARNER_EVAL_DURATION_SECONDS = "learner_eval_duration_seconds"
+M_LEARNER_REATTACH_TOTAL = "learner_reattach_total"
+# RPC transport (comm/rpc.py)
+M_RPC_CLIENT_CALLS_TOTAL = "rpc_client_calls_total"
+M_RPC_CLIENT_LATENCY_SECONDS = "rpc_client_latency_seconds"
+M_RPC_CLIENT_BYTES_TOTAL = "rpc_client_bytes_total"
+M_RPC_CLIENT_ERRORS_TOTAL = "rpc_client_errors_total"
+M_RPC_SERVER_CALLS_TOTAL = "rpc_server_calls_total"
+M_RPC_SERVER_LATENCY_SECONDS = "rpc_server_latency_seconds"
+M_RPC_SERVER_BYTES_TOTAL = "rpc_server_bytes_total"
+M_RPC_SERVER_ERRORS_TOTAL = "rpc_server_errors_total"
+# wire codec (comm/codec.py)
+M_CODEC_DURATION_SECONDS = "codec_duration_seconds"
+M_CODEC_BYTES_TOTAL = "codec_bytes_total"
+# model store cache (store/cached.py)
+M_STORE_CACHE_HITS_TOTAL = "store_cache_hits_total"
+M_STORE_CACHE_MISSES_TOTAL = "store_cache_misses_total"
+M_STORE_CACHE_RESIDENT_BYTES = "store_cache_resident_bytes"
+M_STORE_CACHE_ENTRIES = "store_cache_entries"
+# integrity framing (tensor/pytree.py)
+M_CORRUPT_PAYLOADS_TOTAL = "corrupt_payloads_total"
+# chaos injector (chaos/injector.py)
+M_CHAOS_FAULTS_INJECTED_TOTAL = "chaos_faults_injected_total"
+# driver failover supervision (driver/session.py)
+M_CONTROLLER_RESTARTS_TOTAL = "controller_restarts_total"
+
 __all__ = [
     "metrics",
     "trace",
     "events",
+    "health",
     "postmortem",
     "registry",
     "parse_exposition",
@@ -59,7 +117,7 @@ __all__ = [
     "METADATA_KEY",
     "apply_config",
     "render_metrics",
-]
+] + [name for name in dir() if name.startswith("M_")]
 
 
 def render_metrics() -> str:

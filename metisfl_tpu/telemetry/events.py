@@ -127,11 +127,40 @@ class FailoverBegan:
     exit_code: Optional[int] = None
 
 
+@dataclass(frozen=True)
+class UpdateAnomalous:
+    """A learner's update diverged from its cohort past the configured
+    robust-z threshold (telemetry/health.py; ``raw`` is this round's
+    z-score, ``score`` the EWMA divergence score after folding it)."""
+
+    kind: ClassVar[str] = "update_anomalous"
+    learner_id: str
+    round: int = 0
+    score: float = 0.0
+    raw: float = 0.0
+    update_norm: float = 0.0
+
+
+@dataclass(frozen=True)
+class RoundHealth:
+    """Per-round learning-health snapshot (telemetry/health.py):
+    community update norm, effective step size, participation entropy,
+    and how many cohort updates scored anomalous."""
+
+    kind: ClassVar[str] = "round_health"
+    round: int
+    update_norm: float = 0.0
+    effective_step: float = 0.0
+    participation_entropy: float = 0.0
+    anomalous: int = 0
+
+
 EVENT_TYPES: Dict[str, type] = {
     cls.kind: cls
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
                 TaskCompleted, RetryScheduled, FaultInjected, EpochChanged,
-                AggregationDone, FailoverBegan)
+                AggregationDone, FailoverBegan, UpdateAnomalous,
+                RoundHealth)
 }
 
 

@@ -19,6 +19,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 import jax
 
+from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.tensor.spec import (
     TensorKind,
@@ -42,7 +43,7 @@ _BLOB_VERSION = 2
 # RPC layer surfaces the ValueError as INVALID_ARGUMENT; the controller's
 # malformed-result path drops the contribution without stalling the round.
 _M_CORRUPT = _tmetrics.registry().counter(
-    "corrupt_payloads_total",
+    _tel.M_CORRUPT_PAYLOADS_TOTAL,
     "Model blobs rejected by length/checksum integrity framing")
 
 

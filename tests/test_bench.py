@@ -46,6 +46,16 @@ def test_store_bench_section():
     assert out["store_disk_insert_ms"] > 0
 
 
+def test_health_bench_section():
+    import bench
+
+    out = bench.bench_health(num_learners=3, rounds=2)
+    assert out["health_learners"] == 3
+    assert out["health_params"] > 1_000_000        # bench model size
+    assert out["health_observe_ms"] > 0
+    assert out["health_round_fold_ms"] > 0
+
+
 def test_decode_bench_gates_on_tpu_and_registers():
     """Off-TPU the decode section reports nothing (tokens/sec vs a CPU is
     meaningless); it must still be wired into both full-mode paths."""
@@ -424,11 +434,12 @@ def test_watcher_capture_never_clobbers_onchip_official(tmp_path,
 def test_new_sections_registered():
     import bench
 
-    for name in ("e2e", "cohort", "lora"):
+    for name in ("e2e", "cohort", "lora", "health"):
         assert name in bench._SECTIONS
         assert name in bench._SECTION_TIMEOUTS
     assert "lora" == bench._DEVICE_SECTIONS[-1]  # likeliest wedge last
     assert "cohort" in bench._HOST_SECTIONS
+    assert "health" in bench._HOST_SECTIONS      # host-numpy only
     # watcher items cover the new device sections
     import importlib.util as _ilu
     spec = _ilu.spec_from_file_location(

@@ -482,6 +482,22 @@ def test_controller_crash_failover_midround(tmp_path, capsys):
             assert set(meta["train_received_at"]) <= set(stats["learners"])
         # no ghost registrations: still exactly two learners
         assert len(stats["learners"]) == 2, stats["learners"]
+        # ---- learning health survives the failover (ISSUE 4) ----
+        # every round that completed (all of them post-restore: the kill
+        # fired before round 1 could finish) carries its health snapshot,
+        # and the train metrics the learners shipped are in the lineage
+        for meta in stats["round_metadata"]:
+            assert meta.get("health"), meta.get("global_iteration")
+            assert "round_update_norm" in meta["health"]
+            assert set(meta["health"]["divergence_score"]) <= \
+                set(stats["learners"])
+            assert meta.get("train_metrics"), "shipped metrics dropped"
+        # the restored controller's live snapshot reports the health
+        # plane (scores restored from the checkpoint + later rounds)
+        live = session._client.describe_federation(timeout=15.0)
+        assert "health" in live
+        for learner in live["learners"]:
+            assert "divergence_score" in learner
         # at least one learner observed the new controller epoch and
         # re-attached (scraped over the learner's GetMetrics RPC)
         reattaches = 0.0

@@ -72,6 +72,58 @@ def test_phase_breakdown_columns_when_present():
     assert "0.0ms" in row2
 
 
+def test_epoch_metrics_and_health_reader_backward_compatible():
+    """ISSUE 4 satellite regression: payloads WITHOUT the new
+    train/epoch-metrics + health fields (pre-health experiment.json)
+    render exactly as before; payloads WITH them grow the per-learner
+    learning-health table and the epoch-loss series."""
+    from metisfl_tpu.stats import (epoch_loss_series,
+                                   learning_health_summary)
+
+    old = _stats()
+    assert learning_health_summary(old) == []
+    assert epoch_loss_series(old) == {}
+    assert "learning health" not in summarize(old)
+
+    stats = _stats()
+    stats["round_metadata"][0].update({
+        "train_metrics": {"a": {"loss": 0.9}, "b": {"loss": 0.8}},
+        "epoch_metrics": {"a": [{"loss": 1.1}, {"loss": 0.9}]},
+        "health": {"round": 1, "round_update_norm": 2.5,
+                   "effective_step": 0.1, "participation_entropy": 1.0,
+                   "update_norms": {"a": 1.0, "b": 20.0},
+                   "divergence_score": {"a": 0.0, "b": 6.2},
+                   "anomalous": ["b"]},
+    })
+    stats["round_metadata"][1].update({
+        "train_metrics": {"a": {"loss": 0.4}},
+        "epoch_metrics": {"a": [{"loss": 0.5}, {"loss": 0.4}]},
+    })
+    rows = learning_health_summary(stats)
+    assert rows[0]["learner"] == "b"       # highest divergence first
+    assert rows[0]["last_div"] == pytest.approx(6.2)
+    assert rows[0]["anomalous_rounds"] == 1
+    by_id = {r["learner"]: r for r in rows}
+    # epoch metrics win for the trajectory (finest resolution): first
+    # epoch of round 1 → last epoch of round 2; the task-MEAN
+    # train_metrics loss (0.9 / 0.4... both rounds ship one) must not
+    # overwrite the final-epoch value
+    assert by_id["a"]["first_loss"] == pytest.approx(1.1)
+    assert by_id["a"]["last_loss"] == pytest.approx(0.4)
+    stats["round_metadata"][1]["train_metrics"]["a"]["loss"] = 99.0
+    by_id2 = {r["learner"]: r
+              for r in learning_health_summary(stats)}
+    assert by_id2["a"]["last_loss"] == pytest.approx(0.4)
+    # a learner with only task-level train_metrics still gets a loss
+    assert by_id["b"]["first_loss"] == pytest.approx(0.8)
+    assert epoch_loss_series(stats)["a"] == [1.1, 0.9, 0.5, 0.4]
+
+    text = summarize(stats)
+    assert "per-learner learning health" in text
+    assert "anomalous in 1 round(s)" in text
+    assert "loss 1.1000→0.4000" in text
+
+
 def test_cli_reads_experiment_json(tmp_path):
     path = tmp_path / "experiment.json"
     path.write_text(json.dumps(_stats()))
