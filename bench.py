@@ -838,6 +838,93 @@ def bench_health(num_learners: int = 16, rounds: int = 3):
     }
 
 
+def bench_serving(requests: int = 64, rows_per_request: int = 4,
+                  max_batch: int = 32):
+    """Serving-gateway section (serving/gateway.py): micro-batched vs
+    unbatched forward throughput and the hot-swap pause at bench model
+    size. The batched/unbatched ratio is the amortization the
+    micro-batching queue buys (one padded jitted forward per bucket vs
+    one per request); the swap pause is how long a promotion blocks the
+    NEXT batch (in-flight ones keep the old model — zero drops)."""
+    import threading as _threading
+
+    import jax
+
+    from metisfl_tpu.config import ServingConfig
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.serving import ServingGateway
+    from metisfl_tpu.tensor.pytree import pack_model
+
+    # bench model size: a ~1.3M-param MLP forward (the MODEL_SHAPES scale
+    # the aggregation/health sections use)
+    dim, hidden = 256, (1024, 1024)
+    ops = FlaxModelOps(MLP(features=hidden, num_outputs=64),
+                       np.zeros((2, dim), np.float32), rng_seed=0)
+    params = sum(int(np.prod(np.shape(a))) for a in
+                 jax.tree.leaves(ops.get_variables()))
+    blob = pack_model(ops.get_variables())
+    # max_wait_ms=0: the sequential baseline must not pay a coalescing
+    # window per request (it would measure the wait, not the forward);
+    # concurrent requests still coalesce from the queue backlog, which
+    # is the amortization actually being claimed
+    gw = ServingGateway(ops, ServingConfig(
+        enabled=True, max_batch=max_batch, max_wait_ms=0.0))
+    gw.install("stable", 1, blob)
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((rows_per_request, dim)).astype(np.float32)
+          for _ in range(requests)]
+    gw.predict(xs[0], key="warmup")  # compile outside the timed window
+
+    t0 = time.perf_counter()
+    for i, x in enumerate(xs):
+        gw.predict(x, key=f"seq{i}")
+    unbatched_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    threads = [_threading.Thread(
+        target=lambda x=x, i=i: gw.predict(x, key=f"par{i}"))
+        for i, x in enumerate(xs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batched_s = time.perf_counter() - t0
+
+    # hot-swap pause: how long install() (decode + install) takes, and
+    # the worst request latency observed while swapping under load
+    stop = _threading.Event()
+    worst_ms = [0.0]
+
+    def hammer():
+        while not stop.is_set():
+            t1 = time.perf_counter()
+            gw.predict(xs[0], key="hammer")
+            worst_ms[0] = max(worst_ms[0],
+                              (time.perf_counter() - t1) * 1e3)
+
+    t = _threading.Thread(target=hammer)
+    t.start()
+    time.sleep(0.05)
+    t0 = time.perf_counter()
+    gw.install("stable", 2, blob)
+    swap_s = time.perf_counter() - t0
+    time.sleep(0.05)
+    stop.set()
+    t.join()
+    gw.shutdown()
+    total_rows = requests * rows_per_request
+    return {
+        "serving_params": params,
+        "serving_requests": requests,
+        "serving_unbatched_rows_per_sec": round(total_rows / unbatched_s, 1),
+        "serving_batched_rows_per_sec": round(total_rows / batched_s, 1),
+        "serving_batch_speedup": round(unbatched_s / batched_s, 2),
+        "serving_swap_pause_ms": round(swap_s * 1e3, 3),
+        "serving_swap_worst_request_ms": round(worst_ms[0], 3),
+    }
+
+
 def bench_cohort(sizes=(1024, 4096), stride: int = 64):
     """The FedStride memory-bounding claim at cohort scale (VERDICT r4 #6,
     reference federated_stride.h rationale): 1k-4k distinct 1.64M-param
@@ -982,6 +1069,7 @@ _SECTIONS = {
     "e2e": lambda a: bench_e2e_round(),
     "cohort": lambda a: bench_cohort(),
     "health": lambda a: bench_health(),
+    "serving": lambda a: bench_serving(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1167,7 +1255,8 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 # remaining sections to CPU.
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
-                     "e2e": 600, "cohort": 1200, "health": 240, "lora": 600}
+                     "e2e": 600, "cohort": 1200, "health": 240,
+                     "serving": 300, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1214,7 +1303,7 @@ WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
 _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
-_HOST_SECTIONS = ("ckks", "store", "cohort", "health")
+_HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving")
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_partial.json")
 

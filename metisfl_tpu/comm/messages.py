@@ -314,6 +314,30 @@ class InferTask(Message):
 
 
 @dataclass
+class ServeRequest(Message):
+    """Serving-gateway inference request (serving/gateway.py). Unlike
+    :class:`InferTask`, no model rides along — the gateway serves the
+    registry's promoted community model, hot-swapped server-side."""
+
+    request_id: str = ""
+    # deterministic canary routing key (a session/user id); "" falls back
+    # to request_id so every request still routes deterministically
+    key: str = ""
+    inputs: bytes = b""         # packed {"x": array} ModelBlob
+
+
+@dataclass
+class ServeReply(Message):
+    request_id: str = ""
+    predictions: bytes = b""    # packed {"predictions": array} ModelBlob
+    # which registry version / channel actually served this request —
+    # canary observability is per-response, not config inference
+    model_version: int = 0
+    channel: str = ""
+    duration_ms: float = 0.0
+
+
+@dataclass
 class InferResult(Message):
     task_id: str = ""
     learner_id: str = ""

@@ -137,8 +137,13 @@ class BytesService:
     """
 
     def __init__(self, service_name: str,
-                 handlers: Dict[str, Callable[[bytes], bytes]]):
+                 handlers: Dict[str, Callable[[bytes], bytes]],
+                 role: str = ""):
         self.service_name = service_name
+        # endpoint role ("controller" | "learner" | "serving" | ...): the
+        # status CLI's --probe tells a serving gateway apart from a
+        # learner without guessing from method names
+        self.role = role
         self.handlers = dict(handlers)
         self.handlers.setdefault("ListMethods", self._list_methods)
 
@@ -148,8 +153,10 @@ class BytesService:
              "oversize_unary_fallback": True}
             for name in sorted(self.handlers)
         ]
-        return json.dumps({"service": self.service_name,
-                           "methods": methods}).encode("utf-8")
+        reply = {"service": self.service_name, "methods": methods}
+        if self.role:
+            reply["role"] = self.role
+        return json.dumps(reply).encode("utf-8")
 
     def _generic_handler(self) -> grpc.GenericRpcHandler:
         method_handlers = {}

@@ -195,6 +195,81 @@ class ChaosConfig:
 
 
 @dataclass
+class PromotionConfig:
+    """Promotion gate for the model registry (registry/registry.py): a
+    candidate version moves to the ``stable`` channel only when every
+    enabled rule passes. ``auto=false`` leaves promotion entirely to the
+    operator (the ``PromoteVersion`` RPC)."""
+
+    auto: bool = True
+    # eval metric compared against the current stable version, as a
+    # "<dataset>/<metric>" key of the folded community evaluation (mean
+    # across learners). The candidate must not regress past min_delta:
+    # loss/error-like metrics improve downward, everything else upward.
+    metric: str = "test/accuracy"
+    min_delta: float = 0.0
+    # refuse to promote before the version's eval round-trip reported
+    # back (false: metric rule only applies once metrics exist)
+    require_eval: bool = True
+    # refuse to promote a version whose source round scored any learner
+    # update anomalous (UpdateAnomalous / health["anomalous"])
+    forbid_anomalies: bool = True
+    # bounded divergence-score quantile from the learning-health plane:
+    # the source round's per-learner divergence scores at
+    # ``divergence_quantile`` must stay <= max_divergence (0 = rule off)
+    max_divergence: float = 0.0
+    divergence_quantile: float = 0.9
+
+
+@dataclass
+class RegistryConfig:
+    """Versioned community-model registry (registry/registry.py): every
+    successful aggregation registers a candidate version (monotonic id,
+    round, parent, config hash, health snapshot, eval metrics once they
+    report), channel-promoted candidate → stable through the gate above,
+    with explicit rollback and bounded retention GC. Lineage persists
+    through the controller checkpoint so it survives ``--resume``
+    failover. ``enabled=false`` keeps the post-aggregation path at one
+    attribute check."""
+
+    enabled: bool = False
+    # retired + candidate versions kept beyond the channel heads; older
+    # ones are garbage-collected (their blobs erased from the store and
+    # their per-version gauge series pruned)
+    retention: int = 5
+    promotion: PromotionConfig = field(default_factory=PromotionConfig)
+
+
+@dataclass
+class ServingConfig:
+    """Serving gateway (serving/gateway.py): a driver-bootable process
+    (``python -m metisfl_tpu.serving``) serving inference over the
+    federation's BytesService RPC with a micro-batching queue, atomic
+    hot-swap to newly promoted versions, and a percentage-based canary
+    split toward the ``candidate`` channel. Requires the registry."""
+
+    enabled: bool = False
+    host: str = "0.0.0.0"
+    # gateway gRPC port (0: the driver picks a free one at launch)
+    port: int = 0
+    # micro-batching: coalesce concurrent requests until the batch holds
+    # max_batch rows or max_wait_ms elapsed since the first queued row.
+    # Every forward pass pads to exactly max_batch rows (one compiled
+    # program, and per-row results stay bit-identical to unbatched).
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    # deterministic canary: requests whose key hashes into the lowest
+    # canary_percent slots route to the candidate channel (0 = all stable)
+    canary_percent: float = 0.0
+    # registry poll period: how often the gateway compares channel heads
+    # against the controller and hot-swaps on change
+    poll_every_s: float = 1.0
+    # which learner recipe builds the gateway's model engine (the forward
+    # pass needs the same architecture the federation trains)
+    recipe_index: int = 0
+
+
+@dataclass
 class CheckpointConfig:
     """Controller-side global checkpoint (SURVEY.md §5.4: the reference has
     no resume flow; community model + round counter are rebuilt here)."""
@@ -247,6 +322,8 @@ class FederationConfig:
     secure: SecureAggConfig = field(default_factory=SecureAggConfig)
     termination: TerminationConfig = field(default_factory=TerminationConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    registry: RegistryConfig = field(default_factory=RegistryConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     comm: CommConfig = field(default_factory=CommConfig)
     failover: FailoverConfig = field(default_factory=FailoverConfig)
@@ -295,6 +372,39 @@ class FederationConfig:
                 raise ValueError(f"invalid chaos rule: {exc}") from None
         if self.failover.max_controller_restarts < 0:
             raise ValueError("failover.max_controller_restarts must be >= 0")
+        if self.registry.enabled and self.secure.enabled:
+            # registered blobs are opaque ciphertext under secure agg: the
+            # gateway could never decode them and eval-gated promotion
+            # would compare metrics of models nobody can serve
+            raise ValueError(
+                "registry is incompatible with secure aggregation "
+                "(registered community blobs would be ciphertext)")
+        if self.registry.enabled and self.registry.retention < 1:
+            raise ValueError("registry.retention must be >= 1")
+        if self.registry.enabled:
+            q = self.registry.promotion.divergence_quantile
+            if not 0.0 < q <= 1.0:
+                raise ValueError(
+                    "registry.promotion.divergence_quantile must be in "
+                    "(0, 1]")
+        if self.serving.enabled:
+            if not self.registry.enabled:
+                # the gateway serves registry channels; without versions
+                # there is nothing to install or swap
+                raise ValueError(
+                    "serving.enabled requires registry.enabled (the "
+                    "gateway serves promoted registry versions)")
+            if self.serving.max_batch < 1:
+                raise ValueError("serving.max_batch must be >= 1")
+            if self.serving.max_wait_ms < 0:
+                raise ValueError("serving.max_wait_ms must be >= 0")
+            if not 0.0 <= self.serving.canary_percent <= 100.0:
+                raise ValueError(
+                    "serving.canary_percent must be in [0, 100]")
+            if self.serving.recipe_index < 0:
+                # a negative index would silently pick a recipe from the
+                # END of the driver's list via Python indexing
+                raise ValueError("serving.recipe_index must be >= 0")
         if not 0.0 < self.telemetry.health.alpha <= 1.0:
             # a typo'd blend weight would silently freeze (0) or unsmooth
             # (>1 oscillates) every divergence score

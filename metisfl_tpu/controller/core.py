@@ -198,6 +198,12 @@ class RoundMetadata:
     # per-learner update norms / cohort cosines / divergence scores.
     # Empty when telemetry.health is off or under secure aggregation.
     health: Dict[str, Any] = field(default_factory=dict)
+    # model-lifecycle lineage (registry/registry.py): the candidate
+    # version this round's aggregate registered as, and the stable head
+    # at round close. 0 when the registry is off — pre-registry payloads
+    # lack the keys entirely and stats.py renders them unchanged.
+    registered_version: int = 0
+    stable_version: int = 0
     # non-fatal round errors (e.g. partial-cohort secure aggregation after a
     # deadline) — surfaced in lineage instead of vanishing into a log line
     errors: List[str] = field(default_factory=list)
@@ -333,6 +339,20 @@ class Controller:
         self._health_advisory = bool(
             self._health is not None and getattr(hc, "advisory", False))
 
+        # Model lifecycle plane (registry/registry.py): versioned
+        # community-model lineage with eval-gated promotion. None when
+        # opted out — the post-aggregation path then costs exactly one
+        # attribute check (same posture as the health monitor above).
+        self._registry = None
+        rc = getattr(config, "registry", None)
+        if rc is not None and getattr(rc, "enabled", False):
+            import hashlib
+
+            from metisfl_tpu.registry import ModelRegistry
+            self._registry = ModelRegistry(
+                rc, config_hash=hashlib.sha256(
+                    config.to_wire()).hexdigest()[:16])
+
     # ------------------------------------------------------------------ #
     # lifecycle
     # ------------------------------------------------------------------ #
@@ -356,6 +376,8 @@ class Controller:
             if self._deadline_timer is not None:
                 self._deadline_timer.cancel()
         self._store.shutdown()
+        if self._registry is not None:
+            self._registry.shutdown()
 
     # ------------------------------------------------------------------ #
     # membership (RPC thread)
@@ -947,6 +969,7 @@ class Controller:
                           round=self.global_iteration,
                           selected=len(selected), duration_ms=round(agg_ms, 3))
             self._fold_round_health()
+            self._register_round_version()
         except Exception as exc:
             _M_AGG_FAILURES.inc()
             self._agg_failures += 1
@@ -1546,6 +1569,11 @@ class Controller:
                     if rec is not None and sent:
                         rec.ewma_eval_s = _ewma(rec.ewma_eval_s,
                                                 max(0.0, now - sent))
+                # outside the controller lock: the fold takes the registry
+                # lock and may emit promotion events — one attribute check
+                # when the registry is off
+                if self._registry is not None:
+                    self._note_registry_eval(entry, expected=len(learners))
 
             try:
                 with eval_sp.activate():
@@ -1615,6 +1643,13 @@ class Controller:
                 # as the straggler EWMAs above) — scores must not reset
                 # to "everyone is typical" after a crash
                 state["health"] = self._health.export_state()
+        if self._registry is not None:
+            # model-lifecycle lineage (+ retained blobs, retention-
+            # bounded): channel heads and rollback targets must survive
+            # --resume failover or the serving plane would lose its
+            # promoted model across a controller crash. Outside the
+            # controller lock — the export takes the registry's own.
+            state["registry"] = self._registry.export_state()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # unique temp per writer: concurrent saves (per-round auto-checkpoint
@@ -1698,6 +1733,12 @@ class Controller:
             # server-opt restart-correctness: moments + step counter resume
             # the exact update sequence of an uninterrupted run
             self._aggregator.restore_state(agg_state)
+        registry_state = state.get("registry")
+        if registry_state and self._registry is not None:
+            # lifecycle lineage survives failover: version ids stay
+            # monotonic across incarnations and the serving gateway's
+            # next poll sees the same stable head it served before
+            self._registry.restore_state(registry_state)
         health_state = state.get("health")
         if health_state and self._health is not None:
             self._health.restore_state(health_state)
@@ -1796,6 +1837,109 @@ class Controller:
         except Exception:  # noqa: BLE001 - telemetry never fails a round
             logger.exception("round health fold failed")
 
+    # ------------------------------------------------------------------ #
+    # model lifecycle plane (registry/registry.py)
+    # ------------------------------------------------------------------ #
+
+    def _register_round_version(self) -> None:
+        """Mint a registry candidate from the round that just aggregated
+        and record the lifecycle lineage into ``RoundMetadata``. Runs on
+        the scheduling executor with ``global_iteration`` still naming
+        the completing round; never raises (lifecycle bookkeeping must
+        not trip the aggregation-failure retry path). One attribute
+        check when the registry is off."""
+        if self._registry is None:
+            return
+        try:
+            with self._lock:
+                blob = self._community_blob
+                health = dict(self._current_meta.health)
+            if blob is None:
+                return
+            info = self._registry.register(self.global_iteration, blob,
+                                           health)
+            from metisfl_tpu.registry import CHANNEL_STABLE
+            stable = self._registry.head(CHANNEL_STABLE)
+            with self._lock:
+                self._current_meta.registered_version = info.version
+                self._current_meta.stable_version = (
+                    stable.version if stable is not None else 0)
+        except Exception:  # noqa: BLE001 - lifecycle never fails a round
+            logger.exception("model version registration failed")
+
+    def _note_registry_eval(self, entry: Dict[str, Any],
+                            expected: int = 0) -> None:
+        """Fold a round's community evaluation into its registered
+        version ({"<dataset>/<metric>": mean across learners}); under
+        promotion.auto this is what tips a candidate to stable — but the
+        gate only arms once ALL ``expected`` digests landed, so a single
+        fast learner's partial mean can never promote a model the full
+        cohort would have rejected. Runs on eval-digest threads; never
+        raises."""
+        if self._registry is None:
+            return
+        try:
+            with self._lock:
+                evals = {lid: dict(v)
+                         for lid, v in entry["evaluations"].items()}
+                round_id = int(entry["global_iteration"])
+            per: Dict[str, List[float]] = {}
+            for learner_evals in evals.values():
+                for ds, metrics in learner_evals.items():
+                    for name, value in metrics.items():
+                        try:
+                            per.setdefault(f"{ds}/{name}", []).append(
+                                float(value))
+                        except (TypeError, ValueError):
+                            continue
+            if not per:
+                return
+            folded = {k: sum(v) / len(v) for k, v in per.items()}
+            promoted = self._registry.note_eval(
+                round_id, folded, gate=len(evals) >= expected)
+            if promoted is not None:
+                logger.info("round %d eval promoted model version v%d to "
+                            "stable", round_id, promoted.version)
+        except Exception:  # noqa: BLE001 - eval digest must never break
+            logger.exception("registry eval fold failed")
+
+    def describe_registry(self) -> Dict[str, Any]:
+        """Registry snapshot for the DescribeRegistry RPC / status CLI /
+        serving-gateway polls; ``{"enabled": False}`` when off."""
+        if self._registry is None:
+            return {"enabled": False}
+        return self._registry.describe()
+
+    def registered_model(self, version: int = 0,
+                         channel: str = "") -> Optional[bytes]:
+        """A registered version's blob, by id or channel head."""
+        if self._registry is None:
+            return None
+        if not version and channel:
+            head = self._registry.head(channel)
+            if head is None:
+                return None
+            version = head.version
+        return self._registry.blob(version) if version else None
+
+    def promote_version(self, version: int, force: bool = False):
+        if self._registry is None:
+            raise ValueError("model registry is not enabled")
+        info = self._registry.promote(version, force=force)
+        # durability: the new stable head must survive a crash landing
+        # between this promotion and the next round's auto-checkpoint
+        # (the queued save snapshots state at run time, post-promotion)
+        self._checkpoint_async()
+        return info
+
+    def rollback_version(self):
+        if self._registry is None:
+            raise ValueError("model registry is not enabled")
+        info = self._registry.rollback()
+        if info is not None:
+            self._checkpoint_async()
+        return info
+
     def _update_straggler_gauge(self) -> None:
         # set() under the controller lock, like _M_UPLINK.inc: leave()
         # deletes the record under this lock and prunes the series after,
@@ -1872,6 +2016,9 @@ class Controller:
         if self._health is not None:
             # latest round's convergence snapshot ({} before round 1)
             snapshot["health"] = self._health.snapshot()
+        if self._registry is not None:
+            # model-lifecycle snapshot (channel heads + version lineage)
+            snapshot["registry"] = self._registry.describe()
         return snapshot
 
     # ------------------------------------------------------------------ #

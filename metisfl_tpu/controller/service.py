@@ -114,8 +114,12 @@ class ControllerServer:
             "GetHealthStatus": self._health,
             "GetMetrics": self._get_metrics,
             "DescribeFederation": self._describe,
+            "DescribeRegistry": self._describe_registry,
+            "GetRegisteredModel": self._get_registered_model,
+            "PromoteVersion": self._promote_version,
+            "RollbackVersion": self._rollback_version,
             "ShutDown": self._shutdown_rpc,
-        }))
+        }, role="controller"))
         self._shutdown_event = threading.Event()
         self.port: Optional[int] = None
 
@@ -174,6 +178,40 @@ class ControllerServer:
         # python -m metisfl_tpu.status
         tail = int(loads(raw).get("event_tail", 50)) if raw else 50
         return dumps(self.controller.describe(event_tail=tail))
+
+    def _describe_registry(self, raw: bytes) -> bytes:
+        # model-registry snapshot (channel heads + retained lineage) —
+        # the serving gateway's poll target and the status CLI's source
+        return dumps(self.controller.describe_registry())
+
+    def _get_registered_model(self, raw: bytes) -> bytes:
+        req = loads(raw) if raw else {}
+        blob = self.controller.registered_model(
+            version=int(req.get("version", 0) or 0),
+            channel=str(req.get("channel", "") or ""))
+        return blob or b""
+
+    def _promote_version(self, raw: bytes) -> bytes:
+        req = loads(raw)
+        try:
+            info = self.controller.promote_version(
+                int(req["version"]), force=bool(req.get("force", False)))
+        except ValueError as exc:
+            # a rejected gate is an answer, not a transport error
+            return dumps({"ok": False, "error": str(exc)})
+        return dumps({"ok": True, "version": info.to_dict()})
+
+    def _rollback_version(self, raw: bytes) -> bytes:
+        try:
+            info = self.controller.rollback_version()
+        except ValueError as exc:
+            # registry disabled: same {ok: false} answer shape as a
+            # rejected promotion, not a transport-level error
+            return dumps({"ok": False, "error": str(exc)})
+        if info is None:
+            return dumps({"ok": False,
+                          "error": "nothing to roll back to"})
+        return dumps({"ok": True, "version": info.to_dict()})
 
     def _shutdown_rpc(self, raw: bytes) -> bytes:
         # ack first, then tear down off-thread (servicer :364-375 pattern)
@@ -280,6 +318,38 @@ class ControllerClient:
                                 timeout=timeout, wait_ready=wait_ready,
                                 idempotent=True)
         return loads(raw)
+
+    def describe_registry(self, timeout: Optional[float] = None,
+                          wait_ready: bool = True) -> dict:
+        """Model-registry snapshot (channel heads + retained version
+        lineage); ``{"enabled": False}`` when the registry is off. The
+        serving gateway polls this fail-fast (short timeout, no
+        wait-for-ready) like the driver's supervision polls."""
+        raw = self._client.call("DescribeRegistry", b"", timeout=timeout,
+                                wait_ready=wait_ready, idempotent=True)
+        return loads(raw)
+
+    def get_registered_model(self, version: int = 0, channel: str = "",
+                             timeout: Optional[float] = None) -> bytes:
+        """A registered version's community blob, by version id or channel
+        name (b'' when absent)."""
+        return self._client.call(
+            "GetRegisteredModel",
+            dumps({"version": int(version), "channel": channel}),
+            timeout=timeout, idempotent=True)
+
+    def promote_version(self, version: int, force: bool = False,
+                        timeout: Optional[float] = None) -> dict:
+        """Operator promotion: ``{"ok": bool, ...}`` — a failing gate
+        comes back as ``ok=False`` with the reasons, not an exception."""
+        return loads(self._client.call(
+            "PromoteVersion", dumps({"version": int(version),
+                                     "force": bool(force)}),
+            timeout=timeout))
+
+    def rollback_version(self, timeout: Optional[float] = None) -> dict:
+        return loads(self._client.call("RollbackVersion", dumps({}),
+                                       timeout=timeout))
 
     def list_methods(self, timeout: float = 5.0) -> dict:
         """The service's RPC surface (ListMethods reflection): method

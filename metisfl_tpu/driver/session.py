@@ -49,6 +49,9 @@ logger = logging.getLogger("metisfl_tpu.driver")
 _M_CTRL_RESTARTS = _tmetrics.registry().counter(
     _tel.M_CONTROLLER_RESTARTS_TOTAL,
     "Supervised controller relaunches after a crash")
+_M_GATEWAY_RESTARTS = _tmetrics.registry().counter(
+    _tel.M_GATEWAY_RESTARTS_TOTAL,
+    "Supervised serving-gateway relaunches after a crash")
 
 
 @dataclass
@@ -187,6 +190,12 @@ class DriverSession:
         self._known_endpoints: List[dict] = []
         # controller crash-failover supervision state
         self._controller_restarts = 0
+        self._gateway_restarts = 0
+        # earliest wall-clock for the next gateway relaunch (doubling,
+        # capped): a deterministically-crashing gateway must not
+        # crash-loop at the monitor's poll rate — but unlike the
+        # controller it never fails the run (serving is auxiliary)
+        self._gateway_restart_after = 0.0
         self._shutting_down = False
         # chaos arms ORIGINAL incarnations only (see _chaos_env): learner
         # indices that already got their armed launch
@@ -326,6 +335,25 @@ class DriverSession:
         if self.config.checkpoint.dir:
             os.makedirs(self.config.checkpoint.dir, exist_ok=True)
 
+        # serving gateway: the config file below ships to the gateway
+        # process too, so its port must be pinned BEFORE the write — an
+        # ephemeral bind would leave the driver (and clients) unable to
+        # dial it for shutdown or traffic
+        if self.config.serving.enabled and not self.config.serving.port:
+            if (self.config.controller_host or
+                    "localhost") not in self._LOCAL_HOSTS:
+                # same guard as the multi-host coordinator port: a port
+                # probed on the driver machine says nothing about the
+                # remote host the gateway will bind on
+                raise ValueError(
+                    "serving on remote host "
+                    f"{self.config.controller_host!r} requires an "
+                    "explicit serving.port")
+            import socket as _socket
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                self.config.serving.port = s.getsockname()[1]
+
         config_path = os.path.join(self.workdir, "federation_config.bin")
         with open(config_path, "wb") as f:
             f.write(self.config.to_wire())
@@ -347,6 +375,8 @@ class DriverSession:
 
         for idx in range(len(self.learner_recipes)):
             self.launch_learner(idx)
+        if self.config.serving.enabled:
+            self._launch_gateway()
         self._started_at = time.time()
 
     def _chaos_env(self, process: str, idx: Optional[int] = None) -> Dict[str, str]:
@@ -447,15 +477,88 @@ class DriverSession:
                     self._controller_restarts)
         return True
 
+    def _recipe_path(self, idx: int) -> str:
+        """Cloudpickle learner recipe ``idx`` into the workdir (idempotent
+        — the gateway and the learner launch share one file)."""
+        path = os.path.join(self.workdir, f"learner_{idx}_recipe.pkl")
+        if not os.path.exists(path):
+            with open(path, "wb") as f:
+                cloudpickle.dump(self.learner_recipes[idx], f)
+        return path
+
+    def _launch_gateway(self) -> _Proc:
+        """(Re)launch the serving gateway (serving/__main__.py). It needs
+        no state handoff: the first registry poll pins a relaunch back to
+        the last promoted stable version."""
+        cfg = self.config.serving
+        if cfg.recipe_index >= len(self.learner_recipes):
+            # same rationale as the config's negative-index rejection: a
+            # silently clamped index would boot the gateway on the wrong
+            # architecture and every registry sync would fail decoding
+            raise ValueError(
+                f"serving.recipe_index={cfg.recipe_index} but only "
+                f"{len(self.learner_recipes)} learner recipe(s) exist")
+        recipe_path = self._recipe_path(cfg.recipe_index)
+        launcher = self._launcher_for(self.config.controller_host or
+                                      "localhost")
+        argv = [getattr(launcher, "python", sys.executable),
+                "-m", "metisfl_tpu.serving",
+                "--config", self._config_path,
+                "--recipe", recipe_path]
+        if isinstance(launcher, SSHLauncher):
+            launcher.ship([self._config_path, recipe_path]
+                          + self._ssl_files())
+        env = dict(self._base_env())
+        if self._gateway_restarts == 0:
+            # original incarnation only — a supervised relaunch runs
+            # clean, same contract as the controller/learner chaos arming
+            env.update(self._chaos_env("serving"))
+        self._procs = [p for p in self._procs if p.name != "serving"]
+        proc = launcher.launch("serving", argv, env=env)
+        self._procs.append(proc)
+        return proc
+
+    def _supervise_gateway(self) -> bool:
+        """Serving-gateway crash failover: a dead gateway is relaunched
+        (unbounded — it is stateless; the registry re-pins it), so a
+        chaos kill mid-canary costs one restart, not the serving plane.
+        Returns True when a restart happened this call."""
+        if not self.config.serving.enabled or self._shutting_down:
+            return False
+        gw = next((p for p in self._procs if p.name == "serving"), None)
+        if gw is None or gw.process.poll() is None:
+            return False
+        if time.time() < self._gateway_restart_after:
+            return False  # backoff window: relaunch on a later poll
+        code = gw.process.poll()
+        self._gateway_restarts += 1
+        self._gateway_restart_after = time.time() + min(
+            30.0, 0.5 * (2 ** (self._gateway_restarts - 1)))
+        logger.warning("serving gateway died (exit %s); supervised "
+                       "relaunch %d", code, self._gateway_restarts)
+        _tpostmortem.dump("gateway_relaunch",
+                          extra={"exit_code": code,
+                                 "restart": self._gateway_restarts})
+        self._launch_gateway()
+        _M_GATEWAY_RESTARTS.inc()
+        return True
+
+    def serving_client(self):
+        """A :class:`metisfl_tpu.serving.ServingClient` dialing this
+        session's gateway (serving must be enabled)."""
+        from metisfl_tpu.serving.service import ServingClient
+        if not self.config.serving.enabled:
+            raise RuntimeError("serving is not enabled in this federation")
+        return ServingClient(self.config.controller_host or "localhost",
+                             self.config.serving.port, ssl=self.config.ssl,
+                             comm=self.config.comm)
+
     def launch_learner(self, idx: int) -> _Proc:
         """(Re)launch learner ``idx`` on its configured endpoint. Ports come
         from the endpoint config or are ephemeral (the learner reports its
         bound port on join); credentials persist in the workdir so a
         relaunched learner rejoins as itself."""
-        recipe_path = os.path.join(self.workdir, f"learner_{idx}_recipe.pkl")
-        if not os.path.exists(recipe_path):
-            with open(recipe_path, "wb") as f:
-                cloudpickle.dump(self.learner_recipes[idx], f)
+        recipe_path = self._recipe_path(idx)
         ep = self._endpoint(idx)
         launcher = self._launcher_for(ep.hostname)
         name = f"learner_{idx}"
@@ -580,9 +683,13 @@ class DriverSession:
             # the two calls belongs to the NEXT supervision cycle, not to
             # an instant abort that bypasses the restart budget.
             self._supervise_controller()
-            self._check_procs_alive(
-                skip=("controller",)
-                if self.config.failover.supervise_controller else ())
+            self._supervise_gateway()
+            skip = (("controller",)
+                    if self.config.failover.supervise_controller else ())
+            if self.config.serving.enabled:
+                # the gateway is always supervised (stateless relaunch)
+                skip = tuple(skip) + ("serving",)
+            self._check_procs_alive(skip=skip)
             # poll the tail-bounded lineage RPCs — a long-running federation
             # must not ship its full history every 2 s (the unbounded
             # GetStatistics dump is fetched once, at termination)
@@ -835,6 +942,18 @@ class DriverSession:
                 client.call("ShutDown", b"", timeout=5.0, wait_ready=False)
                 client.close()
             except Exception:  # noqa: BLE001 - learner may already be gone
+                pass
+        if self.config.serving.enabled and self.config.serving.port:
+            # fail-fast like the learner loop above: a dead gateway must
+            # not park shutdown in the transport's default deadline
+            try:
+                from metisfl_tpu.serving.service import SERVING_SERVICE
+                gw = RpcClient(self.config.controller_host or "localhost",
+                               self.config.serving.port, SERVING_SERVICE,
+                               retries=0, ssl=self.config.ssl)
+                gw.call("ShutDown", b"", timeout=5.0, wait_ready=False)
+                gw.close()
+            except Exception:  # noqa: BLE001 - gateway may already be gone
                 pass
         try:
             if self._client is not None:

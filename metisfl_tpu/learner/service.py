@@ -38,7 +38,7 @@ class LearnerServer:
             "GetHealthStatus": self._health,
             "GetMetrics": self._get_metrics,
             "ShutDown": self._shutdown_rpc,
-        }))
+        }, role="learner"))
         self._shutdown_event = threading.Event()
         self._tasks_received = 0
         self.port: Optional[int] = None

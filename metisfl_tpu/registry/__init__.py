@@ -1,0 +1,22 @@
+"""Versioned community-model registry (model lifecycle plane).
+
+Every successful aggregation mints a candidate version; eval-gated
+promotion moves it to the ``stable`` channel; the serving gateway
+(:mod:`metisfl_tpu.serving`) hot-swaps onto promoted versions. See
+docs/DEPLOYMENT.md for the schema, gate semantics, and the rollback
+runbook.
+"""
+
+from metisfl_tpu.registry.registry import (
+    CHANNEL_CANDIDATE,
+    CHANNEL_STABLE,
+    ModelRegistry,
+    VersionInfo,
+)
+
+__all__ = [
+    "ModelRegistry",
+    "VersionInfo",
+    "CHANNEL_CANDIDATE",
+    "CHANNEL_STABLE",
+]

@@ -1,0 +1,34 @@
+"""Serving gateway: micro-batched inference over registry channels.
+
+The consumption side of the model lifecycle plane
+(:mod:`metisfl_tpu.registry`): a driver-bootable process
+(``python -m metisfl_tpu.serving``) — plus an in-process variant for
+tests — that serves the promoted community model over the federation's
+BytesService RPC with a micro-batching queue, atomic hot-swap on
+promotion, and a deterministic canary split toward the candidate
+channel. See docs/DEPLOYMENT.md.
+"""
+
+from metisfl_tpu.serving.gateway import (
+    ControllerRegistrySource,
+    DirectRegistrySource,
+    MicroBatcher,
+    ServingGateway,
+    canary_channel,
+)
+from metisfl_tpu.serving.service import (
+    SERVING_SERVICE,
+    ServingClient,
+    ServingServer,
+)
+
+__all__ = [
+    "ServingGateway",
+    "MicroBatcher",
+    "ControllerRegistrySource",
+    "DirectRegistrySource",
+    "canary_channel",
+    "ServingServer",
+    "ServingClient",
+    "SERVING_SERVICE",
+]

@@ -1,0 +1,87 @@
+"""Serving gateway process: ``python -m metisfl_tpu.serving``.
+
+Booted by the driver like a learner: the model architecture arrives as a
+cloudpickled recipe (the gateway only uses its ``model_ops`` — datasets
+are ignored), configuration as the federation config file. The gateway
+polls the controller's registry (``DescribeRegistry``), installs the
+stable/candidate channel heads, and serves ``Predict`` with the
+micro-batching queue. A relaunch after a crash needs no state of its
+own: the first poll pins it back to the last promoted version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+import cloudpickle
+
+from metisfl_tpu.config import FederationConfig, load_config
+
+
+def main(argv=None) -> int:
+    from metisfl_tpu.platform import honor_platform_env
+    honor_platform_env()
+    parser = argparse.ArgumentParser("metisfl_tpu.serving")
+    parser.add_argument("--config", required=True,
+                        help="path to FederationConfig (.bin codec or .yaml)")
+    parser.add_argument("--recipe", required=True,
+                        help="cloudpickled callable -> (model_ops, ...); "
+                             "only the engine is used")
+    parser.add_argument("--host", default="")
+    parser.add_argument("--port", type=int, default=-1,
+                        help="override config serving.port (-1: use config)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.config.endswith((".yaml", ".yml")):
+        config = load_config(args.config)
+    else:
+        with open(args.config, "rb") as f:
+            config = FederationConfig.from_wire(f.read())
+
+    from metisfl_tpu import telemetry
+    import hashlib
+    config_hash = hashlib.sha256(config.to_wire()).hexdigest()[:16]
+    telemetry.apply_config(config.telemetry, service="serving",
+                           config_hash=config_hash)
+
+    with open(args.recipe, "rb") as f:
+        recipe = cloudpickle.load(f)
+    model_ops = recipe()[0]
+
+    from metisfl_tpu.controller.service import ControllerClient
+    from metisfl_tpu.serving.gateway import (ControllerRegistrySource,
+                                             ServingGateway)
+    from metisfl_tpu.serving.service import ServingServer
+
+    controller = ControllerClient(
+        config.controller_host or "localhost", config.controller_port,
+        ssl=config.ssl, comm=config.comm)
+    gateway = ServingGateway(
+        model_ops, config.serving,
+        ship_tensor_regex=config.train.ship_tensor_regex)
+    server = ServingServer(gateway, host=args.host or config.serving.host,
+                           port=(config.serving.port if args.port < 0
+                                 else args.port),
+                           ssl=config.ssl)
+    port = server.start()
+    print(f"METISFL_TPU_SERVING_READY port={port}", flush=True)
+    gateway.start_sync(ControllerRegistrySource(controller))
+
+    signal.signal(signal.SIGTERM, lambda *_: server.stop())
+    signal.signal(signal.SIGINT, lambda *_: server.stop())
+    server.wait_for_shutdown()
+    controller.close()
+    telemetry.trace.flush()
+    telemetry.events.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

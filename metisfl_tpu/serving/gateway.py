@@ -1,0 +1,464 @@
+"""Serving gateway core: micro-batching, hot-swap, canary routing.
+
+Design notes:
+
+- **Micro-batching.** Concurrent requests coalesce into one forward pass:
+  the batcher waits ``max_wait_ms`` from the first queued row (or until
+  ``max_batch`` rows accumulate) and executes one padded forward. Every
+  forward pads to exactly ``max_batch`` rows, so ONE jitted program
+  serves every batch occupancy — no shape-churn recompiles — and each
+  row's computation is identical whether it arrived alone or coalesced
+  (per-row outputs of a fixed-shape forward do not depend on what else
+  is in the batch), which is what makes the batched results bit-identical
+  to unbatched ones (tests/test_serving.py pins it).
+- **Hot-swap.** A channel's ``(version, variables)`` pair is replaced
+  atomically under the gateway lock; a batch in flight already captured
+  the old pair and completes on it, so no request is ever dropped or
+  served a half-installed model.
+- **Canary.** Requests carry a routing key; ``crc32(key) % 10000`` below
+  ``canary_percent * 100`` routes to the ``candidate`` channel when one
+  is installed. Deterministic: the same key always lands on the same
+  side, so a session's traffic never flaps between models mid-canary.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import zlib
+from concurrent import futures
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metisfl_tpu import telemetry as _tel
+from metisfl_tpu.registry import CHANNEL_CANDIDATE, CHANNEL_STABLE
+from metisfl_tpu.telemetry import events as _tevents
+from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.tensor.pytree import (
+    ModelBlob,
+    named_tensors_to_pytree,
+    pytree_to_named_tensors,
+)
+
+logger = logging.getLogger("metisfl_tpu.serving")
+
+_REG = _tmetrics.registry()
+_M_REQUESTS = _REG.counter(
+    _tel.M_SERVING_REQUESTS_TOTAL, "Inference requests by routed channel",
+    ("channel",))
+_M_LATENCY = _REG.histogram(
+    _tel.M_SERVING_REQUEST_LATENCY_SECONDS,
+    "End-to-end request latency (enqueue -> reply)")
+_M_BATCH_ROWS = _REG.histogram(
+    _tel.M_SERVING_BATCH_ROWS,
+    "Rows per executed micro-batch (occupancy of the max_batch bucket)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+_M_VERSION = _REG.gauge(
+    _tel.M_SERVING_MODEL_VERSION,
+    "Registry version currently installed per channel", ("channel",))
+_M_SWAPS = _REG.counter(
+    _tel.M_SERVING_SWAPS_TOTAL, "Hot-swaps by channel", ("channel",))
+
+
+def canary_channel(key: str, canary_percent: float) -> str:
+    """Deterministic traffic split: the candidate channel owns the lowest
+    ``canary_percent`` of the crc32 keyspace (basis-point resolution).
+    Pure function of (key, percent) — tests and operators can predict any
+    request's routing. Keyless requests serve stable: ``crc32(b"") == 0``
+    sits inside EVERY canary slice, so defaulting them in would send
+    100% of unkeyed traffic to the candidate the moment a canary arms."""
+    if canary_percent <= 0.0 or not key:
+        return CHANNEL_STABLE
+    slot = zlib.crc32(key.encode("utf-8")) % 10000
+    return (CHANNEL_CANDIDATE if slot < canary_percent * 100.0
+            else CHANNEL_STABLE)
+
+
+class _Pending:
+    """One queued request: input rows + the future its caller blocks on."""
+
+    __slots__ = ("rows", "future", "enqueued_at")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.future: "futures.Future" = futures.Future()
+        self.enqueued_at = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesce concurrent requests into padded fixed-size forwards.
+
+    ``run_batch(rows)`` is the model-executing callback: it receives the
+    concatenated request rows (<= max_batch of them) and returns per-row
+    outputs. One worker thread per batcher drains the queue; requests
+    above ``max_batch`` rows are chunked internally so a single fat
+    request cannot wedge the queue."""
+
+    def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
+                 max_batch: int = 8, max_wait_ms: float = 5.0,
+                 name: str = "batcher"):
+        self._run_batch = run_batch
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._queue: List[_Pending] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"serving-{name}")
+        self._worker.start()
+
+    def submit(self, rows: np.ndarray) -> "futures.Future":
+        rows = np.asarray(rows)
+        if rows.ndim == 0:
+            # reject on the caller's thread: a 0-d array has no len()
+            # and would otherwise blow up inside the shared worker
+            raise ValueError("batcher input must be at least 1-d "
+                             "(a batch of rows)")
+        pending = _Pending(rows)
+        with self._cv:
+            if self._closed:
+                pending.future.set_exception(
+                    RuntimeError("batcher closed"))
+                return pending.future
+            self._queue.append(pending)
+            self._cv.notify()
+        return pending.future
+
+    def _gather(self) -> List[_Pending]:
+        """Wait for work, then coalesce until the bucket is full or the
+        wait window (from the FIRST request) expires."""
+        with self._cv:
+            while not self._queue and not self._closed:
+                self._cv.wait(0.1)
+            if self._closed and not self._queue:
+                return []
+            deadline = self._queue[0].enqueued_at + self.max_wait_s
+            while (sum(len(p.rows) for p in self._queue) < self.max_batch
+                   and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            batch: List[_Pending] = []
+            rows = 0
+            while self._queue and (not batch
+                                   or rows + len(self._queue[0].rows)
+                                   <= self.max_batch):
+                item = self._queue.pop(0)
+                rows += len(item.rows)
+                batch.append(item)
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._gather()
+            if not batch:
+                with self._cv:
+                    if self._closed and not self._queue:
+                        return
+                continue
+            try:
+                self._execute(batch)
+            except Exception as exc:  # noqa: BLE001 - worker must survive
+                # one poisoned batch (shape-mismatched concat, anything
+                # _execute's own guard missed) fails ITS requests only —
+                # a dead worker would hang every later request on this
+                # channel until its timeout
+                logger.exception("micro-batch execution failed")
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+
+    def _execute(self, batch: List[_Pending]) -> None:
+        try:
+            rows = np.concatenate([p.rows for p in batch], axis=0)
+            _M_BATCH_ROWS.observe(len(rows))
+            outs = self._run_batch(rows)
+        except Exception as exc:  # noqa: BLE001 - surfaced per request
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        # run_batch may return (outs, extra) — extra (e.g. the model
+        # version the forward actually captured) rides to every request
+        # of the batch, so callers report the TRUE served version even
+        # when a hot-swap lands between enqueue and execution
+        extra = None
+        if isinstance(outs, tuple):
+            outs, extra = outs
+        offset = 0
+        for p in batch:
+            n = len(p.rows)
+            sliced = np.asarray(outs[offset:offset + n])
+            p.future.set_result(sliced if extra is None
+                                else (sliced, extra))
+            offset += n
+
+    def close(self) -> None:
+        """Drain: queued requests still execute, then the worker exits."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join(timeout=30.0)
+
+
+# --------------------------------------------------------------------- #
+# registry sources (where the gateway learns about promoted versions)
+# --------------------------------------------------------------------- #
+
+class DirectRegistrySource:
+    """In-process source: reads a live :class:`Controller` (tests, pod
+    mode)."""
+
+    def __init__(self, controller):
+        self._controller = controller
+
+    def describe(self) -> Dict[str, Any]:
+        return self._controller.describe_registry()
+
+    def blob(self, version: int) -> Optional[bytes]:
+        return self._controller.registered_model(version)
+
+
+class ControllerRegistrySource:
+    """RPC source: polls the controller's DescribeRegistry /
+    GetRegisteredModel surface (the gateway process's view)."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def describe(self) -> Dict[str, Any]:
+        return self._client.describe_registry(timeout=15.0,
+                                              wait_ready=False)
+
+    def blob(self, version: int) -> Optional[bytes]:
+        return self._client.get_registered_model(version=version,
+                                                 timeout=60.0)
+
+
+class ServingGateway:
+    """Serve inference over registry channels. ``model_ops`` supplies the
+    architecture + jitted forward (the same engine a learner trains
+    with); ``config`` is a :class:`metisfl_tpu.config.ServingConfig`."""
+
+    def __init__(self, model_ops, config, ship_tensor_regex: str = ""):
+        self.model_ops = model_ops
+        self.config = config
+        self._ship_regex = ship_tensor_regex
+        self._lock = threading.Lock()
+        # channel -> (version id, variables pytree)
+        self._models: Dict[str, Tuple[int, Any]] = {}
+        self._treedef_like = model_ops.get_variables()
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._requests = 0
+        self._shut_down = False
+        self._started_at = time.time()
+        self._sync_stop = threading.Event()
+        self._sync_thread: Optional[threading.Thread] = None
+        self._last_sync_error = ""
+
+    # -- model install / hot-swap ------------------------------------- #
+
+    def _load_variables(self, blob_bytes: bytes):
+        """Community blob -> engine-dtype variables. Under
+        ship_tensor_regex the blob carries only the federated subset —
+        backfill the frozen base from the construction-time tree (the
+        learner's _merge_frozen contract)."""
+        import jax
+
+        named = list(ModelBlob.from_bytes(blob_bytes).tensors)
+        if self._ship_regex:
+            import re
+
+            have = {n for n, _ in named}
+            for name, arr in pytree_to_named_tensors(self._treedef_like):
+                if name not in have and not re.search(self._ship_regex,
+                                                      name):
+                    named.append((name, arr))
+        tree = named_tensors_to_pytree(named, self._treedef_like)
+        tree = jax.tree.map(
+            lambda a, t: a if a.dtype == t.dtype else np.asarray(a, t.dtype),
+            tree, self._treedef_like)
+        # device-convert ONCE at install: the engine's per-call
+        # `jnp.asarray` then no-ops, instead of re-uploading the whole
+        # model host->device on every executed micro-batch
+        import jax.numpy as jnp
+        return jax.tree.map(jnp.asarray, tree)
+
+    def install(self, channel: str, version: int, blob: bytes) -> None:
+        """Atomically hot-swap ``channel`` to ``version``. Decoding (the
+        slow part) happens OUTSIDE the lock; in-flight batches keep the
+        pair they already captured, so zero requests drop across the
+        swap."""
+        variables = self._load_variables(blob)
+        with self._lock:
+            previous = self._models.get(channel, (0, None))[0]
+            self._models[channel] = (int(version), variables)
+        _M_VERSION.set(int(version), channel=channel)
+        if previous != version:
+            _M_SWAPS.inc(channel=channel)
+            _tevents.emit(_tevents.ServingSwapped, channel=channel,
+                          version=int(version), previous=previous)
+            logger.info("serving %s hot-swapped to v%d (was v%d)",
+                        channel, version, previous)
+
+    def uninstall(self, channel: str) -> None:
+        with self._lock:
+            gone = self._models.pop(channel, None)
+        if gone is not None:
+            _M_VERSION.remove(channel=channel)
+            logger.info("serving %s uninstalled (was v%d)", channel,
+                        gone[0])
+
+    def installed(self) -> Dict[str, int]:
+        with self._lock:
+            return {ch: v for ch, (v, _) in self._models.items()}
+
+    # -- registry sync ------------------------------------------------- #
+
+    def sync(self, source) -> Dict[str, int]:
+        """One poll: compare channel heads against the registry source and
+        hot-swap any channel whose head changed. Returns the installed
+        map after the poll."""
+        desc = source.describe()
+        if not desc.get("enabled", False):
+            return self.installed()
+        current = self.installed()
+        for channel in (CHANNEL_STABLE, CHANNEL_CANDIDATE):
+            head = int(desc.get(channel, 0) or 0)
+            if not head:
+                if channel == CHANNEL_CANDIDATE and channel in current:
+                    # promoted or superseded away: stop canarying it
+                    self.uninstall(channel)
+                continue
+            if current.get(channel) == head:
+                continue
+            blob = source.blob(head)
+            if blob:
+                self.install(channel, head, blob)
+        return self.installed()
+
+    def start_sync(self, source, poll_every_s: Optional[float] = None) -> None:
+        """Background registry polling (the gateway process's main loop)."""
+        period = (self.config.poll_every_s if poll_every_s is None
+                  else poll_every_s)
+
+        def _loop():
+            while not self._sync_stop.is_set():
+                try:
+                    self.sync(source)
+                    self._last_sync_error = ""
+                except Exception as exc:  # noqa: BLE001 - keep polling
+                    self._last_sync_error = str(exc)
+                    logger.warning("registry sync failed: %s", exc)
+                self._sync_stop.wait(max(0.05, period))
+
+        self._sync_thread = threading.Thread(target=_loop, daemon=True,
+                                             name="serving-sync")
+        self._sync_thread.start()
+
+    # -- request path --------------------------------------------------- #
+
+    def _batcher_for(self, channel: str) -> MicroBatcher:
+        with self._lock:
+            if self._shut_down:
+                # a Predict racing shutdown must not resurrect a worker
+                # thread on a torn-down gateway
+                raise RuntimeError("serving gateway is shut down")
+            batcher = self._batchers.get(channel)
+            if batcher is None:
+                batcher = MicroBatcher(
+                    lambda rows, ch=channel: self._forward(ch, rows),
+                    max_batch=self.config.max_batch,
+                    max_wait_ms=self.config.max_wait_ms,
+                    name=channel)
+                self._batchers[channel] = batcher
+            return batcher
+
+    def _forward(self, channel: str,
+                 rows: np.ndarray) -> Tuple[np.ndarray, Tuple[int, str]]:
+        """One padded fixed-shape forward per ``max_batch`` chunk. The
+        (version, variables) pair is captured once per call — a hot-swap
+        mid-batch affects the NEXT batch, never this one — and the
+        captured (version, channel) rides back so replies report what
+        ACTUALLY served them, fallback included."""
+        with self._lock:
+            entry = self._models.get(channel)
+            if entry is None and channel == CHANNEL_CANDIDATE:
+                # the candidate was uninstalled (promoted/superseded)
+                # between routing and execution: degrade the queued
+                # canary batch to stable instead of failing user traffic
+                channel = CHANNEL_STABLE
+                entry = self._models.get(channel)
+        if entry is None:
+            raise RuntimeError(f"no model installed on channel {channel!r}")
+        version, variables = entry
+        bucket = self.config.max_batch
+        outs = []
+        for start in range(0, len(rows), bucket):
+            chunk = rows[start:start + bucket]
+            pad = bucket - len(chunk)
+            if pad > 0:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], pad, axis=0)], axis=0)
+            # batch_size=bucket: the engine sees exactly one fixed-shape
+            # program however the rows were coalesced
+            full = self.model_ops.infer(chunk, batch_size=bucket,
+                                        variables=variables)
+            outs.append(np.asarray(full)[:bucket - pad if pad else bucket])
+        return np.concatenate(outs, axis=0), (version, channel)
+
+    def predict(self, x: np.ndarray, key: str = "",
+                timeout_s: float = 60.0) -> Tuple[np.ndarray, int, str]:
+        """Route, micro-batch, and run one request. Returns
+        ``(outputs, served version, channel)``."""
+        t0 = time.perf_counter()
+        channel = canary_channel(key or "", self.config.canary_percent)
+        with self._lock:
+            if channel not in self._models:
+                # canary slice with no candidate installed (or a gateway
+                # relaunched mid-canary): serve stable — degrading the
+                # canary beats failing user traffic
+                channel = CHANNEL_STABLE
+            entry = self._models.get(channel)
+        if entry is None:
+            raise RuntimeError("no model installed (registry has no "
+                               "stable version yet)")
+        outs, (version, served_channel) = self._batcher_for(channel).submit(
+            np.asarray(x)).result(timeout=timeout_s)
+        with self._lock:
+            self._requests += 1
+        # label by what ACTUALLY served it: a canary request degraded to
+        # stable mid-swap must not skew candidate traffic analytics
+        _M_REQUESTS.inc(channel=served_channel)
+        _M_LATENCY.observe(time.perf_counter() - t0)
+        return outs, version, served_channel
+
+    # -- status --------------------------------------------------------- #
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            installed = {ch: v for ch, (v, _) in self._models.items()}
+            requests = self._requests
+        return {
+            "installed": installed,
+            "canary_percent": float(self.config.canary_percent),
+            "max_batch": int(self.config.max_batch),
+            "max_wait_ms": float(self.config.max_wait_ms),
+            "requests": requests,
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "last_sync_error": self._last_sync_error,
+        }
+
+    def shutdown(self) -> None:
+        self._sync_stop.set()
+        if self._sync_thread is not None:
+            self._sync_thread.join(timeout=10.0)
+        with self._lock:
+            self._shut_down = True
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for batcher in batchers:
+            batcher.close()

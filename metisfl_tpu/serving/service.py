@@ -1,0 +1,159 @@
+"""Serving gateway gRPC surface + client.
+
+Same BytesService transport as the controller/learner (chunked fallback,
+ListMethods reflection — the gateway's methods carry ``role: "serving"``
+so the status CLI's ``--probe`` can tell gateway endpoints apart from
+learner/controller ones)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.comm.messages import ServeReply, ServeRequest
+from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
+from metisfl_tpu.serving.gateway import ServingGateway
+from metisfl_tpu.tensor.pytree import ModelBlob
+
+logger = logging.getLogger("metisfl_tpu.serving.service")
+
+SERVING_SERVICE = "metisfl_tpu.Serving"
+
+
+class ServingServer:
+    """Host a :class:`ServingGateway` behind gRPC."""
+
+    def __init__(self, gateway: ServingGateway, host: str = "0.0.0.0",
+                 port: int = 0, ssl=None):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+
+        self.gateway = gateway
+        self._server = RpcServer(host, port, ssl=ssl)
+        self._health_servicer = HealthServicer()
+        self._health_servicer.set_status(SERVING_SERVICE, SERVING)
+        self._server.add_service(self._health_servicer.service())
+        self._server.add_service(BytesService(SERVING_SERVICE, {
+            "Predict": self._predict,
+            "GetServingStatus": self._status,
+            "GetHealthStatus": self._health,
+            "GetMetrics": self._get_metrics,
+            "ShutDown": self._shutdown_rpc,
+        }, role="serving"))
+        self._shutdown_event = threading.Event()
+        self.port: Optional[int] = None
+
+    # -- handlers (RPC threads) ---------------------------------------- #
+
+    def _predict(self, raw: bytes) -> bytes:
+        req = ServeRequest.from_wire(raw)
+        tensors = dict(ModelBlob.from_bytes(req.inputs).tensors)
+        if "x" not in tensors:
+            raise ValueError("ServeRequest.inputs must pack an 'x' tensor")
+        t0 = time.time()
+        outs, version, channel = self.gateway.predict(
+            tensors["x"], key=req.key or req.request_id)
+        return ServeReply(
+            request_id=req.request_id,
+            predictions=ModelBlob(
+                tensors=[("predictions", np.asarray(outs))]).to_bytes(),
+            model_version=version,
+            channel=channel,
+            duration_ms=(time.time() - t0) * 1e3,
+        ).to_wire()
+
+    def _status(self, raw: bytes) -> bytes:
+        return dumps(self.gateway.describe())
+
+    def _health(self, raw: bytes) -> bytes:
+        return dumps({"status": "SERVING",
+                      "installed": self.gateway.installed()})
+
+    def _get_metrics(self, raw: bytes) -> bytes:
+        from metisfl_tpu.telemetry import render_metrics
+        return render_metrics().encode("utf-8")
+
+    def _shutdown_rpc(self, raw: bytes) -> bytes:
+        threading.Thread(target=self.stop, daemon=True).start()
+        return dumps({"ok": True})
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> int:
+        self.port = self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._shutdown_event.is_set():
+            return
+        from metisfl_tpu.comm.health import NOT_SERVING
+
+        self._health_servicer.set_all(NOT_SERVING)
+        self._shutdown_event.set()
+        # RPC server first: no new Predicts can race the gateway teardown
+        # (a racing request would otherwise respawn a batcher worker on a
+        # torn-down gateway)
+        self._server.stop()
+        self.gateway.shutdown()
+
+    def wait_for_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown_event.wait(timeout)
+
+
+class ServingClient:
+    """Application → gateway client."""
+
+    def __init__(self, host: str, port: int, ssl=None, comm=None):
+        kwargs = {}
+        if comm is not None:
+            kwargs = {"default_deadline_s": comm.default_deadline_s,
+                      "retries": comm.retries,
+                      "retry_sleep_s": comm.retry_sleep_s}
+        self._client = RpcClient(host, port, SERVING_SERVICE, ssl=ssl,
+                                 **kwargs)
+
+    def predict(self, x, key: str = "",
+                timeout: Optional[float] = None) -> ServeReply:
+        req = ServeRequest(
+            request_id=uuid.uuid4().hex,
+            key=key,
+            inputs=ModelBlob(
+                tensors=[("x", np.asarray(x))]).to_bytes())
+        return ServeReply.from_wire(
+            self._client.call("Predict", req.to_wire(), timeout=timeout))
+
+    def predictions(self, reply: ServeReply) -> np.ndarray:
+        return dict(ModelBlob.from_bytes(
+            reply.predictions).tensors)["predictions"]
+
+    def status(self, timeout: float = 10.0,
+               wait_ready: bool = True) -> dict:
+        return loads(self._client.call("GetServingStatus", b"",
+                                       timeout=timeout,
+                                       wait_ready=wait_ready,
+                                       idempotent=True))
+
+    def health(self, timeout: float = 5.0) -> dict:
+        return loads(self._client.call("GetHealthStatus", b"",
+                                       timeout=timeout, idempotent=True))
+
+    def get_metrics(self, timeout: float = 10.0) -> str:
+        return self._client.call("GetMetrics", b"", timeout=timeout,
+                                 idempotent=True).decode("utf-8")
+
+    def list_methods(self, timeout: float = 5.0) -> dict:
+        import json as _json
+        raw = self._client.call("ListMethods", b"", timeout=timeout,
+                                idempotent=True)
+        return _json.loads(raw.decode("utf-8"))
+
+    def shutdown_gateway(self) -> bool:
+        return bool(loads(self._client.call("ShutDown", b""))["ok"])
+
+    def close(self) -> None:
+        self._client.close()

@@ -46,9 +46,15 @@ def summarize(stats: Dict[str, Any]) -> str:
         has_phases = any("dispatch_duration_ms" in m
                          or "wait_duration_ms" in m for m in rounds)
         phase_header = f"{'disp':>8} {'wait':>8} " if has_phases else ""
+        # model-lifecycle lineage (registry era): the version each round
+        # registered and the stable head at round close. Pre-registry
+        # payloads lack the keys (or carry zeros) and render unchanged.
+        has_versions = any(m.get("registered_version") for m in rounds)
+        ver_header = f"{'ver':>6} {'stable':>6} " if has_versions else ""
         lines.append(f"{'round':>5} {'wall':>8} {phase_header}"
                      f"{'cohort':>6} {'agg':>8} "
-                     f"{'params':>10} {'uplink':>9} {'errors':>6}")
+                     f"{'params':>10} {'uplink':>9} {ver_header}"
+                     f"{'errors':>6}")
         for meta in rounds:
             wall_ms = 1e3 * max(
                 0.0, meta.get("completed_at", 0) - meta.get("started_at", 0))
@@ -61,6 +67,13 @@ def summarize(stats: Dict[str, Any]) -> str:
                 phase_cells = (
                     f"{_fmt_ms(meta.get('dispatch_duration_ms', 0.0)):>8} "
                     f"{_fmt_ms(meta.get('wait_duration_ms', 0.0)):>8} ")
+            ver_cells = ""
+            if has_versions:
+                reg = meta.get("registered_version", 0)
+                stable = meta.get("stable_version", 0)
+                ver_cells = (
+                    f"{(f'v{reg}' if reg else '-'):>6} "
+                    f"{(f'v{stable}' if stable else '-'):>6} ")
             lines.append(
                 f"{meta.get('global_iteration', '?'):>5} "
                 f"{_fmt_ms(wall_ms):>8} "
@@ -69,6 +82,7 @@ def summarize(stats: Dict[str, Any]) -> str:
                 f"{_fmt_ms(meta.get('aggregation_duration_ms', 0.0)):>8} "
                 f"{meta.get('model_size', {}).get('values', 0):>10} "
                 f"{up_s:>9} "
+                f"{ver_cells}"
                 f"{len(meta.get('errors', [])):>6}")
         # clamped like the table rows, so both views agree on skewed clocks
         walls = [1e3 * max(0.0, m.get("completed_at", 0)
@@ -218,6 +232,21 @@ def learning_health_summary(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
         r["mean_update_norm"] = (sum(norms) / len(norms)) if norms else 0.0
         rows.append(r)
     rows.sort(key=lambda r: -r["last_div"])
+    return rows
+
+
+def version_lineage(stats: Dict[str, Any]) -> List[Dict[str, int]]:
+    """Model-lifecycle lineage from round metadata: one row per round
+    that registered a version (``{"round", "registered", "stable"}``).
+    Empty for pre-registry payloads (backward compatible)."""
+    rows = []
+    for meta in stats.get("round_metadata", []):
+        reg = int(meta.get("registered_version", 0) or 0)
+        if not reg:
+            continue
+        rows.append({"round": int(meta.get("global_iteration", 0)),
+                     "registered": reg,
+                     "stable": int(meta.get("stable_version", 0) or 0)})
     return rows
 
 

@@ -65,6 +65,22 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
             f"eff_step={health.get('effective_step', 0.0):.4g}  "
             f"entropy={health.get('participation_entropy', 0.0):.2f}"
             f"{loss_cell}{anom_cell}")
+    reg = snap.get("registry") or {}
+    if reg.get("enabled"):
+        # model-lifecycle line (registry/registry.py): channel heads +
+        # retained lineage; pre-registry controllers ship no "registry"
+        # key and render as before
+        stable = reg.get("stable", 0)
+        cand = reg.get("candidate", 0)
+        versions = reg.get("versions", [])
+        gates = [v for v in versions
+                 if v.get("gate") and not v["gate"].get("passed", True)]
+        gate_cell = (f"  gate_rejected=v{gates[-1]['version']}"
+                     if gates else "")
+        lines.append(
+            f"registry: stable={f'v{stable}' if stable else '-'}  "
+            f"candidate={f'v{cand}' if cand else '-'}  "
+            f"versions={len(versions)}{gate_cell}")
     has_div = any("divergence_score" in l for l in learners)
     if learners:
         lines.append("")
@@ -115,7 +131,11 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
 
 def render_probe(reflection: Dict[str, Any]) -> str:
     methods = reflection.get("methods", [])
-    lines = [f"service {reflection.get('service', '?')} "
+    # endpoint role (ListMethods reflection): a serving gateway's surface
+    # is distinguishable from learner/controller ones at a glance
+    role = reflection.get("role", "")
+    role_cell = f" role={role}" if role else ""
+    lines = [f"service {reflection.get('service', '?')}{role_cell} "
              f"({len(methods)} methods):"]
     for m in methods:
         flags = ",".join(m.get("transports", []))

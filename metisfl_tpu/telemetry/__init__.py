@@ -100,6 +100,18 @@ M_CORRUPT_PAYLOADS_TOTAL = "corrupt_payloads_total"
 M_CHAOS_FAULTS_INJECTED_TOTAL = "chaos_faults_injected_total"
 # driver failover supervision (driver/session.py)
 M_CONTROLLER_RESTARTS_TOTAL = "controller_restarts_total"
+M_GATEWAY_RESTARTS_TOTAL = "gateway_restarts_total"
+# model registry (registry/registry.py)
+M_REGISTRY_VERSIONS_TOTAL = "registry_versions_total"
+M_REGISTRY_VERSION_STATE = "registry_version_state"
+M_REGISTRY_PROMOTIONS_TOTAL = "registry_promotions_total"
+M_REGISTRY_ROLLBACKS_TOTAL = "registry_rollbacks_total"
+# serving gateway (serving/gateway.py)
+M_SERVING_REQUESTS_TOTAL = "serving_requests_total"
+M_SERVING_REQUEST_LATENCY_SECONDS = "serving_request_latency_seconds"
+M_SERVING_BATCH_ROWS = "serving_batch_rows"
+M_SERVING_MODEL_VERSION = "serving_model_version"
+M_SERVING_SWAPS_TOTAL = "serving_swaps_total"
 
 __all__ = [
     "metrics",

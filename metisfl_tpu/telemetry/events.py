@@ -155,12 +155,57 @@ class RoundHealth:
     anomalous: int = 0
 
 
+@dataclass(frozen=True)
+class VersionRegistered:
+    """The model registry minted a candidate version from an aggregated
+    round (registry/registry.py)."""
+
+    kind: ClassVar[str] = "version_registered"
+    version: int
+    round: int = 0
+    parent: int = 0
+    channel: str = "candidate"
+
+
+@dataclass(frozen=True)
+class VersionPromoted:
+    """A registry version moved channels (candidate → stable), through
+    the promotion gate or an operator's PromoteVersion."""
+
+    kind: ClassVar[str] = "version_promoted"
+    version: int
+    round: int = 0
+    previous_stable: int = 0
+    forced: bool = False
+
+
+@dataclass(frozen=True)
+class VersionRolledBack:
+    """The stable channel was rolled back to the prior stable version."""
+
+    kind: ClassVar[str] = "version_rolled_back"
+    version: int
+    rolled_back_from: int = 0
+
+
+@dataclass(frozen=True)
+class ServingSwapped:
+    """The serving gateway hot-swapped a channel to a new version
+    (serving/gateway.py) without dropping in-flight requests."""
+
+    kind: ClassVar[str] = "serving_swapped"
+    channel: str
+    version: int
+    previous: int = 0
+
+
 EVENT_TYPES: Dict[str, type] = {
     cls.kind: cls
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
                 TaskCompleted, RetryScheduled, FaultInjected, EpochChanged,
                 AggregationDone, FailoverBegan, UpdateAnomalous,
-                RoundHealth)
+                RoundHealth, VersionRegistered, VersionPromoted,
+                VersionRolledBack, ServingSwapped)
 }
 
 

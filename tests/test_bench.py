@@ -452,3 +452,16 @@ def test_new_sections_registered():
     items = mod._items()
     assert "e2e" in items and "lora" in items
     assert items[-1] == "lora"
+
+
+def test_serving_bench_section():
+    import bench
+
+    out = bench.bench_serving(requests=6, rows_per_request=2, max_batch=8)
+    assert out["serving_params"] > 1_000_000       # bench model size
+    assert out["serving_unbatched_rows_per_sec"] > 0
+    assert out["serving_batched_rows_per_sec"] > 0
+    assert out["serving_swap_pause_ms"] > 0
+    assert "serving" in bench._SECTIONS
+    assert "serving" in bench._SECTION_TIMEOUTS
+    assert "serving" in bench._HOST_SECTIONS

@@ -443,12 +443,16 @@ def test_controller_crash_failover_midround(tmp_path, capsys):
     template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
                             np.zeros((2, 4), np.float32),
                             rng_seed=0).get_variables()
+    from metisfl_tpu.config import RegistryConfig
     config = FederationConfig(
         controller_port=_free_port(),
         round_deadline_secs=45.0,  # backstop if the kill strands a round
         aggregation=AggregationConfig(scaler="participants"),
         train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
         eval=EvalConfig(every_n_rounds=0),
+        # registry on (ISSUE 5): version lineage must survive the
+        # kill + --resume failover this test drives end-to-end
+        registry=RegistryConfig(enabled=True, retention=3),
         termination=TerminationConfig(federation_rounds=3,
                                       execution_cutoff_mins=6.0),
         failover=FailoverConfig(max_controller_restarts=2,
@@ -492,6 +496,21 @@ def test_controller_crash_failover_midround(tmp_path, capsys):
             assert set(meta["health"]["divergence_score"]) <= \
                 set(stats["learners"])
             assert meta.get("train_metrics"), "shipped metrics dropped"
+        # ---- model-lifecycle lineage survives the failover (ISSUE 5) --
+        # every completed round registered a version, ids are strictly
+        # monotone ACROSS the kill + --resume restart (the restored
+        # registry resumes its counter instead of re-minting v1), and
+        # the restored incarnation still serves the lineage
+        versions = [m.get("registered_version", 0)
+                    for m in stats["round_metadata"]]
+        assert all(v > 0 for v in versions), versions
+        assert versions == sorted(set(versions)), versions
+        # the federation keeps aggregating until shutdown, so the live
+        # candidate head is AT LEAST the last round the stats captured
+        reg = session._client.describe_registry()
+        assert reg["enabled"] and reg["candidate"] >= max(versions)
+        assert session._client.get_registered_model(
+            channel="candidate") not in (b"", None)
         # the restored controller's live snapshot reports the health
         # plane (scores restored from the checkpoint + later rounds)
         live = session._client.describe_federation(timeout=15.0)
@@ -549,5 +568,125 @@ def test_controller_crash_failover_midround(tmp_path, capsys):
         out = capsys.readouterr().out
         assert "reason=chaos_kill" in out
         assert "round_started" in out and "task_dispatched" in out
+    finally:
+        session.shutdown_federation()
+
+
+def test_serving_gateway_chaos_kill_relaunches_pinned_to_stable(tmp_path):
+    """Model lifecycle plane (ISSUE 5): a 1-learner federation with the
+    registry + serving gateway enabled runs to completion and promotes a
+    stable version; the seeded chaos injector then kills the gateway
+    process on its first Predict (= mid-canary: canary_percent is armed
+    and traffic is flowing). The driver's supervision must relaunch the
+    gateway, and the relaunch — which carries no state of its own — must
+    pin itself back to the LAST PROMOTED version via its first registry
+    poll and serve it."""
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.config import RegistryConfig, ServingConfig
+    from metisfl_tpu.driver.session import DriverSession
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.telemetry import parse_exposition
+
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((4, 2)).astype(np.float32)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.argmax(x @ w, -1).astype(np.int32)
+
+    def recipe():
+        ops = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                           np.zeros((2, 4), np.float32), rng_seed=0)
+        return ops, ArrayDataset(x, y, seed=0), None, ArrayDataset(x, y)
+
+    template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                            np.zeros((2, 4), np.float32),
+                            rng_seed=0).get_variables()
+    config = FederationConfig(
+        controller_port=_free_port(),
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=1),
+        registry=RegistryConfig(enabled=True, retention=3),
+        serving=ServingConfig(enabled=True, port=_free_port(),
+                              max_batch=4, canary_percent=50.0,
+                              poll_every_s=0.2),
+        termination=TerminationConfig(federation_rounds=2,
+                                      execution_cutoff_mins=6.0),
+        chaos=ChaosConfig(enabled=True, seed=11, rules=[
+            {"process": "serving", "side": "server", "fault": "kill",
+             "method": "Predict", "max_fires": 1}]),
+    )
+    session = DriverSession(config, template, [recipe],
+                            workdir=str(tmp_path))
+    restarts = telemetry.registry().counter("gateway_restarts_total", "")
+    base_restarts = restarts.value()
+    try:
+        session.initialize_federation()
+        session.monitor_federation(poll_every_s=1.0,
+                                   eval_drain_timeout_s=60.0)
+        # a version must have been promoted by the eval round-trip. The
+        # federation keeps aggregating (and promoting) until shutdown, so
+        # the stable head only ADVANCES from here — assertions below are
+        # lower bounds, not equality against a moving target.
+        _wait(lambda: session._client.describe_registry()["stable"] > 0,
+              timeout_s=60.0, msg="a promoted stable version")
+        stable_at_kill = session._client.describe_registry()["stable"]
+
+        # no-retry clients, one per call: the kill-triggering call must
+        # surface the death at once, and post-relaunch polls must dial a
+        # FRESH channel — a channel that watched the endpoint die carries
+        # doubling reconnect backoff that can outlast the poll window
+        from metisfl_tpu.config import CommConfig
+        from metisfl_tpu.serving.service import ServingClient
+
+        def _fresh_client():
+            return ServingClient(
+                "localhost", config.serving.port,
+                comm=CommConfig(retries=0, default_deadline_s=15.0))
+
+        # first Predict fires the armed kill: the gateway dies mid-call
+        client = _fresh_client()
+        try:
+            client.predict(x[:2], key="canary-user")
+        except Exception:  # noqa: BLE001 - expected: the process died
+            pass
+        client.close()
+        gw = next(p for p in session._procs if p.name == "serving")
+        _wait(lambda: gw.process.poll() is not None, timeout_s=30.0,
+              msg="gateway death")
+
+        # the driver's supervision path relaunches it (the same call
+        # monitor_federation makes every poll), armed CLEAN — the kill
+        # rule must not re-fire on the relaunch
+        _wait(session._supervise_gateway, timeout_s=30.0,
+              msg="supervised gateway relaunch")
+        scraped = parse_exposition(telemetry.render_metrics())
+        assert scraped["gateway_restarts_total"][()] - base_restarts == 1
+
+        # the relaunch carries no state: its first registry poll must pin
+        # it back onto the promoted lineage — a stable AT LEAST as new as
+        # the one promoted before the kill
+        def _pinned():
+            probe = _fresh_client()
+            try:
+                installed = probe.status(
+                    timeout=5.0, wait_ready=False).get("installed", {})
+                return installed.get("stable", 0) >= stable_at_kill
+            except Exception:  # noqa: BLE001 - still booting
+                return False
+            finally:
+                probe.close()
+
+        _wait(_pinned, timeout_s=120.0,
+              msg="relaunched gateway pinned to the promoted lineage")
+        client = _fresh_client()
+        reply = client.predict(x[:2], key="stable-user")
+        assert reply.model_version >= stable_at_kill
+        assert reply.channel in ("stable", "candidate")
+        # and the served version is genuinely within the registry's
+        # promoted window at observation time
+        assert reply.model_version <= \
+            session._client.describe_registry()["next_version"]
+        client.close()
     finally:
         session.shutdown_federation()

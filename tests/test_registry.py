@@ -1,0 +1,426 @@
+"""Model lifecycle plane (ISSUE 5): versioned registry, eval-gated
+promotion, rollback, retention GC, checkpoint-failover lineage, and the
+disabled-path inertness contract."""
+
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import JoinRequest, TaskResult, TrainParams
+from metisfl_tpu.config import (
+    AggregationConfig,
+    CheckpointConfig,
+    EvalConfig,
+    FederationConfig,
+    ModelStoreConfig,
+    PromotionConfig,
+    RegistryConfig,
+    ServingConfig,
+)
+from metisfl_tpu.registry import (
+    CHANNEL_CANDIDATE,
+    CHANNEL_STABLE,
+    ModelRegistry,
+)
+from metisfl_tpu.tensor.pytree import pack_model
+
+
+def _blob(seed=0):
+    rng = np.random.default_rng(seed)
+    return pack_model({"w": rng.standard_normal((3, 2)).astype(np.float32)})
+
+
+def _registry(**kwargs):
+    promotion = kwargs.pop("promotion", PromotionConfig())
+    return ModelRegistry(RegistryConfig(enabled=True, retention=3,
+                                        promotion=promotion, **kwargs),
+                         config_hash="cfg0")
+
+
+@pytest.fixture
+def clean_telemetry():
+    from metisfl_tpu.telemetry import events as _events
+    from metisfl_tpu.telemetry import metrics as _metrics
+    _metrics.set_enabled(True)
+    _metrics.registry().reset()
+    _events.set_enabled(True)
+    _events.journal().reset()
+    yield
+    _metrics.registry().reset()
+    _events.journal().reset()
+
+
+# ---------------------------------------------------------------------- #
+# registration + gate
+# ---------------------------------------------------------------------- #
+
+def test_register_mints_monotonic_versions_with_lineage(clean_telemetry):
+    reg = _registry()
+    v1 = reg.register(0, _blob(0), {"anomalous": []})
+    v2 = reg.register(1, _blob(1), {"anomalous": []})
+    assert (v1.version, v2.version) == (1, 2)
+    assert v2.parent == 0  # nothing stable yet
+    assert v1.config_hash == "cfg0"
+    assert reg.head(CHANNEL_CANDIDATE).version == 2
+    assert reg.blob(1) == _blob(0)
+    # registration journaled
+    from metisfl_tpu.telemetry import events as _events
+    kinds = [e["kind"] for e in _events.tail()]
+    assert kinds.count("version_registered") == 2
+
+
+def test_gate_accepts_clean_round_and_promotes_on_eval(clean_telemetry):
+    reg = _registry()
+    reg.register(0, _blob(), {"anomalous": [],
+                              "divergence_score": {"L0": 0.2, "L1": 0.3}})
+    # eval not reported yet: gate refuses (require_eval)
+    passed, reasons = reg.evaluate_gate(1)
+    assert not passed and any("eval" in r for r in reasons)
+    promoted = reg.note_eval(0, {"test/accuracy": 0.8, "test/loss": 0.5})
+    assert promoted is not None and promoted.version == 1
+    assert reg.head(CHANNEL_STABLE).version == 1
+    assert reg.head(CHANNEL_CANDIDATE) is None
+    from metisfl_tpu.telemetry import events as _events
+    assert any(e["kind"] == "version_promoted" and e["version"] == 1
+               for e in _events.tail())
+
+
+def test_gate_rejects_anomalous_round(clean_telemetry):
+    reg = _registry()
+    reg.register(0, _blob(), {"anomalous": []})
+    reg.note_eval(0, {"test/accuracy": 0.5})
+    reg.register(1, _blob(1), {"anomalous": ["L2"]})
+    assert reg.note_eval(1, {"test/accuracy": 0.99}) is None
+    passed, reasons = reg.evaluate_gate(2)
+    assert not passed and any("anomalous" in r for r in reasons)
+    assert reg.head(CHANNEL_STABLE).version == 1
+    # the rejection is recorded for operators
+    assert reg.info(2).gate["passed"] is False
+
+
+def test_gate_rejects_eval_regression_past_min_delta(clean_telemetry):
+    reg = _registry(promotion=PromotionConfig(min_delta=0.01))
+    reg.register(0, _blob(), {})
+    reg.note_eval(0, {"test/accuracy": 0.9})
+    reg.register(1, _blob(1), {})
+    # 0.905 improves but under min_delta
+    assert reg.note_eval(1, {"test/accuracy": 0.905}) is None
+    passed, reasons = reg.evaluate_gate(2)
+    assert not passed and any("accuracy" in r for r in reasons)
+    # a clear improvement passes
+    promoted = reg.note_eval(1, {"test/accuracy": 0.95})
+    assert promoted is not None and reg.head(CHANNEL_STABLE).version == 2
+
+
+def test_gate_loss_metric_improves_downward(clean_telemetry):
+    reg = _registry(promotion=PromotionConfig(metric="test/loss"))
+    reg.register(0, _blob(), {})
+    reg.note_eval(0, {"test/loss": 0.4})
+    reg.register(1, _blob(1), {})
+    assert reg.note_eval(1, {"test/loss": 0.6}) is None  # worse loss
+    promoted = reg.note_eval(1, {"test/loss": 0.3})
+    assert promoted is not None
+
+
+def test_gate_bounds_divergence_quantile(clean_telemetry):
+    # nearest-rank quantile: with 10 scores, p90 is the 9th-smallest —
+    # ONE outlier sits above it (tolerated), TWO put it at p90 (rejected)
+    two_high = {f"L{i}": 0.1 for i in range(8)} | {"L8": 5.0, "L9": 6.0}
+    reg = _registry(promotion=PromotionConfig(
+        max_divergence=1.0, divergence_quantile=0.9))
+    reg.register(0, _blob(), {"anomalous": [],
+                              "divergence_score": two_high})
+    passed, reasons = reg.evaluate_gate(1)
+    assert not passed and any("divergence" in r for r in reasons)
+    # a single outlier is above the p90 rank: the quantile rule tolerates
+    # it (that is what quantile-vs-max means)
+    one_high = {f"L{i}": 0.1 for i in range(9)} | {"L9": 5.0}
+    reg1 = _registry(promotion=PromotionConfig(
+        max_divergence=1.0, divergence_quantile=0.9))
+    reg1.register(0, _blob(), {"anomalous": [],
+                               "divergence_score": one_high})
+    reg1.note_eval(0, {"test/accuracy": 0.5})
+    assert reg1.head(CHANNEL_STABLE) is not None
+    # and a lower quantile under the bound passes the two-outlier round
+    reg2 = _registry(promotion=PromotionConfig(
+        max_divergence=1.0, divergence_quantile=0.5))
+    reg2.register(0, _blob(), {"anomalous": [],
+                               "divergence_score": two_high})
+    reg2.note_eval(0, {"test/accuracy": 0.5})
+    assert reg2.head(CHANNEL_STABLE) is not None
+
+
+def test_operator_force_promote_bypasses_gate(clean_telemetry):
+    reg = _registry()
+    reg.register(0, _blob(), {"anomalous": ["L0"]})
+    with pytest.raises(ValueError):
+        reg.promote(1)
+    info = reg.promote(1, force=True)
+    assert info.channel == CHANNEL_STABLE
+    assert info.gate["forced"] is True
+
+
+def test_rollback_restores_prior_stable(clean_telemetry):
+    reg = _registry()
+    reg.register(0, _blob(0), {})
+    reg.note_eval(0, {"test/accuracy": 0.5})
+    reg.register(1, _blob(1), {})
+    reg.note_eval(1, {"test/accuracy": 0.9})
+    assert reg.head(CHANNEL_STABLE).version == 2
+    restored = reg.rollback()
+    assert restored.version == 1
+    assert reg.head(CHANNEL_STABLE).version == 1
+    # one level only: a second rollback has no target
+    assert reg.rollback() is None
+    from metisfl_tpu.telemetry import events as _events
+    assert any(e["kind"] == "version_rolled_back" and e["version"] == 1
+               for e in _events.tail())
+
+
+def test_retention_gc_erases_blobs_and_prunes_gauge_series(clean_telemetry):
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.telemetry import parse_exposition, render_metrics
+
+    reg = _registry()
+    for r in range(8):
+        reg.register(r, _blob(r), {})
+    kept = [v.version for v in reg.versions()]
+    # retention=3 non-head versions + the candidate head
+    assert len(kept) <= 4, kept
+    assert reg.head(CHANNEL_CANDIDATE).version == 8
+    # retired blobs erased, retained ones intact
+    assert reg.blob(1) is None
+    assert reg.blob(8) == _blob(7)
+    # per-version gauge series pruned at GC (exposition-tested, the PR-4
+    # learner-series posture): only retained versions appear
+    series = parse_exposition(render_metrics()).get(
+        telemetry.M_REGISTRY_VERSION_STATE, {})
+    labelled = {dict(k)["version"] for k in series}
+    assert labelled == {f"v{v}" for v in kept}
+
+
+def test_gc_never_retires_channel_heads_or_rollback_target(clean_telemetry):
+    reg = _registry()
+    reg.register(0, _blob(0), {})
+    reg.note_eval(0, {"test/accuracy": 0.1})
+    reg.register(1, _blob(1), {})
+    reg.note_eval(1, {"test/accuracy": 0.9})   # stable=2, prev=1
+    for r in range(2, 12):
+        reg.register(r, _blob(r), {})
+    versions = {v.version for v in reg.versions()}
+    assert {1, 2} <= versions  # rollback target + stable survive GC
+    assert reg.blob(2) is not None
+    assert reg.rollback().version == 1  # and the target is still servable
+
+
+def test_export_restore_roundtrip_preserves_lineage(clean_telemetry):
+    reg = _registry()
+    reg.register(0, _blob(0), {"anomalous": []})
+    reg.note_eval(0, {"test/accuracy": 0.7})
+    reg.register(1, _blob(1), {})
+    state = reg.export_state()
+    reg2 = _registry()
+    reg2.restore_state(state)
+    assert reg2.head(CHANNEL_STABLE).version == 1
+    assert reg2.head(CHANNEL_CANDIDATE).version == 2
+    assert reg2.blob(2) == _blob(1)
+    assert reg2.info(1).eval_metrics == {"test/accuracy": 0.7}
+    # version ids stay monotonic across the restore
+    assert reg2.register(2, _blob(2), {}).version == 3
+
+
+# ---------------------------------------------------------------------- #
+# controller wiring (registration, lineage, checkpoint failover)
+# ---------------------------------------------------------------------- #
+
+class _NullProxy:
+    def __init__(self, record):
+        pass
+
+    def run_task(self, task):
+        pass
+
+    def evaluate(self, task, callback):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+def _controller(tmp_path, tag, registry_enabled=True):
+    from metisfl_tpu.controller.core import Controller
+
+    config = FederationConfig(
+        protocol="asynchronous",
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=4, local_steps=1),
+        eval=EvalConfig(every_n_rounds=0),
+        registry=RegistryConfig(enabled=registry_enabled, retention=3),
+        model_store=ModelStoreConfig(store="in_memory"),
+        checkpoint=CheckpointConfig(dir=str(tmp_path / f"ckpt_{tag}"),
+                                    every_n_rounds=1),
+    )
+    return Controller(config, _NullProxy)
+
+
+def _model(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal((3, 2)).astype(np.float32)}
+
+
+def _wait(predicate, timeout_s=20.0, msg="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _run_rounds(ctrl, n):
+    reply = ctrl.join(JoinRequest(hostname="h", port=7100,
+                                  num_train_examples=4))
+    for i in range(n):
+        assert ctrl.task_completed(TaskResult(
+            task_id=f"t{i}", learner_id=reply.learner_id,
+            auth_token=reply.auth_token, model=pack_model(_model(i)),
+            completed_batches=1))
+        _wait(lambda i=i: ctrl.global_iteration > i, msg=f"round {i + 1}")
+    return reply
+
+
+def test_controller_registers_each_round_into_lineage(tmp_path,
+                                                      clean_telemetry):
+    ctrl = _controller(tmp_path, "lin")
+    try:
+        ctrl.set_community_model(pack_model(_model()))
+        _run_rounds(ctrl, 3)
+        _wait(lambda: len(ctrl.round_metadata) >= 3, msg="metadata")
+        desc = ctrl.describe_registry()
+        assert desc["enabled"] and desc["candidate"] == 3
+        # per-round lifecycle lineage lands in RoundMetadata
+        assert [m.registered_version for m in ctrl.round_metadata] == \
+            [1, 2, 3]
+        # blob by channel resolves the head
+        assert ctrl.registered_model(channel="candidate") is not None
+        # the live snapshot carries the registry section
+        assert ctrl.describe()["registry"]["candidate"] == 3
+    finally:
+        ctrl.shutdown()
+
+
+def test_registry_lineage_survives_kill_and_resume(tmp_path,
+                                                   clean_telemetry):
+    """Kill + --resume failover contract at the controller level: the
+    checkpoint carries channel heads, version metadata, AND blobs; the
+    restored incarnation keeps serving the same stable head and mints
+    monotonically increasing ids."""
+    ctrl = _controller(tmp_path, "fo")
+    ctrl.set_community_model(pack_model(_model()))
+    _run_rounds(ctrl, 2)
+    ctrl.promote_version(1, force=True)
+    stable_blob = ctrl.registered_model(channel="stable")
+    # the "kill": drain the executor (round checkpoints + the queued
+    # post-promotion save) then write the final state a fresh process
+    # restores below — an undrained round-end save could otherwise land
+    # a pre-promotion snapshot after ours
+    ctrl.shutdown()
+    ctrl.save_checkpoint()
+
+    ctrl2 = _controller(tmp_path, "fo")
+    try:
+        assert ctrl2.restore_checkpoint()
+        desc = ctrl2.describe_registry()
+        assert desc["stable"] == 1
+        assert ctrl2.registered_model(channel="stable") == stable_blob
+        # round counter AND version counter both resumed
+        _run_rounds(ctrl2, 1)
+        _wait(lambda: ctrl2.describe_registry()["candidate"] == 3,
+              msg="post-restore registration")
+        metas = [m.registered_version for m in ctrl2.round_metadata]
+        assert metas[-1] == 3, metas
+    finally:
+        ctrl2.shutdown()
+
+
+def test_disabled_registry_is_one_attribute_check(tmp_path, monkeypatch,
+                                                  clean_telemetry):
+    """registry.enabled=false reduces the post-aggregation path to one
+    attribute check: no ModelRegistry is constructed and no registry
+    code runs (pinned by poisoning every entry point)."""
+    from metisfl_tpu.registry import ModelRegistry
+
+    def _boom(*a, **k):
+        raise AssertionError("registry code ran on the disabled path")
+
+    monkeypatch.setattr(ModelRegistry, "register", _boom)
+    monkeypatch.setattr(ModelRegistry, "note_eval", _boom)
+    ctrl = _controller(tmp_path, "off", registry_enabled=False)
+    try:
+        assert ctrl._registry is None
+        ctrl.set_community_model(pack_model(_model()))
+        _run_rounds(ctrl, 2)
+        assert ctrl.describe_registry() == {"enabled": False}
+        assert "registry" not in ctrl.describe()
+        assert ctrl.registered_model(channel="stable") is None
+        # lineage carries the zero defaults (stats.py renders unchanged)
+        assert all(m.registered_version == 0 for m in ctrl.round_metadata)
+    finally:
+        ctrl.shutdown()
+
+
+def test_stats_table_renders_version_lineage_both_shapes():
+    from metisfl_tpu.stats import summarize, version_lineage
+
+    new = {
+        "global_iteration": 2,
+        "learners": ["L0"],
+        "round_metadata": [
+            {"global_iteration": 0, "started_at": 1.0, "completed_at": 2.0,
+             "selected_learners": ["L0"], "aggregation_duration_ms": 3.0,
+             "registered_version": 1, "stable_version": 0},
+            {"global_iteration": 1, "started_at": 2.0, "completed_at": 3.0,
+             "selected_learners": ["L0"], "aggregation_duration_ms": 3.0,
+             "registered_version": 2, "stable_version": 1},
+        ],
+        "community_evaluations": [],
+    }
+    text = summarize(new)
+    assert "ver" in text and "stable" in text
+    assert "v2" in text and "v1" in text
+    assert version_lineage(new) == [
+        {"round": 0, "registered": 1, "stable": 0},
+        {"round": 1, "registered": 2, "stable": 1}]
+
+    # pre-registry payload: no keys -> no columns, no lineage rows
+    old = {
+        "global_iteration": 1,
+        "learners": ["L0"],
+        "round_metadata": [
+            {"global_iteration": 0, "started_at": 1.0, "completed_at": 2.0,
+             "selected_learners": ["L0"], "aggregation_duration_ms": 3.0}],
+        "community_evaluations": [],
+    }
+    text_old = summarize(old)
+    assert " ver" not in text_old
+    assert version_lineage(old) == []
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="requires registry"):
+        FederationConfig(serving=ServingConfig(enabled=True))
+    with pytest.raises(ValueError, match="canary_percent"):
+        FederationConfig(registry=RegistryConfig(enabled=True),
+                         serving=ServingConfig(enabled=True,
+                                               canary_percent=150.0))
+    with pytest.raises(ValueError, match="retention"):
+        FederationConfig(registry=RegistryConfig(enabled=True,
+                                                 retention=0))
+    from metisfl_tpu.config import SecureAggConfig
+    with pytest.raises(ValueError, match="secure"):
+        FederationConfig(
+            aggregation=AggregationConfig(rule="secure_agg",
+                                          scaler="participants"),
+            secure=SecureAggConfig(enabled=True),
+            registry=RegistryConfig(enabled=True))
