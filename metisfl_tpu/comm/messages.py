@@ -111,6 +111,13 @@ class TrainParams(Message):
     # than a compile-dominated one.
     profile_dir: str = ""
     profile_steps: int = 3
+    # Performance-observatory gating (telemetry/profile.py): when true the
+    # learner captures device utilization per train task (step-time EWMA,
+    # achieved-MFU estimate, HBM watermark) and ships it back in
+    # ``TaskResult.device_stats``. The controller stamps this false when
+    # ``telemetry.profile.enabled=false``, reducing the learner hot path
+    # to this one attribute check.
+    device_stats: bool = True
     # Fuse this many optimizer steps into ONE jit-compiled lax.scan program.
     # Cuts host→device dispatch to 1/scan_chunk of the per-step path — the
     # difference is pure overhead on TPU (and dominant when the chip sits
@@ -250,6 +257,12 @@ class TaskResult(Message):
     # SCAFFOLD client control-variate delta (c_i_new - c_i, ModelBlob);
     # the controller folds the cohort's deltas into the server variate.
     control_delta: bytes = b""
+    # Device-utilization capture (telemetry/profile.py DeviceMonitor):
+    # step_ms_ewma, achieved mfu, hbm_peak_bytes, device_kind — folded
+    # into the controller's RoundProfile so the cost profile is
+    # federation-wide. Empty when TrainParams.device_stats is false
+    # (profile plane opted out) or the task completed zero steps.
+    device_stats: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

@@ -76,6 +76,20 @@ _M_SERVER_BYTES = _REG.counter(
 _M_SERVER_ERRORS = _REG.counter(
     _tel.M_RPC_SERVER_ERRORS_TOTAL, "Handler invocations that raised",
     ("service", "method"))
+# Per-peer wire bytes (performance observatory): a client constructed
+# with ``peer=<learner_id>`` additionally attributes its payload bytes —
+# envelopes included, unlike the controller's payload-level
+# uplink/downlink counters — to that peer. Series are pruned on learner
+# leave via ``prune_peer_series`` (bounded cardinality under churn).
+_M_PEER_BYTES = _REG.counter(
+    _tel.M_RPC_PEER_BYTES_TOTAL,
+    "Client payload bytes attributed to one peer (learner id), by "
+    "direction", ("peer", "direction"))
+
+
+def prune_peer_series(peer: str) -> None:
+    for direction in ("sent", "received"):
+        _M_PEER_BYTES.remove(peer=peer, direction=direction)
 
 
 def _error_code_name(exc: Exception) -> str:
@@ -338,9 +352,14 @@ class RpcClient:
 
     def __init__(self, host: str, port: int, service_name: str,
                  retries: int = 10, retry_sleep_s: float = 1.0, ssl=None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 peer: str = ""):
         self.target = f"{host}:{port}"
         self.service_name = service_name
+        # optional peer identity (a learner id): when set, payload bytes
+        # additionally land in the peer-labeled wire-byte counter (the
+        # performance observatory's per-learner wire attribution)
+        self.peer = peer
         self.retries = retries
         self.retry_sleep_s = retry_sleep_s
         if default_deadline_s is None:
@@ -391,12 +410,8 @@ class RpcClient:
                         result = fn(send, timeout=timeout,
                                     wait_for_ready=wait_ready,
                                     metadata=_trace.outbound_metadata())
-                    _M_CLIENT_BYTES.inc(len(payload),
-                                        service=self.service_name,
-                                        method=method, direction="sent")
-                    _M_CLIENT_BYTES.inc(len(result),
-                                        service=self.service_name,
-                                        method=method, direction="received")
+                    self._count_bytes(len(payload), "sent", method=method)
+                    self._count_bytes(len(result), "received", method=method)
                     return result
                 except (grpc.RpcError, _chaos.FaultInjected) as exc:
                     code = exc.code() if hasattr(exc, "code") else None
@@ -553,6 +568,16 @@ class RpcClient:
         future.add_done_callback(_done)
         return outer
 
+    def _count_bytes(self, nbytes: int, direction: str,
+                     method: str = "") -> None:
+        """Payload bytes by direction: the per-method client counter, plus
+        the peer-labeled series when this client is pinned to a peer."""
+        if method:
+            _M_CLIENT_BYTES.inc(nbytes, service=self.service_name,
+                                method=method, direction=direction)
+        if self.peer:
+            _M_PEER_BYTES.inc(nbytes, peer=self.peer, direction=direction)
+
     def _record_client_call(self, method: str, retried: str, t0: float,
                             sent: Optional[int] = None,
                             received: Optional[int] = None) -> None:
@@ -564,11 +589,9 @@ class RpcClient:
         _M_CLIENT_LATENCY.observe(time.perf_counter() - t0,
                                   service=self.service_name, method=method)
         if sent is not None:
-            _M_CLIENT_BYTES.inc(sent, service=self.service_name,
-                                method=method, direction="sent")
+            self._count_bytes(sent, "sent", method=method)
         if received is not None:
-            _M_CLIENT_BYTES.inc(received, service=self.service_name,
-                                method=method, direction="received")
+            self._count_bytes(received, "received", method=method)
 
     def _async_chunked(self, method, payload, callback, error_callback,
                        timeout, wait_ready, retried: str = "0",

@@ -126,6 +126,27 @@ class HealthConfig:
 
 
 @dataclass
+class ProfileConfig:
+    """Performance observatory (telemetry/profile.py): typed per-round
+    cost profiles on the controller (phase waterfall, per-learner
+    uplink/downlink wire bytes + codec attribution, store/aggregation
+    time), device-utilization capture in the learner train loop
+    (step-time EWMA, achieved MFU, HBM watermark, shipped back in
+    ``TaskResult.device_stats``), and flag-gated periodic ``jax.profiler``
+    trace capture. ``enabled=false`` leaves every hot path at one
+    attribute check (no collector constructed, no device stats
+    shipped). ``python -m metisfl_tpu.perf`` renders the profiles."""
+
+    enabled: bool = True
+    # arm a jax.profiler capture on the dispatched tasks every N rounds
+    # (0 = never); sessions land under <dir>/jaxprof/round<N>/ in
+    # collision-free per-capture subdirs
+    trace_every_rounds: int = 0
+    # RoundProfile JSONL sink dir ("" → telemetry.dir, next to traces)
+    dir: str = ""
+
+
+@dataclass
 class TelemetryConfig:
     """Federation-wide observability (metisfl_tpu/telemetry): trace spans
     + metrics registry + event journal. ``enabled=false`` opts the whole
@@ -144,6 +165,8 @@ class TelemetryConfig:
     events: EventsConfig = field(default_factory=EventsConfig)
     # learning-health plane (telemetry/health.py)
     health: HealthConfig = field(default_factory=HealthConfig)
+    # performance observatory (telemetry/profile.py)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
     # flight-recorder bundle directory (telemetry/postmortem.py): crash /
     # chaos-kill / failover post-mortems land here. "" → recorder off;
     # the driver fills this in with <workdir>/postmortem.
@@ -413,6 +436,10 @@ class FederationConfig:
             # threshold 0 would flag EVERY above-median update anomalous
             raise ValueError(
                 "telemetry.health.anomaly_threshold must be > 0")
+        if self.telemetry.profile.trace_every_rounds < 0:
+            # a negative period would silently never fire via the modulo
+            raise ValueError(
+                "telemetry.profile.trace_every_rounds must be >= 0")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
         if self.train.dp_noise_multiplier < 0.0 or self.train.dp_clip_norm < 0.0:

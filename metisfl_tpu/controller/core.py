@@ -57,6 +57,7 @@ from metisfl_tpu.store import EvictionPolicy, make_store
 from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import profile as _tprofile
 from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.telemetry.health import HealthMonitor, finite_metrics
 from metisfl_tpu.tensor.pytree import ModelBlob
@@ -75,7 +76,7 @@ _M_ROUNDS = _REG.counter(_tel.M_ROUNDS_TOTAL, "Completed federation rounds")
 _M_PHASE = _REG.histogram(
     _tel.M_ROUND_PHASE_DURATION_SECONDS,
     "Per-phase round durations (dispatch/wait_uplinks/select/aggregate/"
-    "aggregate_block/store_insert)", ("phase",))
+    "aggregate_block/store_insert/close)", ("phase",))
 _M_UPLINK = _REG.counter(
     _tel.M_UPLINK_BYTES_TOTAL, "Model bytes received from learners",
     ("learner",))
@@ -204,6 +205,11 @@ class RoundMetadata:
     # lack the keys entirely and stats.py renders them unchanged.
     registered_version: int = 0
     stable_version: int = 0
+    # per-round cost profile (telemetry/profile.py RoundProfile): phase
+    # waterfall, per-learner wire-byte/codec/device attribution, store
+    # timings. Empty when the performance observatory is off — pre-profile
+    # payloads lack the key and stats.py renders them unchanged.
+    profile: Dict[str, Any] = field(default_factory=dict)
     # non-fatal round errors (e.g. partial-cohort secure aggregation after a
     # deadline) — surfaced in lineage instead of vanishing into a log line
     errors: List[str] = field(default_factory=list)
@@ -339,6 +345,21 @@ class Controller:
         self._health_advisory = bool(
             self._health is not None and getattr(hc, "advisory", False))
 
+        # Performance observatory (telemetry/profile.py): per-round cost
+        # profiles — phase waterfall, per-learner wire bytes + codec
+        # attribution, store timings, device stats. None when opted out —
+        # every hot-path hook is then one attribute check.
+        pc = getattr(config.telemetry, "profile", None)
+        self._profile: Optional[_tprofile.ProfileCollector] = None
+        if (config.telemetry.enabled and pc is not None
+                and getattr(pc, "enabled", False)):
+            self._profile = _tprofile.ProfileCollector(
+                pc, telemetry_dir=config.telemetry.dir,
+                service="controller")
+            # the flight recorder snapshots the active collector's tail
+            # into crash bundles
+            _tprofile.set_collector(self._profile)
+
         # Model lifecycle plane (registry/registry.py): versioned
         # community-model lineage with eval-gated promotion. None when
         # opted out — the post-aggregation path then costs exactly one
@@ -378,6 +399,15 @@ class Controller:
         self._store.shutdown()
         if self._registry is not None:
             self._registry.shutdown()
+        # Deregister the process-global collector handle if it is still
+        # ours: a later controller in the same process (the in-process
+        # test/driver pattern) with the profile plane off must see None —
+        # otherwise its RPC layer would keep minting per-learner
+        # attribution series into this dead collector.
+        if self._profile is not None:
+            if _tprofile.collector() is self._profile:
+                _tprofile.set_collector(None)
+            self._profile.close()
 
     # ------------------------------------------------------------------ #
     # membership (RPC thread)
@@ -491,6 +521,7 @@ class Controller:
             record = self._learners.get(learner_id)
             if record is None or record.auth_token != auth_token:
                 return False
+            proxy = record.proxy
             del self._learners[learner_id]
             _M_ACTIVE_LEARNERS.set(len(self._learners))
             # a departed learner's tasks can never complete: without this
@@ -503,7 +534,12 @@ class Controller:
                 self._task_dispatched_at.pop(tid, None)
         # bounded metric cardinality under churn: a departed learner's
         # per-learner series (uplink bytes, straggler AND divergence
-        # scores) must not accumulate for the process lifetime
+        # scores) must not accumulate for the process lifetime. Detach
+        # the proxy's peer label FIRST: an in-flight RPC's completion
+        # callback firing after the prune would otherwise re-mint the
+        # peer wire-byte series for the process lifetime.
+        if proxy is not None and hasattr(proxy, "detach_peer"):
+            proxy.detach_peer()
         self._prune_learner_series(learner_id)
         self._store.erase([learner_id])
         logger.info("learner %s left", learner_id)
@@ -523,10 +559,37 @@ class Controller:
         _M_DIVERGENCE.remove(learner=learner_id)
         if self._health is not None:
             self._health.drop(learner_id)
+        if self._profile is not None:
+            # downlink bytes, MFU/step-time/HBM gauges, codec attribution
+            # and peer wire-byte series all prune together
+            self._profile.drop(learner_id)
+        else:
+            # profile off NOW, but codec/peer attribution may have been
+            # minted earlier (e.g. before a config change + resume) —
+            # those series must never outlive the learner either
+            _tprofile.prune_attribution_series(learner_id)
 
     def active_learners(self) -> List[str]:
         with self._lock:
             return list(self._learners.keys())
+
+    def is_member(self, learner_id: str) -> bool:
+        """Cheap membership probe (RPC threads gate per-learner metric
+        attribution on it so departed learners' series stay pruned)."""
+        with self._lock:
+            return learner_id in self._learners
+
+    def attribute_decode(self, learner_id: str, seconds: float) -> None:
+        """Codec decode attribution under the registry lock: leave()
+        deletes the record under this lock and prunes the series only
+        afterwards, so an attribution recorded here either precedes the
+        prune (erased with it) or sees the learner gone — it can never
+        resurrect a pruned series."""
+        from metisfl_tpu.comm import codec as _codec
+
+        with self._lock:
+            if learner_id in self._learners:
+                _codec.attribute(learner_id, "decode", seconds)
 
     def learner_endpoints(self) -> List[Dict[str, Any]]:
         """Registered endpoints with the ports learners reported on join."""
@@ -696,6 +759,11 @@ class Controller:
             # and prunes the series after — an unlocked inc here could
             # interleave and resurrect a departed learner's series
             _M_UPLINK.inc(len(result.model), learner=result.learner_id)
+            if self._profile is not None and result.device_stats:
+                # learner-shipped device utilization (step EWMA, MFU,
+                # HBM watermark) → per-learner gauges + the round profile
+                self._profile.note_device(result.learner_id,
+                                          result.device_stats)
         _tevents.emit(_tevents.TaskCompleted, task_id=result.task_id,
                       learner_id=result.learner_id, round=result.round_id,
                       stale=stale, uplink_bytes=len(result.model))
@@ -734,6 +802,9 @@ class Controller:
                 self._store.insert(result.learner_id, model)
             _M_PHASE.observe(insert_sp.duration_ms / 1e3,
                              phase="store_insert")
+            if self._profile is not None:
+                self._profile.note_store_insert(result.learner_id,
+                                                insert_sp.duration_ms)
             with self._lock:
                 # step count and result round pair with the STORED model:
                 # dropped payloads (late topk, malformed) must not refresh
@@ -943,6 +1014,8 @@ class Controller:
                 # aggregation-failure retry opens a second wait barrier
                 # and both belong to this round's total
                 self._current_meta.wait_duration_ms += wait_sp.duration_ms
+        if self._profile is not None:
+            self._profile.note_mark("wait_end")
         with self._lock:
             self._phase = "select"
         select_sp = _ttrace.span("round.select", parent=self._round_span,
@@ -958,18 +1031,13 @@ class Controller:
                 selected = self._selector.select(cohort,
                                                  self.active_learners())
         _M_PHASE.observe(select_sp.duration_ms / 1e3, phase="select")
+        if self._profile is not None:
+            self._profile.note_phase("select", select_sp.duration_ms)
+            self._profile.note_mark("select_end")
         with self._lock:
             self._phase = "aggregate"
         try:
             self._compute_community_model(selected)
-            self._agg_failures = 0
-            with self._lock:
-                agg_ms = self._current_meta.aggregation_duration_ms
-            _tevents.emit(_tevents.AggregationDone,
-                          round=self.global_iteration,
-                          selected=len(selected), duration_ms=round(agg_ms, 3))
-            self._fold_round_health()
-            self._register_round_version()
         except Exception as exc:
             _M_AGG_FAILURES.inc()
             self._agg_failures += 1
@@ -1003,7 +1071,25 @@ class Controller:
                 self._scheduler.reset()
                 self._dispatch_train(self._sample_cohort())
             return
+        self._agg_failures = 0
+        if self._profile is not None:
+            self._profile.note_mark("aggregate_end")
+        with self._lock:
+            agg_ms = self._current_meta.aggregation_duration_ms
+        _tevents.emit(_tevents.AggregationDone,
+                      round=self.global_iteration,
+                      selected=len(selected), duration_ms=round(agg_ms, 3))
+        # round close: everything between the aggregate landing and the
+        # round counter advancing (health fold, version registration,
+        # eval dispatch, lineage bookkeeping) — the last measured phase
+        # of the cost-profile waterfall, and a real span in the trace
+        close_sp = _ttrace.span("round.close", parent=self._round_span)
+        self._fold_round_health()
+        self._register_round_version()
         self._send_eval_tasks()
+        close_ms = close_sp.end()
+        _M_PHASE.observe(close_ms / 1e3, phase="close")
+        profile_record = None
         with self._lock:
             self.global_iteration += 1
             self._current_meta.completed_at = time.time()
@@ -1011,10 +1097,20 @@ class Controller:
                 resource.RUSAGE_SELF).ru_maxrss
             round_wall_s = max(0.0, self._current_meta.completed_at
                                - self._current_meta.started_at)
+            if self._profile is not None:
+                # assemble under the lock (cheap dict building; the meta
+                # object stays reachable through round_metadata, so a
+                # concurrent to_dict must never race the write)
+                profile_record = self._profile.assemble_round(
+                    self._current_meta, close_ms=close_ms)
+                self._current_meta.profile = profile_record
             self.round_metadata.append(self._current_meta)
             self._current_meta = RoundMetadata(
                 global_iteration=self.global_iteration)
             round_sp, self._round_span = self._round_span, None
+        if profile_record is not None:
+            # the JSONL sink write stays off the controller lock
+            self._profile.persist(profile_record)
         if round_sp is not None:
             round_sp.set_attr("learners", len(selected))
             round_sp.end()
@@ -1097,6 +1193,17 @@ class Controller:
             agg_sp.end()
             _M_PHASE.observe(agg_sp.duration_ms / 1e3, phase="aggregate")
 
+    def _timed_select(self, block, k):
+        """Store lineage select with cost-profile attribution (the select
+        share of aggregation time is the 100k-learner ingest wall's
+        counterpart on the read side)."""
+        if self._profile is None:
+            return self._store.select(block, k=k)
+        t0 = time.perf_counter()
+        picked = self._store.select(block, k=k)
+        self._profile.note_store_select((time.perf_counter() - t0) * 1e3)
+        return picked
+
     def _compute_community_model_traced(self, selected: Sequence[str],
                                         agg_sp) -> None:
         lineage_k = self._aggregator.required_lineage
@@ -1137,7 +1244,7 @@ class Controller:
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 sp = block_span(block)
-                picked = self._store.select(block, k=lineage_k)
+                picked = self._timed_select(block, k=lineage_k)
                 for lid in block:
                     if lid in picked:
                         pairs.append((picked[lid], scales[lid]))
@@ -1187,7 +1294,7 @@ class Controller:
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 sp = block_span(block)
-                picked = self._store.select(block, k=lineage_k)
+                picked = self._timed_select(block, k=lineage_k)
                 pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
                 if pairs:
                     if needs_steps:
@@ -1215,7 +1322,7 @@ class Controller:
             for i in range(0, len(ids), stride):
                 block = ids[i : i + stride]
                 sp = block_span(block)
-                picked = self._store.select(block, k=lineage_k)
+                picked = self._timed_select(block, k=lineage_k)
                 pairs = [(picked[lid], scales[lid]) for lid in block if lid in picked]
                 present = [lid for lid in block if lid in picked]
                 if pairs:
@@ -1437,6 +1544,13 @@ class Controller:
                               round=self.global_iteration,
                               cohort=len(learner_ids))
             round_span = self._round_span
+        # performance observatory: periodic jax.profiler capture — when
+        # this round is due, the dispatched tasks carry a profile_dir and
+        # the learners trace one steady-state window each
+        profile_trace_dir = ""
+        if self._profile is not None:
+            profile_trace_dir = self._profile.trace_target(
+                self.global_iteration)
         dispatch_sp = _ttrace.span("round.dispatch", parent=round_span,
                                    attrs={"learners": len(learner_ids)})
         with dispatch_sp, dispatch_sp.activate():
@@ -1448,6 +1562,12 @@ class Controller:
                     params = dataclasses.replace(self.config.train)
                     if record.local_steps_override:
                         params.local_steps = record.local_steps_override
+                    if self._profile is None:
+                        # opt-out contract: the learner's device-stats
+                        # path reduces to this one attribute check
+                        params.device_stats = False
+                    elif profile_trace_dir and not params.profile_dir:
+                        params.profile_dir = profile_trace_dir
                     task = TrainTask(
                         task_id=uuid.uuid4().hex,
                         learner_id=lid,
@@ -1463,6 +1583,14 @@ class Controller:
                     self._task_dispatched_at[task.task_id] = time.time()
                     self._current_meta.train_submitted_at[lid] = time.time()
                     proxy = record.proxy
+                    if self._profile is not None:
+                        # downlink wire bytes attributed per learner (the
+                        # uplink counterpart lives in _handle_completed).
+                        # Under the lock for the same reason as _M_UPLINK:
+                        # leave() prunes the series under it, and an
+                        # unlocked inc could resurrect a departed
+                        # learner's series
+                        self._profile.note_downlink(lid, len(blob))
                 # journaled BEFORE the send: if the send (or an injected
                 # fault) kills the process, the flight recorder still
                 # shows what was dispatched
@@ -1492,6 +1620,11 @@ class Controller:
             if self._wait_span is None and learner_ids:
                 self._wait_span = _ttrace.span("round.wait_uplinks",
                                                parent=round_span)
+        if self._profile is not None:
+            # waterfall boundary: the round's FIRST dispatch end (a
+            # mid-round rejoin re-dispatch lands inside the wait window
+            # and must not move the boundary)
+            self._profile.note_mark("dispatch_end", first=True)
         self._arm_round_deadline(restart=restart_deadline)
 
     def _note_dispatch_failure(self, learner_id: str, exc: Exception,
@@ -1578,6 +1711,17 @@ class Controller:
             try:
                 with eval_sp.activate():
                     record.proxy.evaluate(task, _digest)
+                if self._profile is not None:
+                    # eval broadcasts are downlink wire bytes too — under
+                    # the lock with a membership re-check (same posture
+                    # as _M_UPLINK): leave() prunes the series strictly
+                    # after deleting the record, so attributing only to
+                    # a still-registered learner cannot resurrect a
+                    # pruned series
+                    with self._lock:
+                        if record.learner_id in self._learners:
+                            self._profile.note_downlink(
+                                record.learner_id, len(blob))
             except Exception:
                 logger.exception("eval dispatch to %s failed", record.learner_id)
         eval_sp.end()
@@ -2019,6 +2163,9 @@ class Controller:
         if self._registry is not None:
             # model-lifecycle snapshot (channel heads + version lineage)
             snapshot["registry"] = self._registry.describe()
+        if self._profile is not None:
+            # latest round's cost profile (phase waterfall + wire totals)
+            snapshot["profile"] = self._profile.summary()
         return snapshot
 
     # ------------------------------------------------------------------ #

@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
+from metisfl_tpu.comm import codec as _codec
 from metisfl_tpu.comm.codec import dumps, loads
 from metisfl_tpu.comm.messages import (
     EvalResult,
@@ -23,6 +25,7 @@ from metisfl_tpu.comm.messages import (
 )
 from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
 from metisfl_tpu.controller.core import Controller, LearnerRecord
+from metisfl_tpu.telemetry import profile as _tprofile
 
 logger = logging.getLogger("metisfl_tpu.controller.service")
 
@@ -46,26 +49,47 @@ class RpcLearnerProxy:
     reference's CompletionQueue fan-out, controller.cc:713-759)."""
 
     def __init__(self, record: LearnerRecord, ssl=None, comm=None):
+        # peer=learner_id: the transport attributes this channel's wire
+        # bytes (envelopes included) to the learner — the performance
+        # observatory's rpc_peer_bytes_total series, pruned on leave.
+        # Gated on the ACTIVE collector (set at controller construction,
+        # before any proxy exists): with the profile plane off, no
+        # per-learner attribution series are ever minted — the opt-out
+        # contract — and nothing needs pruning on leave.
+        profiled = _tprofile.collector() is not None
         self._client = RpcClient(record.hostname, record.port, LEARNER_SERVICE,
-                                 ssl=ssl, **_comm_kwargs(comm))
+                                 ssl=ssl,
+                                 peer=record.learner_id if profiled else "",
+                                 **_comm_kwargs(comm))
+
+    @staticmethod
+    def _to_wire_attributed(task) -> bytes:
+        # attributed(): the envelope encode (which embeds the model blob)
+        # lands in the learner's codec_learner_seconds_total series;
+        # profile off → plain encode, no attribution series minted
+        if _tprofile.collector() is None:
+            return task.to_wire()
+        with _codec.attributed(task.learner_id):
+            return task.to_wire()
 
     def run_task(self, task: TrainTask) -> None:
-        self._client.call_async("RunTask", task.to_wire())
+        self._client.call_async("RunTask", self._to_wire_attributed(task))
 
     def run_task_with_callback(self, task: TrainTask, on_error) -> None:
         """Dispatch + failure notification: feeds the controller's learner
         liveness tracking (consecutive failed dispatches)."""
+        payload = self._to_wire_attributed(task)
         # RunTask acks immediately (non-blocking learner dispatch):
         # wait_ready=False surfaces UNAVAILABLE from a dead endpoint at once
         # (liveness counts in seconds, not 60 s deadlines), and the timeout
         # bounds a connected-but-unresponsive peer.
-        self._client.call_async("RunTask", task.to_wire(),
+        self._client.call_async("RunTask", payload,
                                 error_callback=on_error, timeout=60.0,
                                 wait_ready=False)
 
     def evaluate(self, task: EvalTask, callback: Callable[[EvalResult], None]) -> None:
         self._client.call_async(
-            "EvaluateModel", task.to_wire(),
+            "EvaluateModel", self._to_wire_attributed(task),
             callback=lambda raw: callback(EvalResult.from_wire(raw)))
 
     def recover_masks(self, round_id: int, surviving, dropped,
@@ -79,6 +103,12 @@ class RpcLearnerProxy:
              "dropped": list(dropped), "lengths": list(lengths)}),
             timeout=60.0, wait_ready=False)
         return loads(raw)["corrections"]
+
+    def detach_peer(self) -> None:
+        """Stop attributing this channel's bytes to the learner: called
+        on leave, BEFORE the per-peer series are pruned, so an in-flight
+        call's completion callback cannot re-mint them afterwards."""
+        self._client.peer = ""
 
     def shutdown(self) -> None:
         try:
@@ -133,7 +163,21 @@ class ControllerServer:
         return dumps({"ok": ok})
 
     def _mark_completed(self, raw: bytes) -> bytes:
-        ok = self.controller.task_completed(TaskResult.from_wire(raw))
+        if _tprofile.collector() is None:
+            # profile plane off: one attribute check, no timing, no
+            # per-learner attribution series
+            result = TaskResult.from_wire(raw)
+        else:
+            # the decode only reveals WHICH learner the payload belongs
+            # to after it runs — attribute the elapsed time post hoc,
+            # membership-gated under the controller lock: a late
+            # completion racing leave() must not re-mint the series the
+            # prune just dropped (the bounded-cardinality posture)
+            t0 = time.perf_counter()
+            result = TaskResult.from_wire(raw)
+            self.controller.attribute_decode(result.learner_id,
+                                             time.perf_counter() - t0)
+        ok = self.controller.task_completed(result)
         return dumps({"ok": ok})
 
     def _replace_model(self, raw: bytes) -> bytes:

@@ -165,6 +165,11 @@ class Learner:
         # every learner holds the identical frozen base.
         self._ship_regex: str = ""
         self._warned_unfrozen = False
+        # device-utilization capture (telemetry/profile.py DeviceMonitor):
+        # lazily constructed on the first train task whose params carry
+        # device_stats=true — the opted-out hot path is one attribute
+        # check on the TrainParams flag
+        self._device_monitor = None
 
     # ------------------------------------------------------------------ #
     # membership
@@ -596,9 +601,11 @@ class Learner:
                         and parse_topk(params.ship_dtype) is None):
                     resolve_ship_dtype(params.ship_dtype)
             if params.profile_dir:
-                # per-learner trace subdir: same-host learners start traces
-                # within the same second and jax.profiler session dirs are
-                # timestamped + hostname-named, so a shared dir would clobber
+                # per-learner trace subdir: collision-freedom is owned by
+                # the DeviceTracer's unique per-capture session dirs
+                # (telemetry/profile.py — same-second starts used to
+                # clobber each other); the subdir keeps captures
+                # attributable to a learner at a glance
                 import dataclasses as _dc
                 import os as _os
                 params = _dc.replace(
@@ -651,6 +658,10 @@ class Learner:
                 # step baseline — its wall-clock is not compile time
                 _M_TRAIN_STEP_MS.observe(out.ms_per_step)
                 _M_JIT_COMPILE.observe(compile_s)
+            device_stats = {}
+            if (getattr(params, "device_stats", False)
+                    and out.completed_steps > 0 and out.ms_per_step > 0):
+                device_stats = self._capture_device_stats(params, out)
             # training updated the local tensors (e.g. BatchNorm stats):
             # refresh the snapshot evals and later merges read from —
             # under the task lock so _adopt_local_regex's fallback install
@@ -702,6 +713,7 @@ class Learner:
                 train_metrics=out.train_metrics,
                 epoch_metrics=out.epoch_metrics,
                 control_delta=control_delta,
+                device_stats=device_stats,
             )
             self._report_completion(result)
             _M_TASKS.inc(outcome="completed")
@@ -711,6 +723,31 @@ class Learner:
             task_sp.set_attr("outcome", "failed")
             logger.exception("%s: training task %s failed",
                              self.learner_id, task.task_id)
+
+    def _capture_device_stats(self, params, out) -> Dict[str, float]:
+        """Device-utilization snapshot for one train task (performance
+        observatory): step-time EWMA, achieved-MFU estimate from the
+        engine's FLOPs accounting, HBM watermark. Never raises — a
+        telemetry capture must not fail a completed task."""
+        from metisfl_tpu.telemetry import profile as _tprofile
+
+        try:
+            if self._device_monitor is None:
+                self._device_monitor = _tprofile.DeviceMonitor()
+            flops = 0.0
+            # probe through wrappers like the freeze-mask check above
+            # (multi-host LeaderOps has no FLOPs accounting — mfu reads 0)
+            engine = getattr(self.model_ops, "inner", self.model_ops)
+            step_flops = getattr(engine, "step_flops", None)
+            if callable(step_flops):
+                flops = float(step_flops(params.batch_size))
+            return self._device_monitor.observe(
+                steps=out.completed_steps, ms_per_step=out.ms_per_step,
+                flops_per_step=flops)
+        except Exception:  # noqa: BLE001 - telemetry never fails a task
+            logger.exception("%s: device-stats capture failed",
+                             self.learner_id)
+            return {}
 
     def _scaffold_offset(self, control_bytes: bytes):
         """(c, c - c_i) for this task — both params-shaped f32 trees.

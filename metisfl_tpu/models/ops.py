@@ -31,6 +31,7 @@ import optax
 from metisfl_tpu.comm.messages import TrainParams
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.optimizers import make_optimizer
+from metisfl_tpu.telemetry import profile as _tprofile
 
 Pytree = Any
 
@@ -190,6 +191,23 @@ class FlaxModelOps:
             mutable.append("intermediates")
         return self.module.apply(variables, x, rngs=rngs,
                                  mutable=mutable or False, **kwargs)
+
+    # -- cost accounting ---------------------------------------------------
+    def param_count(self) -> int:
+        """Trainable parameter count (``params`` collection leaves)."""
+        if not hasattr(self, "_param_count"):
+            leaves = jax.tree.leaves(self.variables.get("params", {}))
+            self._param_count = int(sum(np.size(l) for l in leaves))
+        return self._param_count
+
+    def step_flops(self, batch_size: int) -> float:
+        """Estimated FLOPs for one optimizer step at ``batch_size``: the
+        dense-layer approximation 6·params·batch (2 forward + 4 backward
+        matmul FLOPs per parameter per example). The MFU numerator for
+        the performance observatory's achieved-utilization gauge —
+        an estimate, like bench.py's analytic ``_lm_step_flops``, not an
+        XLA cost-model readout."""
+        return 6.0 * self.param_count() * max(1, int(batch_size))
 
     # -- weights I/O -------------------------------------------------------
     def get_variables(self) -> Pytree:
@@ -382,95 +400,95 @@ class FlaxModelOps:
                 accs.extend(as_)
                 epoch_losses = []
 
-        traced = False
+        # jax.profiler capture lifecycle for this task: one reusable
+        # handle (telemetry/profile.py) with idempotent, exception-safe
+        # stop and a unique per-capture session dir — replaces the three
+        # start/stop bookkeeping sites this loop used to carry
+        tracer = _tprofile.device_tracer(params_cfg.profile_dir)
         fallback_time: Optional[float] = None
-        if chunk > 1 and total_steps >= chunk:
-            scan_compiled, _ = self._make_scan(params_cfg, chunk)
-            n_chunks = total_steps // chunk
-            profiling = False
-            for chunk_idx in range(n_chunks):
+        try:
+            if chunk > 1 and total_steps >= chunk:
+                scan_compiled, _ = self._make_scan(params_cfg, chunk)
+                n_chunks = total_steps // chunk
+                for chunk_idx in range(n_chunks):
+                    if cancel_event is not None and cancel_event.is_set():
+                        break
+                    # second chunk = first steady-state program execution;
+                    # a single-chunk run has no steady-state chunk to trace
+                    # (the remainder loop below still traces when it runs)
+                    chunk_profiling = (chunk_idx == 1 and tracer.start())
+                    xs, ys = [], []
+                    for _ in range(chunk):
+                        x, y = next(stream)
+                        xs.append(x)
+                        ys.append(y)
+                    xs = place(np.stack(xs), batch_axis=1)
+                    ys = place(np.stack(ys), batch_axis=1)
+                    step_ids = jnp.arange(completed, completed + chunk,
+                                          dtype=jnp.uint32)
+                    t0 = time.perf_counter()
+                    params, batch_stats, opt_state, rng, c_losses, c_accs = (
+                        scan_compiled(params, batch_stats, opt_state,
+                                      global_params, grad_offset, rng,
+                                      step_ids, xs, ys))
+                    c_losses = np.asarray(c_losses)
+                    c_accs = np.asarray(c_accs)   # host sync, once per chunk
+                    if chunk_idx > 0 and not chunk_profiling:
+                        step_times.extend(
+                            [(time.perf_counter() - t0) / chunk] * chunk)
+                    elif n_chunks == 1 or chunk_profiling:
+                        # compile- or profiler-contaminated; used only if no
+                        # clean sample lands anywhere in the run
+                        fallback_time = (time.perf_counter() - t0) / chunk
+                    if chunk_profiling:
+                        tracer.stop()
+                    for loss, acc in zip(c_losses, c_accs):
+                        completed += 1
+                        epoch_losses.append((loss, acc))
+                        _flush_epoch()
+                remaining = (total_steps - completed
+                             if not (cancel_event is not None
+                                     and cancel_event.is_set()) else 0)
+            else:
+                remaining = total_steps
+
+            # per-step path: the whole run (chunk == 1), the scan remainder
+            # (total_steps % chunk), or the whole run again when
+            # total_steps < chunk made the scan path skip itself
+            profile_from = completed + (1 if remaining > 1 else 0)
+            profile_until = profile_from + max(1, params_cfg.profile_steps)
+            per_step_runs = 0
+            for _ in range(remaining):
                 if cancel_event is not None and cancel_event.is_set():
                     break
-                # second chunk = first steady-state program execution; a
-                # single-chunk run has no steady-state chunk to trace (the
-                # remainder loop below still traces when it runs)
-                if params_cfg.profile_dir and chunk_idx == 1:
-                    jax.profiler.start_trace(params_cfg.profile_dir)
-                    profiling = traced = True
-                xs, ys = [], []
-                for _ in range(chunk):
-                    x, y = next(stream)
-                    xs.append(x)
-                    ys.append(y)
-                xs = place(np.stack(xs), batch_axis=1)
-                ys = place(np.stack(ys), batch_axis=1)
-                step_ids = jnp.arange(completed, completed + chunk,
-                                      dtype=jnp.uint32)
+                if completed == profile_from:
+                    tracer.start()  # no-op when already captured or inert
+                x, y = next(stream)
+                rng = jax.random.fold_in(rng, completed)
                 t0 = time.perf_counter()
-                params, batch_stats, opt_state, rng, c_losses, c_accs = (
-                    scan_compiled(params, batch_stats, opt_state,
-                                  global_params, grad_offset, rng, step_ids,
-                                  xs, ys))
-                c_losses = np.asarray(c_losses)
-                c_accs = np.asarray(c_accs)       # host sync, once per chunk
-                if chunk_idx > 0 and not profiling:
-                    step_times.extend([(time.perf_counter() - t0) / chunk]
-                                      * chunk)
-                elif n_chunks == 1 or profiling:
-                    # compile- or profiler-contaminated; used only if no
-                    # clean sample lands anywhere in the run
-                    fallback_time = (time.perf_counter() - t0) / chunk
-                if profiling:
-                    jax.profiler.stop_trace()
-                    profiling = False
-                for loss, acc in zip(c_losses, c_accs):
-                    completed += 1
-                    epoch_losses.append((loss, acc))
-                    _flush_epoch()
-            remaining = (total_steps - completed
-                         if not (cancel_event is not None
-                                 and cancel_event.is_set()) else 0)
-        else:
-            remaining = total_steps
+                params, batch_stats, opt_state, loss, acc = compiled(
+                    params, batch_stats, opt_state, global_params,
+                    grad_offset, place(x), place(y), rng)
+                per_step_runs += 1
+                if per_step_runs > 1 or (remaining == 1 and not step_times):
+                    # the per-step program's first execution pays its jit
+                    # compile — keep it out of steady-state timing (unless
+                    # it would be the only sample in the whole run)
+                    jax.block_until_ready(loss)
+                    step_times.append(time.perf_counter() - t0)
+                if tracer.active and completed + 1 >= profile_until:
+                    jax.block_until_ready(loss)
+                    tracer.stop()
+                completed += 1
+                epoch_losses.append((loss, acc))
+                _flush_epoch()
 
-        # per-step path: the whole run (chunk == 1), the scan remainder
-        # (total_steps % chunk), or the whole run again when total_steps <
-        # chunk made the scan path skip itself
-        profile_from = completed + (1 if remaining > 1 else 0)
-        profile_until = profile_from + max(1, params_cfg.profile_steps)
-        profiling = False
-        per_step_runs = 0
-        for _ in range(remaining):
-            if cancel_event is not None and cancel_event.is_set():
-                break
-            if (params_cfg.profile_dir and not profiling and not traced
-                    and completed == profile_from):
-                jax.profiler.start_trace(params_cfg.profile_dir)
-                profiling = True
-            x, y = next(stream)
-            rng = jax.random.fold_in(rng, completed)
-            t0 = time.perf_counter()
-            params, batch_stats, opt_state, loss, acc = compiled(
-                params, batch_stats, opt_state, global_params, grad_offset,
-                place(x), place(y), rng)
-            per_step_runs += 1
-            if per_step_runs > 1 or (remaining == 1 and not step_times):
-                # the per-step program's first execution pays its jit
-                # compile — keep it out of steady-state timing (unless it
-                # would be the only sample in the whole run)
+            if tracer.active:
                 jax.block_until_ready(loss)
-                step_times.append(time.perf_counter() - t0)
-            if profiling and completed + 1 >= profile_until:
-                jax.block_until_ready(loss)
-                jax.profiler.stop_trace()
-                profiling = False
-            completed += 1
-            epoch_losses.append((loss, acc))
-            _flush_epoch()
-
-        if profiling:
-            jax.block_until_ready(loss)
-            jax.profiler.stop_trace()
+        finally:
+            # exception-safe: a trace left open would wedge the NEXT
+            # task's capture and leak the profiler session
+            tracer.stop()
 
         _flush_epoch(force=True)
 

@@ -1,0 +1,577 @@
+"""Offline performance analyzer: ``python -m metisfl_tpu.perf``.
+
+The reading half of the performance observatory (telemetry/profile.py):
+
+- **run-dir mode** — render the per-round phase waterfall from the
+  RoundProfiles a run recorded (``profiles-*.jsonl`` next to the traces,
+  or ``experiment.json`` round metadata), plus the top-N span self-time
+  table from ``traces.jsonl`` when present::
+
+      python -m metisfl_tpu.perf <workdir>
+      python -m metisfl_tpu.perf experiment.json --round 3 --top 10
+
+- **--compare A.json B.json** — diff two bench captures key-by-key with
+  direction-aware relative-threshold regression flags and a CI-friendly
+  exit code (1 = regression detected, 0 = clean)::
+
+      python -m metisfl_tpu.perf --compare BENCH_r04.json BENCH_r05.json
+
+- **--trajectory <dir-or-files>** — the same diff across a whole series
+  of captures (consecutive pairs), e.g. the repo's ``BENCH_r0*.json``
+  driver captures. Degraded captures parse via the single-line
+  ``METISFL_BENCH`` marker bench.py appends (and older full-JSON tail
+  lines); unparseable ones are reported and skipped, never fatal.
+
+Library-usable: :func:`load_profiles`, :func:`render_waterfall`,
+:func:`span_self_times`, :func:`load_bench_capture`,
+:func:`compare_captures`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# bench.py stamps this on every result and prefixes the final marker
+# line with it — the trajectory parser's anchor on degraded runs whose
+# main JSON line was truncated by the capture harness
+BENCH_MARKER = "METISFL_BENCH "
+
+# default relative-change threshold for regression flags (20% — well
+# under the 30% regressions the acceptance gate injects, well over
+# normal run-to-run jitter for the judged keys)
+DEFAULT_THRESHOLD = 0.2
+
+
+# --------------------------------------------------------------------- #
+# round-profile loading + waterfall rendering
+# --------------------------------------------------------------------- #
+
+def load_profiles(path: str) -> List[dict]:
+    """RoundProfile dicts from a run artifact: a ``profiles-*.jsonl``
+    sink file, an ``experiment.json`` (round metadata ``profile`` keys),
+    or a run directory holding either (``telemetry/`` searched too)."""
+    if os.path.isdir(path):
+        candidates = (
+            sorted(glob.glob(os.path.join(path, "profiles-*.jsonl")))
+            + sorted(glob.glob(
+                os.path.join(path, "telemetry", "profiles-*.jsonl"))))
+        profiles: List[dict] = []
+        for name in candidates:
+            profiles.extend(_load_profile_jsonl(name))
+        if profiles:
+            profiles.sort(key=lambda p: p.get("round", 0))
+            return profiles
+        exp = os.path.join(path, "experiment.json")
+        if os.path.exists(exp):
+            return load_profiles(exp)
+        return []
+    if path.endswith(".jsonl"):
+        return _load_profile_jsonl(path)
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        # missing/torn experiment.json: report-and-skip like every other
+        # loader here — the CLI's exit codes, not a traceback, are the
+        # contract
+        print(f"cannot read round profiles from {path}: {exc}",
+              file=sys.stderr)
+        return []
+    if not isinstance(data, dict):
+        return []
+    return [meta["profile"] for meta in data.get("round_metadata", [])
+            if meta.get("profile")]
+
+
+def _load_profile_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a crashed process
+                if isinstance(record, dict) and "phases" in record:
+                    out.append(record)
+    except OSError:
+        pass
+    return out
+
+
+def _fmt_ms(ms: float) -> str:
+    return f"{ms / 1e3:.2f}s" if ms >= 1e3 else f"{ms:.1f}ms"
+
+
+def _fmt_bytes(n: float) -> str:
+    if n >= 1e6:
+        return f"{n / 1e6:.2f}MB"
+    if n >= 1e3:
+        return f"{n / 1e3:.1f}KB"
+    return f"{int(n)}B"
+
+
+def render_waterfall(profiles: List[dict], width: int = 40,
+                     want_round: Optional[int] = None) -> str:
+    """The phase waterfall (one bar block per round) plus the per-learner
+    attribution table for each profiled round."""
+    lines: List[str] = []
+    for prof in profiles:
+        round_no = prof.get("round", 0)
+        if want_round is not None and round_no != want_round:
+            continue
+        wall = float(prof.get("wall_ms", 0.0))
+        lines.append(
+            f"round {round_no}  wall {_fmt_ms(wall)}  coverage "
+            f"{float(prof.get('coverage', 0.0)) * 100:.0f}%"
+            + ("  [jax trace armed]" if prof.get("trace_armed") else ""))
+        phases = prof.get("phases") or {}
+        longest = max((float(v) for v in phases.values()), default=0.0)
+        for name in ("dispatch", "wait_uplinks", "select", "aggregate",
+                     "close"):
+            if name not in phases:
+                continue
+            ms = float(phases[name])
+            bar = "#" * (int(round(width * ms / longest))
+                         if longest > 0 else 0)
+            share = (ms / wall * 100) if wall > 0 else 0.0
+            lines.append(f"  {name:<13} {_fmt_ms(ms):>9} {share:5.1f}%  "
+                         f"{bar}")
+        store = prof.get("store") or {}
+        if store:
+            lines.append(
+                f"  store: insert {_fmt_ms(float(store.get('insert_ms', 0.0)))}"
+                f" (overlaps wait), select "
+                f"{_fmt_ms(float(store.get('select_ms', 0.0)))}")
+        serving = prof.get("serving") or {}
+        if serving:
+            lines.append(f"  serving: queue_depth="
+                         f"{serving.get('queue_depth', 0)}")
+        learners = prof.get("learners") or {}
+        if learners:
+            lines.append(f"  {'learner':<24} {'uplink':>9} {'downlink':>9} "
+                         f"{'codec':>8} {'insert':>8} {'step_ms':>8} "
+                         f"{'mfu':>6} {'hbm':>9}")
+            for lid in sorted(learners):
+                entry = learners[lid]
+                codec_s = (float(entry.get("codec_encode_s", 0.0))
+                           + float(entry.get("codec_decode_s", 0.0)))
+                device = entry.get("device") or {}
+                mfu = float(device.get("mfu", 0.0))
+                step = float(device.get("step_ms_ewma", 0.0))
+                hbm = float(device.get("hbm_peak_bytes", 0))
+                lines.append(
+                    f"  {lid:<24} "
+                    f"{_fmt_bytes(entry.get('uplink_bytes', 0)):>9} "
+                    f"{_fmt_bytes(entry.get('downlink_bytes', 0)):>9} "
+                    f"{(_fmt_ms(codec_s * 1e3) if codec_s else '-'):>8} "
+                    f"{(_fmt_ms(float(entry.get('insert_ms', 0.0))) if entry.get('insert_ms') else '-'):>8} "
+                    f"{(f'{step:.2f}' if step else '-'):>8} "
+                    f"{(f'{mfu:.3f}' if mfu else '-'):>6} "
+                    f"{(_fmt_bytes(hbm) if hbm else '-'):>9}")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+# --------------------------------------------------------------------- #
+# span self-time table (from the trace sink)
+# --------------------------------------------------------------------- #
+
+def span_self_times(spans: List[dict]) -> List[Dict[str, Any]]:
+    """Aggregate self time (own duration minus direct children) by span
+    name across a trace dump — the 'where does time actually go' table a
+    stitched tree hides in its leaves. Children whose parent never
+    landed in the sink count as roots (their time still aggregates)."""
+    by_id = {s.get("span"): s for s in spans if s.get("span")}
+    child_ms: Dict[str, float] = {}
+    for s in spans:
+        parent = s.get("parent", "")
+        if parent and parent in by_id:
+            child_ms[parent] = (child_ms.get(parent, 0.0)
+                                + float(s.get("dur_ms", 0.0)))
+    agg: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        name = s.get("name", "?")
+        dur = float(s.get("dur_ms", 0.0))
+        # clamp: async children (eval digests) can outlive their parent
+        self_ms = max(0.0, dur - child_ms.get(s.get("span", ""), 0.0))
+        row = agg.setdefault(name, {"count": 0, "self_ms": 0.0,
+                                    "total_ms": 0.0})
+        row["count"] += 1
+        row["self_ms"] += self_ms
+        row["total_ms"] += dur
+    rows = [{"name": name, **vals} for name, vals in agg.items()]
+    rows.sort(key=lambda r: -r["self_ms"])
+    return rows
+
+
+def render_self_times(rows: List[Dict[str, Any]], top: int = 15) -> str:
+    lines = [f"{'span':<28} {'count':>6} {'self':>10} {'total':>10}"]
+    for row in rows[:top]:
+        lines.append(f"{row['name']:<28} {row['count']:>6} "
+                     f"{_fmt_ms(row['self_ms']):>10} "
+                     f"{_fmt_ms(row['total_ms']):>10}")
+    return "\n".join(lines)
+
+
+def _load_trace_spans(path: str) -> List[dict]:
+    """Spans from a run dir (traces.jsonl / telemetry/*.jsonl) — reuses
+    the trace viewer's tolerant loader."""
+    from metisfl_tpu.telemetry.__main__ import load_spans
+
+    candidates = []
+    if os.path.isdir(path):
+        for name in ("traces.jsonl",):
+            full = os.path.join(path, name)
+            if os.path.exists(full):
+                candidates.append(full)
+        tel = os.path.join(path, "telemetry")
+        if os.path.isdir(tel):
+            candidates.append(tel)
+    elif path.endswith(".jsonl"):
+        candidates.append(path)
+    if not candidates:
+        return []
+    try:
+        spans = load_spans(candidates)
+    except OSError:
+        return []
+    # profile sink lines also live under telemetry/ and parse as dicts
+    # without a "span" key — load_spans already filters them out
+    return spans
+
+
+# --------------------------------------------------------------------- #
+# bench-capture loading (raw results, driver captures, degraded tails)
+# --------------------------------------------------------------------- #
+
+def load_bench_capture(path: str) -> Dict[str, Any]:
+    """One bench capture as a flat ``{key: float}`` dict, from any of the
+    shapes this repo records:
+
+    - a raw ``bench.py`` result line saved as JSON;
+    - a driver capture ``{"n", "cmd", "rc", "tail", "parsed"}`` —
+      ``parsed`` when present, else the tail scanned for the
+      ``METISFL_BENCH`` marker line or a full result JSON line;
+    - a watcher/partial capture ``{"details": {...}}``.
+
+    Returns ``{}`` when nothing parseable is found (reported by the
+    caller, never fatal)."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    if "metric" in data or "value" in data:
+        return flatten_bench(data)
+    if "parsed" in data or "tail" in data:
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict) and parsed:
+            return flatten_bench(parsed)
+        return _parse_capture_tail(str(data.get("tail") or ""))
+    if "details" in data:
+        return flatten_bench(data)
+    return {}
+
+
+def _parse_capture_tail(tail: str) -> Dict[str, Any]:
+    """Recover a result from a captured stdout tail: the final
+    ``METISFL_BENCH`` marker wins (it is small, so it survives
+    head-truncation of the capture window); else the last line that
+    parses as a full result JSON."""
+    marker: Optional[dict] = None
+    full: Optional[dict] = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith(BENCH_MARKER):
+            try:
+                candidate = json.loads(line[len(BENCH_MARKER):])
+                if isinstance(candidate, dict):
+                    marker = candidate
+            except json.JSONDecodeError:
+                continue
+        elif line.startswith("{"):
+            try:
+                candidate = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(candidate, dict) and ("metric" in candidate
+                                                or "details" in candidate):
+                full = candidate
+    if full is not None:
+        flat = flatten_bench(full)
+        if marker is not None:
+            flat.setdefault("schema_version",
+                            marker.get("schema_version", 0))
+        return flat
+    if marker is not None:
+        return flatten_bench(marker)
+    return {}
+
+
+_EXCLUDE_KEYS = {
+    # harness bookkeeping, timestamps, and identity keys — never judged
+    "n", "rc", "ts", "schema_version", "errors", "last_dead_ts",
+    "probe_attempts", "recover_probes", "devices", "cpu_retry",
+    "degraded_to_cpu", "post_loop_recovery", "bench_wall_s",
+}
+
+
+def flatten_bench(capture: Dict[str, Any]) -> Dict[str, Any]:
+    """Numeric keys from a bench result: top-level value/vs_baseline/mfu
+    plus every numeric ``details`` entry, excluding harness bookkeeping."""
+    flat: Dict[str, Any] = {}
+
+    def _take(key: str, value: Any) -> None:
+        if key in _EXCLUDE_KEYS or isinstance(value, bool):
+            return
+        if isinstance(value, (int, float)):
+            flat[key] = float(value)
+
+    for key in ("value", "vs_baseline", "mfu"):
+        if key in capture:
+            _take(key, capture[key])
+    for key, value in (capture.get("details") or {}).items():
+        _take(key, value)
+    # marker-shaped captures carry their numerics at the top level
+    if "details" not in capture:
+        for key, value in capture.items():
+            _take(key, value)
+    return flat
+
+
+# --------------------------------------------------------------------- #
+# direction-aware comparison
+# --------------------------------------------------------------------- #
+
+# substrings that classify a key's improvement direction. Higher-better
+# patterns are checked FIRST: throughput keys like samples_per_sec would
+# otherwise match the lower-better "_s"/"secs" time patterns.
+_HIGHER_BETTER = ("mfu", "per_sec", "tokens_per", "samples_per",
+                  "throughput", "vs_baseline", "hit_rate", "tflops",
+                  "rows_per", "speedup")
+_LOWER_BETTER = ("_ms", "ms_per", "_secs", "seconds", "_bytes", "_mb",
+                 "_kb", "rss", "wall", "latency", "pause")
+
+
+def metric_direction(key: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = don't judge."""
+    k = key.lower()
+    if k == "value":
+        # the headline bench value is aggregation ms/round
+        return -1
+    for pat in _HIGHER_BETTER:
+        if pat in k:
+            return 1
+    for pat in _LOWER_BETTER:
+        if pat in k:
+            return -1
+    if k.endswith("_s") or "_s_" in k or k.endswith("_insert_s"):
+        return -1
+    return 0
+
+
+def compare_captures(a: Dict[str, Any], b: Dict[str, Any],
+                     threshold: float = DEFAULT_THRESHOLD
+                     ) -> List[Dict[str, Any]]:
+    """Key-by-key relative diff of two flattened captures: one row per
+    shared judgeable key, ``regressed=True`` where B is worse than A by
+    more than ``threshold`` (relative, direction-aware)."""
+    rows: List[Dict[str, Any]] = []
+    for key in sorted(set(a) & set(b)):
+        direction = metric_direction(key)
+        if direction == 0:
+            continue
+        va, vb = float(a[key]), float(b[key])
+        if va <= 0.0:
+            continue  # no baseline to be relative to
+        if vb <= 0.0 and direction < 0:
+            # a lower-better metric at 0 means the subsystem recorded
+            # nothing (errored/skipped section, zero-filled degraded
+            # capture), not an infinite speedup — don't judge it.
+            # Higher-better keys keep judging: throughput collapsing to
+            # 0 IS the regression.
+            continue
+        rel = (vb - va) / abs(va)
+        regressed = (rel > threshold if direction < 0
+                     else rel < -threshold)
+        improved = (rel < -threshold if direction < 0
+                    else rel > threshold)
+        rows.append({"key": key, "a": va, "b": vb, "rel": rel,
+                     "direction": direction, "regressed": regressed,
+                     "improved": improved})
+    return rows
+
+
+def capture_collapsed(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    """True when capture B's headline collapsed while A had one: the
+    later run recorded value<=0 (bench.py's *_failed shape zero-fills
+    it) or lost the key entirely. Per-key comparison deliberately skips
+    lower-better zeros — this capture-level check is what keeps a bench
+    that stopped producing results at all from passing the CI gate."""
+    va = a.get("value")
+    if va is None or va <= 0.0:
+        return False  # no healthy baseline to collapse from
+    vb = b.get("value")
+    return vb is None or vb <= 0.0
+
+
+def render_comparison(rows: List[Dict[str, Any]],
+                      label_a: str = "A", label_b: str = "B",
+                      show_all: bool = False) -> str:
+    lines = [f"{'key':<36} {label_a:>12} {label_b:>12} {'change':>9}"]
+    for row in rows:
+        if not (show_all or row["regressed"] or row["improved"]):
+            continue
+        tag = ("  REGRESSED" if row["regressed"]
+               else "  improved" if row["improved"] else "")
+        lines.append(f"{row['key']:<36} {row['a']:>12.4g} "
+                     f"{row['b']:>12.4g} {row['rel'] * 100:>+8.1f}%{tag}")
+    if len(lines) == 1:
+        lines.append("(no judgeable shared keys moved past the threshold)")
+    return "\n".join(lines)
+
+
+def _trajectory_paths(args: List[str]) -> List[str]:
+    paths: List[str] = []
+    for arg in args:
+        if os.path.isdir(arg):
+            paths.extend(sorted(glob.glob(os.path.join(arg, "*.json"))))
+        else:
+            paths.append(arg)
+    return paths
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "metisfl_tpu.perf",
+        description="performance observatory analyzer: round-profile "
+                    "waterfalls, span self-times, bench regression diffs")
+    parser.add_argument("paths", nargs="*",
+                        help="run dir / profiles .jsonl / experiment.json "
+                             "(default mode), or capture files for "
+                             "--compare/--trajectory")
+    parser.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                        help="diff two bench captures; exit 1 on regression")
+    parser.add_argument("--trajectory", nargs="+", metavar="PATH",
+                        help="diff a series of bench captures pairwise "
+                             "(files and/or dirs of .json); exit 1 on "
+                             "regression")
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_THRESHOLD,
+                        help="relative regression threshold "
+                             f"(default {DEFAULT_THRESHOLD})")
+    parser.add_argument("--round", type=int, default=None,
+                        help="waterfall: only this round")
+    parser.add_argument("--top", type=int, default=15,
+                        help="span self-time rows to show")
+    parser.add_argument("--all", action="store_true",
+                        help="comparison: show unchanged keys too")
+    args = parser.parse_args(argv)
+
+    if args.compare:
+        return _compare_main(args.compare[0], args.compare[1],
+                             args.threshold, args.all)
+    if args.trajectory:
+        return _trajectory_main(_trajectory_paths(args.trajectory),
+                                args.threshold)
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+    return _waterfall_main(args.paths, args.round, args.top)
+
+
+def _compare_main(path_a: str, path_b: str, threshold: float,
+                  show_all: bool) -> int:
+    a, b = load_bench_capture(path_a), load_bench_capture(path_b)
+    for path, flat in ((path_a, a), (path_b, b)):
+        if not flat:
+            print(f"cannot parse a bench result from {path}",
+                  file=sys.stderr)
+            return 2
+    rows = compare_captures(a, b, threshold=threshold)
+    print(render_comparison(rows, label_a=os.path.basename(path_a),
+                            label_b=os.path.basename(path_b),
+                            show_all=show_all))
+    regressions = [r for r in rows if r["regressed"]]
+    if capture_collapsed(a, b):
+        print(f"REGRESSED: {os.path.basename(path_b)} headline value "
+              f"collapsed to {b.get('value', 'absent')} (failed/degraded "
+              f"run)", file=sys.stderr)
+        return 1
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) past "
+              f"{threshold * 100:.0f}% threshold", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _trajectory_main(paths: List[str], threshold: float) -> int:
+    captures: List[Tuple[str, Dict[str, Any]]] = []
+    for path in paths:
+        flat = load_bench_capture(path)
+        if flat:
+            captures.append((os.path.basename(path), flat))
+        else:
+            print(f"skipping unparseable capture {path}", file=sys.stderr)
+    if len(captures) < 2:
+        print("need at least two parseable captures for a trajectory",
+              file=sys.stderr)
+        return 2
+    any_regression = False
+    for (name_a, a), (name_b, b) in zip(captures, captures[1:]):
+        rows = compare_captures(a, b, threshold=threshold)
+        regressions = [r for r in rows if r["regressed"]]
+        improvements = [r for r in rows if r["improved"]]
+        print(f"{name_a} -> {name_b}: {len(regressions)} regression(s), "
+              f"{len(improvements)} improvement(s) over "
+              f"{len(rows)} judged key(s)")
+        for row in regressions:
+            print(f"  REGRESSED {row['key']}: {row['a']:.4g} -> "
+                  f"{row['b']:.4g} ({row['rel'] * 100:+.1f}%)")
+        if capture_collapsed(a, b):
+            print(f"  REGRESSED {name_b}: headline value collapsed to "
+                  f"{b.get('value', 'absent')} (failed/degraded run)")
+            regressions.append({"key": "value"})
+        any_regression = any_regression or bool(regressions)
+    return 1 if any_regression else 0
+
+
+def _waterfall_main(paths: List[str], want_round: Optional[int],
+                    top: int) -> int:
+    profiles: List[dict] = []
+    spans: List[dict] = []
+    for path in paths:
+        profiles.extend(load_profiles(path))
+        spans.extend(_load_trace_spans(path))
+    if not profiles and not spans:
+        print("no round profiles or trace spans found (is the "
+              "performance observatory enabled and the run dir right?)",
+              file=sys.stderr)
+        return 2  # unusable input, same code as the compare modes
+    if profiles:
+        print(render_waterfall(profiles, want_round=want_round))
+    if spans:
+        if profiles:
+            print()
+        print(f"top span self-times ({len(spans)} spans):")
+        print(render_self_times(span_self_times(spans), top=top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

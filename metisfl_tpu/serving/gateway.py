@@ -36,6 +36,7 @@ from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.registry import CHANNEL_CANDIDATE, CHANNEL_STABLE
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import profile as _tprofile
 from metisfl_tpu.tensor.pytree import (
     ModelBlob,
     named_tensors_to_pytree,
@@ -60,6 +61,11 @@ _M_VERSION = _REG.gauge(
     "Registry version currently installed per channel", ("channel",))
 _M_SWAPS = _REG.counter(
     _tel.M_SERVING_SWAPS_TOTAL, "Hot-swaps by channel", ("channel",))
+_M_QUEUE_DEPTH = _REG.gauge(
+    _tel.M_SERVING_QUEUE_DEPTH,
+    "Requests currently queued per micro-batcher channel — the occupancy "
+    "signal the round cost profile and fleet scale-out key on (series "
+    "removed when the channel's batcher closes)", ("channel",))
 
 
 def canary_channel(key: str, canary_percent: float) -> str:
@@ -100,6 +106,7 @@ class MicroBatcher:
                  max_batch: int = 8, max_wait_ms: float = 5.0,
                  name: str = "batcher"):
         self._run_batch = run_batch
+        self.name = name
         self.max_batch = max(1, int(max_batch))
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self._queue: List[_Pending] = []
@@ -123,8 +130,15 @@ class MicroBatcher:
                     RuntimeError("batcher closed"))
                 return pending.future
             self._queue.append(pending)
+            _M_QUEUE_DEPTH.set(len(self._queue), channel=self.name)
             self._cv.notify()
         return pending.future
+
+    def depth(self) -> int:
+        """Requests currently queued (the occupancy probe the round cost
+        profile samples)."""
+        with self._cv:
+            return len(self._queue)
 
     def _gather(self) -> List[_Pending]:
         """Wait for work, then coalesce until the bucket is full or the
@@ -149,6 +163,7 @@ class MicroBatcher:
                 item = self._queue.pop(0)
                 rows += len(item.rows)
                 batch.append(item)
+            _M_QUEUE_DEPTH.set(len(self._queue), channel=self.name)
             return batch
 
     def _loop(self) -> None:
@@ -202,6 +217,9 @@ class MicroBatcher:
             self._closed = True
             self._cv.notify_all()
         self._worker.join(timeout=30.0)
+        # bounded cardinality: an uninstalled channel's depth series must
+        # not linger in the exposition at its last value
+        _M_QUEUE_DEPTH.remove(channel=self.name)
 
 
 # --------------------------------------------------------------------- #
@@ -258,6 +276,14 @@ class ServingGateway:
         self._sync_stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._last_sync_error = ""
+        # In-process deployments (gateway sharing the controller's
+        # process, the test/InProcessFederation shape): register the
+        # queue probe with the active profile collector so RoundProfiles
+        # carry serving pressure next to training cost. The driver's
+        # subprocess gateway has no collector in its process — no-op.
+        coll = _tprofile.collector()
+        if coll is not None and coll.serving_probe is None:
+            coll.serving_probe = self.queue_snapshot
 
     # -- model install / hot-swap ------------------------------------- #
 
@@ -452,7 +478,22 @@ class ServingGateway:
             "last_sync_error": self._last_sync_error,
         }
 
+    def queue_snapshot(self) -> Dict[str, Any]:
+        """Micro-batch queue occupancy (per channel + total) — wired as
+        the profile collector's ``serving_probe`` in in-process
+        deployments so RoundProfiles carry serving pressure next to
+        training cost."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        depths = {ch: b.depth() for ch, b in batchers.items()}
+        return {"queue_depth": sum(depths.values()),
+                "queue_depth_by_channel": depths,
+                "max_batch": int(self.config.max_batch)}
+
     def shutdown(self) -> None:
+        coll = _tprofile.collector()
+        if coll is not None and coll.serving_probe == self.queue_snapshot:
+            coll.serving_probe = None
         self._sync_stop.set()
         if self._sync_thread is not None:
             self._sync_thread.join(timeout=10.0)

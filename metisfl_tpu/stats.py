@@ -110,6 +110,22 @@ def summarize(stats: Dict[str, Any]) -> str:
                     f"max={row['max_s']:.2f}s rel={row['rel']:.2f}x "
                     f"over {row['rounds']} round(s)")
 
+        profiles = profile_summary(stats)
+        if profiles:
+            lines.append("")
+            lines.append("per-round cost profile (phase share of wall-clock; "
+                         "python -m metisfl_tpu.perf renders the full "
+                         "waterfall):")
+            for row in profiles:
+                shares = " ".join(
+                    f"{name}={share * 100:.0f}%"
+                    for name, share in row["shares"])
+                lines.append(
+                    f"  round {row['round']:>3}: {shares} "
+                    f"coverage={row['coverage'] * 100:.0f}% "
+                    f"up={row['uplink_bytes'] / 1e6:.2f}MB "
+                    f"down={row['downlink_bytes'] / 1e6:.2f}MB")
+
         health = learning_health_summary(stats)
         if health:
             lines.append("")
@@ -232,6 +248,36 @@ def learning_health_summary(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
         r["mean_update_norm"] = (sum(norms) / len(norms)) if norms else 0.0
         rows.append(r)
     rows.sort(key=lambda r: -r["last_div"])
+    return rows
+
+
+def profile_summary(stats: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Post-hoc per-round cost-profile rows from round metadata (the
+    ``profile`` dicts the performance observatory records): phase shares
+    of round wall-clock (largest first), waterfall coverage, and the
+    round's wire-byte totals. Empty for pre-profile payloads (backward
+    compatible)."""
+    rows: List[Dict[str, Any]] = []
+    for meta in stats.get("round_metadata", []):
+        prof = meta.get("profile") or {}
+        if not prof:
+            continue
+        wall = float(prof.get("wall_ms", 0.0))
+        phases = prof.get("phases") or {}
+        shares = sorted(
+            ((name, (float(ms) / wall) if wall > 0 else 0.0)
+             for name, ms in phases.items()),
+            key=lambda kv: -kv[1])
+        totals = prof.get("totals") or {}
+        rows.append({
+            "round": int(prof.get("round",
+                                  meta.get("global_iteration", 0))),
+            "wall_ms": wall,
+            "shares": shares,
+            "coverage": float(prof.get("coverage", 0.0)),
+            "uplink_bytes": float(totals.get("uplink_bytes", 0.0)),
+            "downlink_bytes": float(totals.get("downlink_bytes", 0.0)),
+        })
     return rows
 
 

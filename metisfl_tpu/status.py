@@ -81,6 +81,22 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
             f"registry: stable={f'v{stable}' if stable else '-'}  "
             f"candidate={f'v{cand}' if cand else '-'}  "
             f"versions={len(versions)}{gate_cell}")
+    prof = snap.get("profile") or {}
+    if prof.get("enabled") and prof.get("rounds_profiled"):
+        # performance-observatory line (telemetry/profile.py): the latest
+        # round's cost waterfall in one glance; pre-profile controllers
+        # ship no "profile" key and render as before
+        phases = prof.get("phases") or {}
+        top = max(phases, key=phases.get) if phases else "-"
+        wall = float(prof.get("wall_ms", 0.0))
+        lines.append(
+            f"perf: round={prof.get('last_round', '?')}  "
+            f"wall={_fmt_s(wall / 1e3)}  "
+            f"coverage={float(prof.get('coverage', 0.0)) * 100:.0f}%  "
+            f"top_phase={top}"
+            + (f" ({phases.get(top, 0.0) / 1e3:.2f}s)" if phases else "")
+            + f"  up={float(prof.get('uplink_bytes', 0.0)) / 1e6:.2f}MB"
+            f"  down={float(prof.get('downlink_bytes', 0.0)) / 1e6:.2f}MB")
     has_div = any("divergence_score" in l for l in learners)
     if learners:
         lines.append("")

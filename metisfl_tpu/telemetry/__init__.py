@@ -70,6 +70,12 @@ M_LEARNER_STRAGGLER_SCORE = "learner_straggler_score"
 # learning-health plane (controller/core.py + telemetry/health.py)
 M_LEARNER_DIVERGENCE_SCORE = "learner_divergence_score"
 M_ROUND_UPDATE_NORM = "round_update_norm"
+# performance observatory (telemetry/profile.py + controller/core.py)
+M_DOWNLINK_BYTES_TOTAL = "downlink_bytes_total"
+M_CODEC_LEARNER_SECONDS = "codec_learner_seconds_total"
+M_LEARNER_ACHIEVED_MFU = "learner_achieved_mfu"
+M_LEARNER_STEP_MS_EWMA = "learner_step_ms_ewma"
+M_LEARNER_HBM_PEAK_BYTES = "learner_hbm_peak_bytes"
 # learner runtime (learner/learner.py)
 M_LEARNER_TRAIN_DURATION_SECONDS = "learner_train_duration_seconds"
 M_LEARNER_STEP_MILLISECONDS = "learner_step_milliseconds"
@@ -78,6 +84,7 @@ M_LEARNER_TASKS_TOTAL = "learner_tasks_total"
 M_LEARNER_EVAL_DURATION_SECONDS = "learner_eval_duration_seconds"
 M_LEARNER_REATTACH_TOTAL = "learner_reattach_total"
 # RPC transport (comm/rpc.py)
+M_RPC_PEER_BYTES_TOTAL = "rpc_peer_bytes_total"
 M_RPC_CLIENT_CALLS_TOTAL = "rpc_client_calls_total"
 M_RPC_CLIENT_LATENCY_SECONDS = "rpc_client_latency_seconds"
 M_RPC_CLIENT_BYTES_TOTAL = "rpc_client_bytes_total"
@@ -112,6 +119,7 @@ M_SERVING_REQUEST_LATENCY_SECONDS = "serving_request_latency_seconds"
 M_SERVING_BATCH_ROWS = "serving_batch_rows"
 M_SERVING_MODEL_VERSION = "serving_model_version"
 M_SERVING_SWAPS_TOTAL = "serving_swaps_total"
+M_SERVING_QUEUE_DEPTH = "serving_queue_depth"
 
 __all__ = [
     "metrics",
@@ -163,3 +171,11 @@ def apply_config(telemetry_config, service: str = "",
     if enabled and pm_dir:
         postmortem.configure(pm_dir, service=service,
                              config_hash=config_hash)
+
+
+# Imported at the BOTTOM so profile.py (which reads the M_* constants at
+# its own import time) sees a fully-initialized package — the other
+# submodules import nothing back from this package.
+from metisfl_tpu.telemetry import profile  # noqa: E402
+
+__all__.append("profile")

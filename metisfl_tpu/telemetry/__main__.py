@@ -145,6 +145,20 @@ def render_postmortem(bundle: dict, show_metrics: bool = False) -> str:
                 f"    {sp.get('name', '?')} "
                 f"(open {_fmt_dur(float(sp.get('open_ms', 0.0)))}) "
                 f"trace={str(sp.get('trace', ''))[:8]}{attr_s}")
+    profiles = bundle.get("profiles", [])
+    if profiles:
+        lines.append(f"  round cost profiles at death ({len(profiles)}):")
+        for prof in profiles:
+            phases = prof.get("phases") or {}
+            top = max(phases, key=phases.get) if phases else "-"
+            totals = prof.get("totals") or {}
+            lines.append(
+                f"    round {prof.get('round', '?')}: "
+                f"wall {_fmt_dur(float(prof.get('wall_ms', 0.0)))} "
+                f"coverage {float(prof.get('coverage', 0.0)) * 100:.0f}% "
+                f"top={top} {_fmt_dur(float(phases.get(top, 0.0)))} "
+                f"uplink {int(totals.get('uplink_bytes', 0))}B "
+                f"downlink {int(totals.get('downlink_bytes', 0))}B")
     metrics_text = bundle.get("metrics", "")
     n_series = sum(1 for line in metrics_text.splitlines()
                    if line and not line.startswith("#"))

@@ -131,6 +131,16 @@ class _Recorder:
             "open_spans": _trace.open_spans(),
             "metrics": _metrics.registry().render(),
         }
+        try:
+            # latest RoundProfile tail (performance observatory): where
+            # the last rounds' time and bytes went, readable post-crash
+            from metisfl_tpu.telemetry import profile as _profile
+
+            profiles = _profile.tail(3)
+            if profiles:
+                bundle["profiles"] = profiles
+        except Exception:  # noqa: BLE001 - best-effort by contract
+            pass
         if extra:
             bundle["extra"] = extra
         safe_reason = "".join(c if (c.isalnum() or c in "_-") else "_"
