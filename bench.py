@@ -918,13 +918,16 @@ def bench_serving(requests: int = 64, rows_per_request: int = 4,
     }
 
 
-def bench_cohort(sizes=(1024, 4096), stride: int = 64):
-    """The FedStride memory-bounding claim at cohort scale (VERDICT r4 #6,
-    reference federated_stride.h rationale): 1k-4k distinct 1.64M-param
-    models on the DISK store, folded stride-blocked — peak RSS must be
-    bounded by the stride block (models stream through mmap views that die
-    with each block), not the cohort. Host-only; runs in its own child so
-    ru_maxrss is clean."""
+def bench_cohort(sizes=(1024, 4096), stride: int = 64,
+                 ingest_workers=(1, 4, 16)):
+    """Cohort-scale ingest + fold (VERDICT r4 #6 / weak #5, docs/SCALE.md):
+    1k-4k distinct 1.64M-param models onto the DISK store — now through
+    the parallel ingest pipeline, swept across worker counts {1, 4, 16}
+    (w=1 isolates the copy-free write path; the headline
+    ``cohort_{n}_insert_s`` is the 16-worker figure the controller's
+    ingest plane runs at) — then folded stride-blocked with peak RSS
+    bounded by the stride block, not the cohort. Host-only; runs in its
+    own child so ru_maxrss is clean."""
     import gc
     import shutil as _shutil
     import tempfile
@@ -932,13 +935,33 @@ def bench_cohort(sizes=(1024, 4096), stride: int = 64):
     from metisfl_tpu.aggregation.fedavg import FedAvg
     from metisfl_tpu.store.base import EvictionPolicy
     from metisfl_tpu.store.disk import DiskModelStore
+    from metisfl_tpu.store.ingest import IngestPipeline
 
     rng = np.random.default_rng(9)
     base = {name: rng.standard_normal(shape).astype(np.float32)
             for name, shape in MODEL_SHAPES.items()}
     model_bytes = sum(a.nbytes for a in base.values())
     out = {"cohort_stride": stride,
-           "cohort_model_mb": round(model_bytes / 1e6, 2)}
+           "cohort_model_mb": round(model_bytes / 1e6, 2),
+           "cohort_ingest_workers": list(ingest_workers)}
+
+    def _timed_ingest(root, n, workers):
+        """Insert n distinct models through a w-worker pipeline; returns
+        (elapsed_s, store) with every write drained + flushed."""
+        store = DiskModelStore(root, EvictionPolicy.LINEAGE_LENGTH,
+                               lineage_length=1)
+        pipe = IngestPipeline(store, workers)
+        t0 = time.perf_counter()
+        for i in range(n):
+            # distinct per-learner content at generation cost O(model)
+            pipe.submit(f"L{i}", {k: v + np.float32(i % 17)
+                                  for k, v in base.items()})
+        if not pipe.drain(timeout=1800.0):
+            raise RuntimeError("ingest drain timed out")
+        elapsed = time.perf_counter() - t0
+        pipe.shutdown()
+        return elapsed, store
+
     for n in sizes:
         need = int(n * model_bytes * 1.15)
         free = _shutil.disk_usage(tempfile.gettempdir()).free
@@ -946,15 +969,23 @@ def bench_cohort(sizes=(1024, 4096), stride: int = 64):
             out[f"cohort_{n}_skipped"] = (
                 f"needs {need >> 30} GiB free disk, have {free >> 30}")
             continue
+        # worker sweep: all but the last run are timing-only (their
+        # stores are freed immediately to keep one cohort of disk in use)
+        for w in ingest_workers[:-1]:
+            with tempfile.TemporaryDirectory(prefix=f"cohort{n}w{w}_") as rt:
+                elapsed, store = _timed_ingest(rt, n, w)
+                store.shutdown()
+            out[f"cohort_{n}_insert_w{w}_s"] = round(elapsed, 1)
+            # settle the page cache between sweeps: the previous sweep's
+            # GBs of dirty pages would otherwise throttle the next one's
+            # writes and skew the comparison
+            os.sync()
         with tempfile.TemporaryDirectory(prefix=f"cohort{n}_") as root:
-            store = DiskModelStore(root, EvictionPolicy.LINEAGE_LENGTH,
-                                   lineage_length=1)
-            t0 = time.perf_counter()
-            for i in range(n):
-                # distinct per-learner content at generation cost O(model)
-                store.insert(f"L{i}", {k: v + np.float32(i % 17)
-                                       for k, v in base.items()})
-            out[f"cohort_{n}_insert_s"] = round(time.perf_counter() - t0, 1)
+            headline_w = ingest_workers[-1]
+            elapsed, store = _timed_ingest(root, n, headline_w)
+            out[f"cohort_{n}_insert_w{headline_w}_s"] = round(elapsed, 1)
+            out[f"cohort_{n}_insert_s"] = round(elapsed, 1)
+            out[f"cohort_{n}_insert_models_per_sec"] = round(n / elapsed, 1)
             gc.collect()
             rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
             agg = FedAvg()
@@ -987,6 +1018,35 @@ def bench_cohort(sizes=(1024, 4096), stride: int = 64):
             out[f"cohort_{n}_bounded"] = bool(
                 (rss1 - rss0) * 1024 < n * model_bytes / 4)
             store.shutdown()
+
+    # 10k-learner in-process round probe (ROADMAP open item 3): fold 10k
+    # distinct uplinks through the STREAMING path — each model enters the
+    # accumulator as it "arrives" and is dropped, zero store traffic —
+    # and show the round completes with RSS bounded by one stride block
+    # (~stride x model), not the 10k-model cohort (~66 GiB here).
+    from metisfl_tpu.aggregation.streaming import StreamingAggregator
+
+    n10k = 10_000
+    gc.collect()
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    streamer = StreamingAggregator(FedAvg(), stride=stride)
+    t0 = time.perf_counter()
+    for i in range(n10k):
+        streamer.fold(f"L{i}", {k: v + np.float32(i % 17)
+                                for k, v in base.items()}, 1.0)
+    community = streamer.finish([f"L{i}" for i in range(n10k)])
+    wall = time.perf_counter() - t0
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    want = base["head/bias"] + np.float32(
+        np.mean([i % 17 for i in range(n10k)]))
+    np.testing.assert_allclose(np.asarray(community["head/bias"]), want,
+                               rtol=1e-4, atol=1e-3)
+    out["round_10k_wall_s"] = round(wall, 1)
+    out["round_10k_uplinks_per_sec"] = round(n10k / wall, 1)
+    out["round_10k_peak_rss_kb"] = rss1
+    out["round_10k_rss_growth_kb"] = rss1 - rss0
+    out["round_10k_bounded"] = bool(
+        (rss1 - rss0) * 1024 < n10k * model_bytes / 16)
     return out
 
 

@@ -71,6 +71,34 @@ class _RollingBase:
             state.wc_scaled = sub(state.wc_scaled, old_model, old_scale)
             state.z -= old_scale
 
+    # -- streaming fold (controller uplink path, PR 7) ---------------------
+    # The rolling sum IS a streaming accumulator: these methods expose the
+    # same per-contribution kernels the batch ``aggregate`` uses, so the
+    # controller can fold each accepted uplink as it arrives off the wire
+    # (no store round-trip) and the result is bit-identical to the
+    # store-based path when the fold order matches (same kernels, same
+    # accumulator dtype — the fold-order policy is docs/SCALE.md).
+
+    def fold(self, learner_id: str, model: Pytree, scale: float) -> None:
+        """Fold one arrived contribution; a re-submission replaces the
+        learner's previous one (recency semantics, case II-B)."""
+        self._remove(learner_id)
+        self._add(learner_id, model, scale)
+
+    def forget(self, learner_id: str) -> None:
+        """Subtract a contribution (learner left / not selected)."""
+        self._remove(learner_id)
+
+    def contributors(self):
+        return set(self._state.contributions)
+
+    def fold_result(self) -> Pytree:
+        """Community model of the current rolling state."""
+        if self._state.wc_scaled is None or self._state.z <= 0.0:
+            raise ValueError("fold_result called with no contributions")
+        template = next(iter(self._state.contributions.values()))[1]
+        return self._community(template)
+
     # -- checkpoint / resume ----------------------------------------------
     def export_scales(self) -> Dict[str, float]:
         """``learner_id -> scale`` of every counted contribution — the part

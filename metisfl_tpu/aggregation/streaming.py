@@ -1,0 +1,225 @@
+"""Streaming aggregation: fold uplinks into the community accumulator as
+they arrive off the wire — no store round-trip.
+
+At cohort scale the store is the round-time wall (VERDICT weak #5); for
+the rules whose community model is a weighted sum — plain ``fedavg`` and
+the rolling rules ``fedstride``/``fedrec`` — nothing forces the
+store-insert/select detour: each accepted uplink can enter the
+accumulator the moment the completion handler has it, and the community
+model materializes at barrier release with zero store reads.
+
+Fold-order policy (the bit-identity contract, docs/SCALE.md):
+
+- **Rolling rules** fold per arrival with the exact kernels their
+  store-based ``aggregate`` uses (``scaled_add``/``np_scaled_add``, same
+  accumulator dtype). The community model is bit-identical to the store
+  path whenever the arrival order matches the selection order the store
+  path would have folded in (the seeded equivalence tests pin this);
+  under a different arrival order it is equal up to fp reassociation.
+- **fedavg** buffers arrivals into blocks of the SAME ``stride_length``
+  the store path uses and folds each full block with the same stacked
+  kernel (``FedAvg.accumulate``) — identical blocking, identical
+  kernels, so bit-identity again holds under matching order. Peak
+  residency is one stride block of models, matching the store path's
+  fold memory without the store.
+
+Weights are RAW (:func:`metisfl_tpu.scaling.raw_weight`) because the
+cohort normalizer is unknown at arrival time; ``finish`` divides by
+z = Σw, which the rules already do (their scales are not required to
+sum to 1). Within a round this is proportional to the normalized store
+path — the same community model up to fp rounding, bit-identical when
+the weights are uniform powers of two (the pinned configurations).
+
+The controller builds a :class:`StreamingAggregator` only when
+``aggregation.streaming`` is on AND the rule/protocol/lineage support it
+(:func:`streaming_supported`); everything else automatically falls back
+to the store path, and the opt-out hot path is one attribute check.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from metisfl_tpu.aggregation.fedavg import FedAvg
+from metisfl_tpu.aggregation.rolling import _RollingBase
+
+logger = logging.getLogger("metisfl_tpu.aggregation.streaming")
+
+# rules whose round community model is a weighted sum the stream can fold
+STREAMING_RULES = ("fedavg", "fedstride", "fedrec")
+
+
+def streaming_supported(rule_name: str, protocol: str,
+                        secure_enabled: bool,
+                        store_lineage_length: int,
+                        required_lineage: int,
+                        checkpointed: bool = False) -> bool:
+    """Can the controller fold uplinks on arrival for this federation?
+
+    - only the weighted-sum rules (robust/fednova/serveropt need full
+      cohorts or auxiliary state → store path);
+    - never under secure aggregation (opaque payloads);
+    - only "when lineage_length permits": an operator keeping MORE store
+      history than the rule needs wants the store written — skipping it
+      would silently break that contract;
+    - ``fedavg``/``fedstride`` are round-scoped sums over the sync
+      barrier's cohort; under the asynchronous protocol the selector
+      aggregates ALL active learners' stored lineage on every single
+      completion, which only the store can serve. ``fedrec`` is the
+      async streaming rule (its rolling state IS the lineage);
+    - ``fedrec`` + checkpointing needs the store written: crash-restore
+      rehydrates the cross-round rolling sum FROM store lineage
+      (controller ``rehydrate``), and a zero-store round path would make
+      ``--resume`` silently restore 0 contributions. fedavg/fedstride
+      are round-scoped — a resumed round re-dispatches from scratch, so
+      they stream safely under checkpointing.
+    """
+    rule = rule_name.lower()
+    if rule not in STREAMING_RULES or secure_enabled:
+        return False
+    if store_lineage_length > required_lineage:
+        return False
+    if rule in ("fedavg", "fedstride") and protocol == "asynchronous":
+        return False
+    if rule == "fedrec" and checkpointed:
+        return False
+    return True
+
+
+class StreamingAggregator:
+    """Wraps the controller's aggregation rule with an arrival-order fold.
+
+    Thread-safety: ``fold``/``finish``/``abandon`` all run on the
+    controller's single scheduling executor; ``forget`` is routed there
+    too (leave() submits it). The internal lock exists only for the
+    cheap stats counters the status plane reads cross-thread.
+    """
+
+    def __init__(self, rule, stride: int = 0):
+        self._rule = rule
+        self._stride = int(stride)
+        self._rolling = isinstance(rule, _RollingBase)
+        # fedavg path: block buffer + per-round fold bookkeeping
+        self._block: List[Tuple[Any, float]] = []
+        self._folded: Set[str] = set()
+        self._fold_count = 0
+        self._lock = threading.Lock()
+
+    @property
+    def rule_name(self) -> str:
+        return self._rule.name
+
+    # -- uplink path (scheduling executor) ---------------------------------
+    def fold(self, learner_id: str, model: Any, weight: float) -> None:
+        """Fold one accepted uplink. Rolling rules fold immediately
+        (a re-submission replaces the previous contribution); fedavg
+        buffers until a stride block is full, then folds the block with
+        the store path's stacked kernel."""
+        if self._rolling:
+            self._rule.fold(learner_id, model, weight)
+        else:
+            if learner_id in self._folded:
+                # fedavg's stacked fold cannot replace an already-folded
+                # contribution (no per-learner subtraction) — a duplicate
+                # arrival within one sync round means an expired-task
+                # re-dispatch raced its late completion; keep the first,
+                # matching the store path's lineage_length=1 "latest
+                # wins" only up to the block boundary (documented).
+                logger.warning("duplicate streaming fold from %s ignored",
+                               learner_id)
+                return
+            self._block.append((model, float(weight)))
+            if self._stride > 0 and len(self._block) >= self._stride:
+                self._flush_block()
+        with self._lock:
+            self._folded.add(learner_id)
+            self._fold_count += 1
+
+    def _flush_block(self) -> None:
+        if not self._block:
+            return
+        self._rule.accumulate([([m], w) for m, w in self._block])
+        self._block.clear()
+
+    def forget(self, learner_id: str) -> None:
+        """A learner left: subtract its contribution where the rule can
+        (rolling state); fedavg's folded blocks cannot un-fold — its
+        round-scoped sum keeps the already-folded contribution and
+        ``finish`` logs the divergence (the store path would have erased
+        the departed lineage; docs/SCALE.md)."""
+        if self._rolling:
+            self._rule.forget(learner_id)
+            with self._lock:
+                self._folded.discard(learner_id)
+
+    # -- barrier release ---------------------------------------------------
+    def finish(self, selected: Sequence[str]) -> Optional[Dict[str, Any]]:
+        """Community model from the streamed folds for the released
+        cohort. Returns None when nothing folded (the caller logs and
+        re-dispatches, matching the store path's empty-select posture)."""
+        selected_set = set(selected)
+        if self._rolling:
+            if self._rule.name == "fedstride":
+                # round-scoped sum: contributions outside the released
+                # cohort (e.g. a mid-round joiner that was not selected)
+                # are subtracted — exact, the models are in state
+                for lid in list(self._rule.contributors() - selected_set):
+                    self._rule.forget(lid)
+            # fedrec keeps every contributor: its rolling sum spans
+            # rounds, exactly like the store path's persistent lineage
+            try:
+                community = self._rule.fold_result()
+            except ValueError:
+                community = None
+            self._reset_round()
+            return community
+        # fedavg: a fold outside the released cohort can only come from a
+        # learner that uplinked and then LEFT mid-round (uplinks arrive
+        # solely from dispatched tasks; the barrier's cohort is
+        # scheduled ∩ active). A stacked fold cannot be subtracted, so
+        # the round keeps the departed learner's accepted contribution
+        # and completes — the store path would have erased its lineage,
+        # a documented divergence (docs/SCALE.md); aborting an otherwise
+        # completable round (and marching toward the aggregation-failure
+        # halt under churn) would be strictly worse.
+        extra = self._folded - selected_set
+        if extra:
+            logger.warning(
+                "streamed folds from departed learners %s stay in the "
+                "round sum (stacked folds cannot be subtracted)",
+                sorted(extra)[:5])
+        self._flush_block()
+        try:
+            community = self._rule.result()
+        except ValueError:
+            community = None
+        self._reset_round()
+        return community
+
+    def abandon(self) -> None:
+        """Round abandoned (aggregation failure / deadline with no
+        reporters / cohort departed): drop round-scoped fold state so the
+        re-dispatched round starts clean. FedRec's cross-round rolling
+        state survives — re-arrivals replace their contributions."""
+        self._reset_round()
+
+    def _reset_round(self) -> None:
+        if self._rolling:
+            if self._rule.name == "fedstride":
+                self._rule.reset()
+        else:
+            self._rule.reset()
+        self._block.clear()
+        with self._lock:
+            self._folded.clear()
+
+    # -- status plane ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"rule": self._rule.name,
+                    "folded": len(self._folded),
+                    "fold_count": self._fold_count}

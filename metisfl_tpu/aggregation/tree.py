@@ -1,0 +1,144 @@
+"""Tree-aggregation tier: hierarchical in-process reduction.
+
+At 10k+ learners a flat fold makes the controller's aggregation loop
+O(cohort) in both fan-in and wall-clock: one thread walks every stride
+block, and the store reads serialize behind it. The tree tier partitions
+the cohort into ``branch`` contiguous slices, folds each slice in its own
+worker (parallel store selects + parallel host-BLAS folds), then folds
+the ``branch`` partial accumulators into the root — controller fan-in is
+O(branch), peak residency is ~``branch`` × (one sub-block of models +
+one accumulator) instead of the whole cohort.
+
+Math: the tier applies only to weighted-sum rules (community =
+Σ wᵢ·mᵢ / Σ wᵢ — fedavg/scaffold/fedstride), where addition is
+associative, so any slicing yields the same sum up to fp reassociation.
+The equality tests pin tree-vs-flat bit-identity on integer-valued
+payloads (every partial sum exactly representable — reassociation-proof)
+at branch ∈ {2, 8, 32}; for real-valued models the difference is ~1 ulp.
+
+Host-numpy only: models come out of the store as host arrays (wire
+uplinks), and the slice folds use the same ``np_stacked_scaled_add`` /
+native hostfold kernels as :class:`FedAvg`. The accumulator dtype policy
+(f32, f64 for wide trees) is inherited from aggregation/base.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from metisfl_tpu.aggregation.base import (
+    np_finalize,
+    np_stacked_scaled_add,
+)
+
+logger = logging.getLogger("metisfl_tpu.aggregation.tree")
+
+# default sub-block size inside a slice when the federation runs with
+# stride_length=0 ("whole cohort in one block") — the tree tier still
+# bounds residency per worker instead of stacking cohort/branch models
+_DEFAULT_SUBBLOCK = 32
+
+Fetch = Callable[[Sequence[str]], Dict[str, List[Any]]]
+
+
+class SlicePartial:
+    """One slice's fold result."""
+
+    __slots__ = ("acc", "z", "count", "dtypes", "duration_ms")
+
+    def __init__(self, acc, z, count, dtypes, duration_ms):
+        self.acc, self.z, self.count = acc, z, count
+        self.dtypes, self.duration_ms = dtypes, duration_ms
+
+
+class TreeReducer:
+    """B-way two-level reducer over store-resident lineages."""
+
+    def __init__(self, branch: int = 8, workers: int = 0):
+        if branch < 2:
+            raise ValueError("tree branch must be >= 2")
+        self.branch = int(branch)
+        self._workers = int(workers) or min(self.branch,
+                                            max(2, os.cpu_count() or 2))
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers, thread_name_prefix="tree-agg")
+        return self._pool
+
+    def shutdown(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    # -- slice fold (worker thread) ----------------------------------------
+    @staticmethod
+    def _fold_slice(slice_ids: Sequence[str], scales: Dict[str, float],
+                    fetch: Fetch, subblock: int) -> SlicePartial:
+        t0 = time.perf_counter()
+        acc = None
+        z = 0.0
+        count = 0
+        dtypes: Optional[Tuple[str, ...]] = None
+        for i in range(0, len(slice_ids), subblock):
+            block = list(slice_ids[i:i + subblock])
+            picked = fetch(block)
+            models = [picked[lid][0] for lid in block if lid in picked]
+            weights = np.asarray([scales[lid] for lid in block
+                                  if lid in picked], np.float64)
+            if not models:
+                continue
+            if dtypes is None:
+                dtypes = tuple(str(np.asarray(x).dtype)
+                               for x in jax.tree.leaves(models[0]))
+            acc = np_stacked_scaled_add(acc, models, weights)
+            z += float(weights.sum())
+            count += len(models)
+        return SlicePartial(acc, z, count, dtypes,
+                            (time.perf_counter() - t0) * 1e3)
+
+    # -- public API --------------------------------------------------------
+    def reduce(self, ids: Sequence[str], scales: Dict[str, float],
+               fetch: Fetch, stride: int = 0
+               ) -> Optional[Tuple[Dict[str, Any], List[SlicePartial]]]:
+        """Fold ``ids``' latest stored models into a community model.
+
+        ``fetch(block) -> {lid: lineage}`` is the (thread-safe) store
+        select; ``stride`` bounds each worker's resident sub-block (0 →
+        a default bound, NOT the whole slice). Returns ``(community,
+        partials)`` or None when no learner had a stored model."""
+        ids = list(ids)
+        if not ids:
+            return None
+        subblock = int(stride) or _DEFAULT_SUBBLOCK
+        # branch contiguous slices (the last may be short); slices keep
+        # the flat path's id order so slice-internal folds match the
+        # flat fold's blocking within each slice
+        per = max(1, -(-len(ids) // self.branch))  # ceil division
+        slices = [ids[i:i + per] for i in range(0, len(ids), per)]
+        if len(slices) == 1:
+            partials = [self._fold_slice(slices[0], scales, fetch, subblock)]
+        else:
+            futures = [self._executor().submit(
+                self._fold_slice, s, scales, fetch, subblock)
+                for s in slices]
+            partials = [f.result() for f in futures]
+        live = [p for p in partials if p.acc is not None]
+        if not live:
+            return None
+        # root fold: O(branch) partial-accumulator adds, in slice order
+        acc, z = live[0].acc, live[0].z
+        for p in live[1:]:
+            acc = jax.tree.map(lambda a, b: a + b, acc, p.acc)
+            z += p.z
+        community = np_finalize(acc, z, dtypes=live[0].dtypes)
+        return community, partials

@@ -30,6 +30,22 @@ class TerminationConfig:
 
 
 @dataclass
+class TreeAggregationConfig:
+    """Tree-aggregation tier (aggregation/tree.py): partition the cohort
+    into ``branch`` slices, fold each in a worker (parallel store selects
+    + parallel host folds), fold the partials — controller fan-in becomes
+    O(branch) and fold residency is bounded by ~branch sub-blocks instead
+    of the cohort. Applies to the weighted-sum rules on the store path
+    (fedavg / scaffold / fedstride); everything else falls back to the
+    flat fold. ``enabled=false`` leaves the aggregation path at one
+    attribute check."""
+
+    enabled: bool = False
+    branch: int = 8
+    workers: int = 0                         # 0 → min(branch, cpu_count)
+
+
+@dataclass
 class AggregationConfig:
     rule: str = "fedavg"                     # fedavg | fedstride | fedrec |
                                              # secure_agg | fedavgm |
@@ -56,6 +72,15 @@ class AggregationConfig:
     # (0 derives the max tolerable (n-3)//2 from the cohort)
     trim_ratio: float = 0.1
     byzantine_f: int = 0
+    # streaming aggregation (aggregation/streaming.py, docs/SCALE.md):
+    # fold each accepted uplink into the community accumulator as it
+    # arrives off the wire — no store round-trip — for fedavg /
+    # fedstride / fedrec when the store lineage permits; other rules
+    # (and secure agg) automatically fall back to the store path.
+    # false (default) keeps today's path at one attribute check.
+    streaming: bool = False
+    # hierarchical tree-aggregation tier for the store path
+    tree: TreeAggregationConfig = field(default_factory=TreeAggregationConfig)
 
 
 @dataclass
@@ -69,6 +94,12 @@ class ModelStoreConfig:
     # process (the reference's external-Redis posture, SURVEY.md §2.1 C12)
     host: str = "localhost"
     port: int = 0
+    # parallel ingest (store/ingest.py, docs/SCALE.md): >0 decouples
+    # payload persistence from the uplink path — a bounded pool of this
+    # many writers drains completions into the store and aggregation
+    # fences on drain before select. 0 (default) = today's synchronous
+    # insert on the completion path (one attribute check).
+    ingest_workers: int = 0
 
 
 @dataclass
@@ -442,6 +473,21 @@ class FederationConfig:
                 "telemetry.profile.trace_every_rounds must be >= 0")
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
+        if self.model_store.ingest_workers < 0:
+            raise ValueError("model_store.ingest_workers must be >= 0")
+        if self.aggregation.tree.enabled and self.aggregation.tree.branch < 2:
+            # a 1-way "tree" is the flat fold with extra thread hops
+            raise ValueError("aggregation.tree.branch must be >= 2")
+        if self.aggregation.tree.workers < 0:
+            raise ValueError("aggregation.tree.workers must be >= 0")
+        if self.aggregation.streaming and self.secure.enabled:
+            # streaming folds plaintext trees on arrival; secure payloads
+            # are opaque ciphertext that only the full-cohort combine can
+            # handle — fail loudly instead of silently falling back, the
+            # operator asked for a path this federation cannot take
+            raise ValueError(
+                "aggregation.streaming is incompatible with secure "
+                "aggregation (opaque payloads cannot fold on arrival)")
         if self.train.dp_noise_multiplier < 0.0 or self.train.dp_clip_norm < 0.0:
             # a sign typo must not silently disable the mechanism
             raise ValueError("dp_clip_norm and dp_noise_multiplier must be "

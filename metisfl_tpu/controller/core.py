@@ -31,6 +31,7 @@ import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from functools import partial
 from typing import (Any, Callable, Dict, List, Optional, Protocol, Sequence,
                     Tuple)
 
@@ -50,7 +51,7 @@ from metisfl_tpu.comm.messages import (
     TrainTask,
 )
 from metisfl_tpu.config import FederationConfig
-from metisfl_tpu.scaling import apply_staleness_decay, make_scaler
+from metisfl_tpu.scaling import apply_staleness_decay, make_scaler, raw_weight
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
 from metisfl_tpu.selection import make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
@@ -283,6 +284,51 @@ class Controller:
             store_kwargs["port"] = store_cfg.port
         self._store = make_store(store_cfg.store, **store_kwargs)
 
+        # Cohort-scale ingest plane (docs/SCALE.md). All three are None
+        # when opted out — every hot path then costs one attribute check.
+        # (a) parallel store ingest: completions enqueue, a bounded
+        # writer pool persists, aggregation fences on drain
+        self._ingest = None
+        ingest_workers = int(getattr(store_cfg, "ingest_workers", 0) or 0)
+        if ingest_workers > 0:
+            from metisfl_tpu.store.ingest import IngestPipeline
+            # accept: the worker re-checks membership right before the
+            # write, so a queued write racing leave() cannot land after
+            # the erase and resurrect the pruned lineage
+            self._ingest = IngestPipeline(
+                self._store, ingest_workers,
+                on_insert=self._note_ingest_insert,
+                accept=self.is_member)
+        # (b) streaming aggregation: fold accepted uplinks on arrival —
+        # no store round-trip — for the weighted-sum rules; unsupported
+        # rule/protocol/lineage combinations fall back to the store path
+        self._streaming = None
+        if getattr(agg, "streaming", False):
+            from metisfl_tpu.aggregation.streaming import (
+                StreamingAggregator,
+                streaming_supported,
+            )
+            if streaming_supported(self._aggregator.name, config.protocol,
+                                   config.secure.enabled, lineage,
+                                   self._aggregator.required_lineage,
+                                   checkpointed=bool(config.checkpoint.dir)):
+                self._streaming = StreamingAggregator(
+                    self._aggregator, stride=agg.stride_length)
+            else:
+                logger.info(
+                    "aggregation.streaming requested but rule=%s/"
+                    "protocol=%s/lineage=%d/checkpointed=%s does not "
+                    "support it; using the store path",
+                    self._aggregator.name, config.protocol, lineage,
+                    bool(config.checkpoint.dir))
+        # (c) tree-aggregation tier: O(branch) fan-in for the store path
+        self._tree = None
+        tree_cfg = getattr(agg, "tree", None)
+        if tree_cfg is not None and getattr(tree_cfg, "enabled", False):
+            from metisfl_tpu.aggregation.tree import TreeReducer
+            self._tree = TreeReducer(branch=tree_cfg.branch,
+                                     workers=tree_cfg.workers)
+
         # community model state
         self._community_flat: Optional[Dict[str, np.ndarray]] = None
         self._community_blob: Optional[bytes] = None
@@ -396,7 +442,13 @@ class Controller:
         with self._lock:
             if self._deadline_timer is not None:
                 self._deadline_timer.cancel()
+        # ingest workers write INTO the store: stop them (bounded drain)
+        # before the store's own shutdown
+        if self._ingest is not None:
+            self._ingest.shutdown()
         self._store.shutdown()
+        if self._tree is not None:
+            self._tree.shutdown()
         if self._registry is not None:
             self._registry.shutdown()
         # Deregister the process-global collector handle if it is still
@@ -541,7 +593,23 @@ class Controller:
         if proxy is not None and hasattr(proxy, "detach_peer"):
             proxy.detach_peer()
         self._prune_learner_series(learner_id)
+        # drain the departing learner's queued ingest writes BEFORE the
+        # erase — a write landing after the prune would resurrect the
+        # lineage (and its attribution series) for the process lifetime
+        if self._ingest is not None:
+            if not self._ingest.drain(learner_id, timeout=30.0):
+                # a wedged writer: proceed with the erase — the queued
+                # write cannot resurrect the lineage, the worker's
+                # membership gate drops it (store/ingest.py accept)
+                logger.error("ingest drain for departing %s timed out; "
+                             "its queued writes will be gate-dropped",
+                             learner_id)
         self._store.erase([learner_id])
+        if self._streaming is not None and not self._shutdown.is_set():
+            # subtract the departed learner's streamed contribution on
+            # the scheduling executor (fold state is single-threaded)
+            self._pool.submit(self._guard, self._streaming.forget,
+                              learner_id)
         logger.info("learner %s left", learner_id)
         _tevents.emit(_tevents.LearnerLost, learner_id=learner_id)
         # Re-evaluate the round barrier: if the departed learner was the last
@@ -794,25 +862,51 @@ class Controller:
                 self._current_meta.errors.append(
                     f"malformed result from {result.learner_id}: {exc}")
             model = None
+        deferred_meta = False
+        if model is not None and self._streaming is not None:
+            # streaming aggregation (docs/SCALE.md): the accepted uplink
+            # folds straight into the community accumulator — the store
+            # round-trip is skipped entirely. A dropped fold (stale on a
+            # round-scoped rule, opaque payload) contributes nothing,
+            # exactly like a malformed payload on the store path.
+            if not self._stream_fold(result, model, stale):
+                model = None
+        elif model is not None:
+            if self._ingest is not None:
+                # parallel ingest: enqueue and return — the writer pool
+                # records the ACTUAL write time via _note_ingest_insert
+                # (no store_insert sample from this thread: no double
+                # count), and aggregation fences on drain before select.
+                # The result metadata is applied by on_success ONLY when
+                # the write lands: a fail-soft write failure must not
+                # pair fresh step counts with the older stored model.
+                self._ingest.submit(
+                    result.learner_id, model,
+                    on_success=partial(self._ingest_landed, result))
+                deferred_meta = True
+            else:
+                insert_sp = _ttrace.span(
+                    "round.store_insert", parent=self._round_span,
+                    attrs={"learner": result.learner_id})
+                with insert_sp:
+                    self._store.insert(result.learner_id, model)
+                _M_PHASE.observe(insert_sp.duration_ms / 1e3,
+                                 phase="store_insert")
+                if self._profile is not None:
+                    self._profile.note_store_insert(result.learner_id,
+                                                    insert_sp.duration_ms)
         if model is not None:
-            insert_sp = _ttrace.span(
-                "round.store_insert", parent=self._round_span,
-                attrs={"learner": result.learner_id})
-            with insert_sp:
-                self._store.insert(result.learner_id, model)
-            _M_PHASE.observe(insert_sp.duration_ms / 1e3,
-                             phase="store_insert")
-            if self._profile is not None:
-                self._profile.note_store_insert(result.learner_id,
-                                                insert_sp.duration_ms)
-            with self._lock:
-                # step count and result round pair with the STORED model:
-                # dropped payloads (late topk, malformed) must not refresh
-                # them, or FedNova's τ / the batches scaler / staleness
-                # decay would weight the older stored model with metadata
-                # from a different task
-                record.completed_batches = result.completed_batches
-                record.last_result_round = result.round_id
+            if not deferred_meta:
+                with self._lock:
+                    # step count and result round pair with the STORED
+                    # (or streamed) model: dropped payloads (late topk,
+                    # malformed, stale-on-streaming) must not refresh
+                    # them, or FedNova's τ / the batches scaler /
+                    # staleness decay would weight the older stored model
+                    # with metadata from a different task (the ingest
+                    # path applies them in _ingest_landed, write-fenced)
+                    record.completed_batches = result.completed_batches
+                    record.last_result_round = result.round_id
             if self._health is not None and isinstance(model, dict) and model:
                 # learning-health statistics for this uplink (host numpy,
                 # read-only — the stored model is untouched). Reference is
@@ -878,6 +972,8 @@ class Controller:
             # surviving learners keep making progress
             logger.info("round abandoned (dispatched cohort left); re-dispatching")
             self._scheduler.reset()
+            if self._streaming is not None:
+                self._streaming.abandon()
             self._dispatch_train(self._sample_cohort())
 
     # -- straggler deadline ----------------------------------------------
@@ -957,7 +1053,77 @@ class Controller:
             logger.warning(
                 "round deadline (%.1fs) expired with no reporters (%s); "
                 "re-dispatching", self.config.round_deadline_secs, dropped)
+            if self._streaming is not None:
+                self._streaming.abandon()
             self._dispatch_train(self._sample_cohort())
+
+    def _ingest_landed(self, result: TaskResult, ms: float) -> None:
+        """Ingest-write success hook (runs on the writer, strictly before
+        the drain fence covering the write can return): pair the result's
+        step count and round with the NOW-stored model. A fail-soft write
+        failure never reaches here, so the older stored model keeps its
+        older metadata."""
+        with self._lock:
+            record = self._learners.get(result.learner_id)
+            if record is None:
+                return
+            record.completed_batches = result.completed_batches
+            record.last_result_round = result.round_id
+
+    def _note_ingest_insert(self, learner_id: str, ms: float) -> None:
+        """Ingest-worker write attribution: the phase histogram and the
+        round profile record the worker's ACTUAL write duration (the
+        completion handler only enqueued — it records nothing)."""
+        _M_PHASE.observe(ms / 1e3, phase="store_insert")
+        if self._profile is not None:
+            # membership gate under the registry lock (same posture as
+            # _M_UPLINK): leave() prunes the profile series strictly
+            # after deleting the record, so a late worker write cannot
+            # re-mint a departed learner's series
+            with self._lock:
+                if learner_id in self._learners:
+                    self._profile.note_store_insert(learner_id, ms)
+
+    def _stream_fold(self, result: TaskResult, model, stale: bool) -> bool:
+        """Fold one accepted uplink into the streaming accumulator.
+        Returns False when the contribution was dropped (stale on a
+        round-scoped rule — the streaming path has no store to park a
+        late model in; or a non-tree payload)."""
+        if stale and self._streaming.rule_name != "fedrec":
+            # fedavg/fedstride sums are round-scoped: the expired round
+            # this model belongs to was already abandoned. (fedrec's
+            # recency semantics WANT the late model — newest wins.)
+            logger.info("late completion from %s dropped (streaming "
+                        "path keeps no store lineage)", result.learner_id)
+            return False
+        if not isinstance(model, dict) or not model:
+            return False
+        with self._lock:
+            record = self._learners.get(result.learner_id)
+            if record is None:
+                return False
+            entry = {"num_train_examples": record.num_train_examples,
+                     "completed_batches": result.completed_batches}
+        # raw (unnormalized) weight — the cohort normalizer is unknown
+        # until barrier release; finish() divides by z = Σw (docs/SCALE.md)
+        weight = raw_weight(self.config.aggregation.scaler, entry)
+        if weight <= 0.0:
+            # the batch scalers would give this learner scale 0 (e.g.
+            # completed_batches=0): accept the completion — the record
+            # update below still pairs metadata with it — but fold
+            # nothing, matching a scale-0 contribution on the store path
+            return True
+        decay = self.config.aggregation.staleness_decay
+        if decay > 0.0:
+            staleness = max(0, self.global_iteration - result.round_id)
+            weight *= (1.0 + float(staleness)) ** -decay
+        t0 = time.perf_counter()
+        self._streaming.fold(result.learner_id, model, weight)
+        fold_ms = (time.perf_counter() - t0) * 1e3
+        _M_PHASE.observe(fold_ms / 1e3, phase="stream_fold")
+        if self._profile is not None:
+            self._profile.note_phase("stream_fold", fold_ms)
+        return True
 
     def _topk_uplink(self) -> bool:
         from metisfl_tpu.tensor.sparse import parse_topk
@@ -1041,6 +1207,10 @@ class Controller:
         except Exception as exc:
             _M_AGG_FAILURES.inc()
             self._agg_failures += 1
+            if self._streaming is not None:
+                # drop round-scoped fold state so the retry starts clean
+                # (fedrec's cross-round rolling state survives)
+                self._streaming.abandon()
             with self._lock:
                 self._current_meta.errors.append(f"aggregation failed: {exc!r}")
             if self._agg_failures >= self._MAX_AGG_FAILURES:
@@ -1206,6 +1376,21 @@ class Controller:
 
     def _compute_community_model_traced(self, selected: Sequence[str],
                                         agg_sp) -> None:
+        if self._ingest is not None:
+            # lineage visibility fence: every queued write must land (and
+            # the store flush its batched fsyncs) before any select — a
+            # torn lineage must never enter an aggregate. A timeout means
+            # a wedged writer; raising routes into the aggregation-failure
+            # retry instead of silently aggregating a partial cohort.
+            t0 = time.perf_counter()
+            if not self._ingest.drain(timeout=300.0):
+                raise RuntimeError(
+                    "ingest drain fence timed out; store lineage would be "
+                    "torn")
+            drain_ms = (time.perf_counter() - t0) * 1e3
+            _M_PHASE.observe(drain_ms / 1e3, phase="ingest_drain")
+            if self._profile is not None:
+                self._profile.note_phase("ingest_drain", drain_ms)
         lineage_k = self._aggregator.required_lineage
         stride = self.config.aggregation.stride_length or len(selected) or 1
         metadata = self._scaling_metadata(selected)
@@ -1214,8 +1399,10 @@ class Controller:
         if decay > 0.0:
             scales = apply_staleness_decay(scales, metadata, decay)
         # FedStride state resets between rounds (federated_stride.cc:52-68);
-        # FedRec carries state across rounds; FedAvg resets in its own branch.
-        if self._aggregator.name == "fedstride":
+        # FedRec carries state across rounds; FedAvg resets in its own
+        # branch. Under streaming the rolling state HOLDS this round's
+        # folds — finish() owns the reset.
+        if self._aggregator.name == "fedstride" and self._streaming is None:
             self._aggregator.reset()
 
         community = None
@@ -1265,6 +1452,18 @@ class Controller:
                     present_ids, parsed)
             community = self._aggregator.aggregate(parsed,
                                                    correction=correction)
+        elif self._streaming is not None:
+            # Streaming: the community model is already accumulated —
+            # barrier release just finalizes it. Zero store reads.
+            folded = self._streaming.stats()["folded"]
+            sp = block_span(range(folded))
+            with sp:
+                community = self._streaming.finish(selected)
+            end_block(sp, range(folded))
+            if community is None:
+                logger.warning("no streamed contributions for cohort %s",
+                               list(selected))
+                return
         elif getattr(self._aggregator, "requires_full_cohort", False):
             # Robust rules (median / trimmed_mean / krum): a median cannot
             # fold stride-wise.
@@ -1281,6 +1480,34 @@ class Controller:
                     advisory_scores=self._health.scores())
             else:
                 community = self._aggregator.aggregate(pairs)
+        elif (self._tree is not None
+              and self._aggregator.name in ("fedavg", "scaffold",
+                                            "fedstride")):
+            # Tree tier (aggregation/tree.py): B-way slice folds in
+            # workers, O(branch) root fan-in; applies to the pure
+            # weighted-sum rules on the store path. stride_length=0 is
+            # passed through as 0 so the tier applies its own bounded
+            # sub-block instead of stacking whole slices.
+            if self._aggregator.name == "fedstride":
+                self._aggregator.reset()  # round-scoped state unused here
+            tree_sp = _ttrace.span("round.tree_reduce", parent=agg_sp,
+                                   attrs={"cohort": len(ids),
+                                          "branch": self._tree.branch})
+            with tree_sp:
+                reduced = self._tree.reduce(
+                    ids, scales,
+                    lambda block: self._timed_select(block, k=lineage_k),
+                    stride=self.config.aggregation.stride_length)
+            if reduced is None:
+                logger.warning("no stored models for cohort %s",
+                               list(selected))
+                return
+            community, partials = reduced
+            for partial in partials:
+                meta_blocks.append(partial.count)
+                meta_durations.append(round(partial.duration_ms, 3))
+                _M_PHASE.observe(partial.duration_ms / 1e3,
+                                 phase="aggregate_block")
         elif hasattr(self._aggregator, "accumulate"):
             # Fold rules (FedAvg and the ServerOpt family wrapping it):
             # accumulate block-by-block so only one stride block of models is
@@ -2157,6 +2384,13 @@ class Controller:
             "events": _tevents.tail(event_tail) if event_tail else [],
             "time": round(now, 6),
         })
+        if self._ingest is not None:
+            errors, _ = self._ingest.errors()
+            snapshot["ingest"] = {"workers": self._ingest.workers,
+                                  "queue_depth": self._ingest.queue_depth(),
+                                  "errors": errors}
+        if self._streaming is not None:
+            snapshot["streaming"] = self._streaming.stats()
         if self._health is not None:
             # latest round's convergence snapshot ({} before round 1)
             snapshot["health"] = self._health.snapshot()

@@ -70,6 +70,30 @@ SCALERS: Dict[str, Callable[[Metadata], Dict[str, float]]] = {
 }
 
 
+def raw_weight(scaler_name: str, entry: Mapping[str, float]) -> float:
+    """Unnormalized contribution weight for ONE learner — the streaming
+    aggregation path (docs/SCALE.md) folds uplinks as they arrive, before
+    the cohort (and therefore the normalizer Σw) is known, so it uses raw
+    weights and divides by z = Σw at finalize. Proportional to the batch
+    scalers above within any one round (the community model is identical
+    up to fp reassociation; bit-identical in the pinned configurations).
+
+    A missing/zero quantity returns 0.0 — the batch scalers give that
+    learner weight 0 whenever anyone in the cohort reported a positive
+    quantity, so the streaming fold skips the contribution (scale-0
+    parity). The scalers' cohort-WIDE degrade-to-uniform (every quantity
+    zero) has no streaming analogue: all folds skip and the round
+    completes without a model, which the caller logs."""
+    name = scaler_name.lower()
+    if name == "train_dataset_size":
+        return float(entry.get("num_train_examples", 0.0))
+    if name == "batches":
+        return float(entry.get("completed_batches", 0.0))
+    if name == "participants":
+        return 1.0
+    raise ValueError(f"unknown scaler {scaler_name!r}; have {sorted(SCALERS)}")
+
+
 def make_scaler(name: str) -> Callable[[Metadata], Dict[str, float]]:
     try:
         return SCALERS[name.lower()]

@@ -12,6 +12,7 @@ from metisfl_tpu.store.base import EvictionPolicy, ModelStore
 from metisfl_tpu.store.memory import InMemoryModelStore
 from metisfl_tpu.store.disk import DiskModelStore
 from metisfl_tpu.store.cached import CachedDiskStore
+from metisfl_tpu.store.ingest import IngestPipeline
 
 
 def _remote(**kwargs):
@@ -45,6 +46,7 @@ __all__ = [
     "InMemoryModelStore",
     "DiskModelStore",
     "CachedDiskStore",
+    "IngestPipeline",
     "STORES",
     "make_store",
 ]

@@ -1,7 +1,48 @@
-"""Model store interface + eviction semantics."""
+"""Model store interface + eviction semantics.
+
+Thread-safety contract (PR 7 — parallel ingest)
+-----------------------------------------------
+
+Inserts are concurrent: the controller's ingest pipeline
+(:mod:`metisfl_tpu.store.ingest`) drives ``insert`` from a writer pool, so
+the store can no longer serialize everything behind one global lock (a
+5 MB model packs+writes in ~10 ms — one lock would cap ingest at ~100
+models/s regardless of worker count). Lock granularity is therefore
+**per learner-lineage**:
+
+- ``_registry_lock`` guards only the lock table and any store-global
+  bookkeeping (sequence counters, caches keep their own locks). It is
+  never held across I/O or serialization.
+- One :class:`threading.Lock` per learner serializes that learner's
+  lineage mutations and snapshots. Operations on DIFFERENT learners run
+  fully in parallel; operations on the SAME learner are linearized
+  (insert/insert, insert/select, insert/erase each observe a consistent
+  lineage — never a torn one). The lock table is refcounted so ``erase``
+  can prune a departed learner's entry without ever letting two lock
+  objects coexist for one learner (a contended entry survives until a
+  later erase finds it idle).
+- ``learner_ids()`` is a racy-but-consistent snapshot: it may miss a
+  learner whose first insert is mid-flight, exactly like a select issued
+  a microsecond earlier would.
+
+Cross-learner ordering is the CALLER's job: the controller fences
+aggregation behind ``IngestPipeline.drain()`` before any ``select``, and
+drains a learner's queued writes before ``erase`` on leave. An ``erase``
+racing an ``insert`` for the same learner is linearized by the learner
+lock — whichever runs second wins (an insert landing after the erase
+re-creates the lineage; the controller's drain-before-erase ordering
+prevents that from happening unintentionally).
+
+Subclass storage hooks (``_append``/``_lineage``/``_erase``/``_evict``)
+are always invoked with the owning learner's lock held; ``_learner_ids``
+is invoked with no lock (it must be a GIL-atomic snapshot or take the
+subclass's own). The concurrency regression test hammering this contract
+on the disk + cached backends lives in tests/test_store_ingest.py.
+"""
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import threading
 from typing import Any, Dict, List, Optional, Sequence
@@ -19,8 +60,9 @@ class EvictionPolicy(enum.Enum):
 
 
 class ModelStore:
-    """Per-learner lineage cache. Thread-safe; values are opaque to the store
-    (pytrees of host numpy arrays, or encrypted OpaqueModels)."""
+    """Per-learner lineage cache. Thread-safe per the module docstring;
+    values are opaque to the store (pytrees of host numpy arrays, or
+    encrypted OpaqueModels)."""
 
     def __init__(self, policy: EvictionPolicy = EvictionPolicy.LINEAGE_LENGTH,
                  lineage_length: int = 1):
@@ -28,9 +70,32 @@ class ModelStore:
             raise ValueError("lineage_length must be >= 1")
         self.policy = policy
         self.lineage_length = lineage_length
+        # registry lock: guards ONLY the per-learner lock table (and
+        # subclass-global bookkeeping) — never held across I/O
         self._lock = threading.Lock()
+        # learner_id -> [lock, refcount]; the refcount makes pruning safe:
+        # erase may drop an entry only when no other thread has fetched
+        # it, otherwise two lock objects could coexist for one learner
+        # and "serialized per learner" would silently stop being true
+        self._learner_locks: Dict[str, List] = {}
 
-    # -- subclass storage hooks -------------------------------------------
+    @contextlib.contextmanager
+    def _locked(self, learner_id: str):
+        """Hold ``learner_id``'s lineage lock. All same-learner mutations
+        and snapshots run under exactly one lock object at a time."""
+        with self._lock:
+            entry = self._learner_locks.get(learner_id)
+            if entry is None:
+                entry = self._learner_locks[learner_id] = [threading.Lock(), 0]
+            entry[1] += 1
+        try:
+            with entry[0]:
+                yield
+        finally:
+            with self._lock:
+                entry[1] -= 1
+
+    # -- subclass storage hooks (called with the learner's lock held) ------
     def _append(self, learner_id: str, model: Any) -> None:
         raise NotImplementedError
 
@@ -49,7 +114,7 @@ class ModelStore:
 
     # -- public API --------------------------------------------------------
     def insert(self, learner_id: str, model: Any) -> None:
-        with self._lock:
+        with self._locked(learner_id):
             self._append(learner_id, model)
             if self.policy is EvictionPolicy.LINEAGE_LENGTH:
                 self._evict(learner_id)
@@ -58,25 +123,38 @@ class ModelStore:
         """Latest ≤k models per learner, most recent first. Learners with no
         stored model are omitted (mirrors SelectModels, model_store.h)."""
         out: Dict[str, List[Any]] = {}
-        with self._lock:
-            for lid in learner_ids:
+        for lid in learner_ids:
+            with self._locked(lid):
                 lineage = self._lineage(lid)
-                if lineage:
-                    out[lid] = lineage[:k]
+            if lineage:
+                out[lid] = lineage[:k]
         return out
 
     def erase(self, learner_ids: Sequence[str]) -> None:
-        with self._lock:
-            for lid in learner_ids:
+        for lid in learner_ids:
+            with self._locked(lid):
                 self._erase(lid)
+            # lock-table hygiene for long-churn federations: drop the
+            # entry, but ONLY when uncontended (refcount 0) — a thread
+            # that already fetched it keeps the one true lock object; a
+            # contended entry survives until a later erase prunes it
+            with self._lock:
+                entry = self._learner_locks.get(lid)
+                if entry is not None and entry[1] == 0:
+                    del self._learner_locks[lid]
 
     def learner_ids(self) -> List[str]:
-        with self._lock:
-            return self._learner_ids()
+        return self._learner_ids()
 
     def size(self, learner_id: str) -> int:
-        with self._lock:
+        with self._locked(learner_id):
             return len(self._lineage(learner_id))
+
+    def flush(self) -> None:
+        """Durability fence: persistent backends sync buffered state
+        (batched directory fsyncs on the disk store); in-memory stores
+        no-op. The ingest pipeline calls this at drain barriers so the
+        per-insert hot path never pays an fsync."""
 
     def shutdown(self) -> None:
         pass

@@ -8,10 +8,16 @@ no external service: every model persists to disk (crash-safe, like Redis
 persistence), and a byte-budgeted LRU cache serves hot lineage heads from
 memory — at the 64-learner x ~26 MB-ciphertext scale the resident set stays
 under ``cache_bytes`` instead of growing with the federation.
+
+Concurrency (PR 7): lineage mutations are serialized per learner by the
+base class (store/base.py thread-safety contract); the LRU OrderedDict is
+store-global, so it takes its OWN lock (``_cache_lock``, always acquired
+AFTER a learner lock, never before — no ordering cycle).
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any, List, Tuple
 
@@ -59,38 +65,44 @@ class CachedDiskStore(DiskModelStore):
                  cache_bytes: int = 256 * 1024 * 1024):
         super().__init__(root, policy, lineage_length)
         self.cache_bytes = int(cache_bytes)
-        # (learner_id, seq) -> (nbytes, decoded value); newest at the end
+        # (learner_id, seq) -> (nbytes, decoded value); newest at the end.
+        # Guarded by _cache_lock (the LRU spans learners, so the
+        # per-learner lineage locks cannot protect it).
         self._cache: "OrderedDict[Tuple[str, int], Tuple[int, Any]]" = OrderedDict()
+        self._cache_lock = threading.Lock()
         self._cached_total = 0
         self.cache_hits = 0
         self.cache_misses = 0
 
-    # -- cache plumbing (called under the base class lock) ----------------
+    # -- cache plumbing (thread-safe via _cache_lock) ----------------------
     def _cache_put(self, key: Tuple[str, int], value: Any) -> None:
         if self.cache_bytes <= 0:
             return
         nbytes = _value_nbytes(value)
         if nbytes > self.cache_bytes:
             return  # one oversized model must not evict the whole cache
-        old = self._cache.pop(key, None)
-        if old is not None:
-            self._cached_total -= old[0]
-        self._cache[key] = (nbytes, value)
-        self._cached_total += nbytes
-        while self._cached_total > self.cache_bytes and self._cache:
-            _, (evicted_bytes, _) = self._cache.popitem(last=False)
-            self._cached_total -= evicted_bytes
-        self._publish_gauges()
+        with self._cache_lock:
+            old = self._cache.pop(key, None)
+            if old is not None:
+                self._cached_total -= old[0]
+            self._cache[key] = (nbytes, value)
+            self._cached_total += nbytes
+            while self._cached_total > self.cache_bytes and self._cache:
+                _, (evicted_bytes, _) = self._cache.popitem(last=False)
+                self._cached_total -= evicted_bytes
+            self._publish_gauges()
 
     def _publish_gauges(self) -> None:
+        """Call with ``_cache_lock`` held."""
         _M_CACHE_BYTES.set(self._cached_total)
         _M_CACHE_ENTRIES.set(len(self._cache))
 
     def _cache_drop_learner(self, learner_id: str) -> None:
-        for key in [k for k in self._cache if k[0] == learner_id]:
-            nbytes, _ = self._cache.pop(key)
-            self._cached_total -= nbytes
-        self._publish_gauges()
+        with self._cache_lock:
+            for key in [k for k in self._cache if k[0] == learner_id]:
+                nbytes, _ = self._cache.pop(key)
+                self._cached_total -= nbytes
+            self._publish_gauges()
 
     # -- DiskModelStore overrides -----------------------------------------
     def _append(self, learner_id: str, model: Any) -> int:
@@ -101,16 +113,17 @@ class CachedDiskStore(DiskModelStore):
         return seq
 
     def _cache_fetch(self, learner_id: str, seq: int) -> Any:
-        """Hook for the parallel select() in DiskModelStore."""
-        cached = self._cache.get((learner_id, seq))
-        if cached is not None:
-            self._cache.move_to_end((learner_id, seq))
-            self.cache_hits += 1
-            _M_CACHE_HITS.inc()
-            return cached[1]
-        self.cache_misses += 1
-        _M_CACHE_MISSES.inc()
-        return _MISS
+        """Hook for the per-learner select path in DiskModelStore."""
+        with self._cache_lock:
+            cached = self._cache.get((learner_id, seq))
+            if cached is not None:
+                self._cache.move_to_end((learner_id, seq))
+                self.cache_hits += 1
+                _M_CACHE_HITS.inc()
+                return cached[1]
+            self.cache_misses += 1
+            _M_CACHE_MISSES.inc()
+            return _MISS
 
     def _cache_store(self, learner_id: str, seq: int, value: Any) -> None:
         self._cache_put((learner_id, seq), value)
@@ -135,8 +148,9 @@ class CachedDiskStore(DiskModelStore):
         entries = self._entries(learner_id)
         excess = len(entries) - self.lineage_length
         super()._evict(learner_id)
-        for seq, _ in entries[:max(0, excess)]:
-            dropped = self._cache.pop((learner_id, seq), None)
-            if dropped is not None:
-                self._cached_total -= dropped[0]
-        self._publish_gauges()
+        with self._cache_lock:
+            for seq, _ in entries[:max(0, excess)]:
+                dropped = self._cache.pop((learner_id, seq), None)
+                if dropped is not None:
+                    self._cached_total -= dropped[0]
+            self._publish_gauges()

@@ -1,0 +1,187 @@
+"""Parallel store-ingest pipeline: decouple payload persistence from the
+uplink RPC path.
+
+The round-time wall at large cohorts is ingest, not math (VERDICT weak
+#5: disk inserts ran ~21/s single-threaded — 48 s for a 1024 cohort —
+while aggregation itself took 61-108 ms). The fix is three-fold: the
+store's per-learner lock granularity (store/base.py) lets writes run in
+parallel, the copy-free blob writer (tensor/pytree.py
+``write_named_tensors``) cuts per-insert memory traffic ~4x, and this
+pipeline moves the write off the completion path entirely — the
+controller's completion handler ENQUEUES and returns, a bounded writer
+pool drains the queue into the store, and aggregation fences on
+:meth:`drain` before any ``select`` so it never reads a torn lineage.
+
+Semantics:
+
+- **Bounded**: at most ``max_pending`` models wait in the queue
+  (default ``8 x workers``); past that, ``submit`` blocks the caller —
+  uplink handlers throttle instead of buffering an unbounded cohort of
+  models in controller RAM.
+- **Fenced**: ``drain()`` blocks until every queued write has landed
+  (optionally for one learner only — ``erase`` on leave drains that
+  learner's queued writes before pruning, so a write in flight cannot
+  resurrect an erased lineage). A drain also calls the store's
+  ``flush()`` — the batched-directory-fsync durability point.
+- **Attributed**: the worker measures the ACTUAL write duration and
+  reports it through ``on_insert(learner_id, ms)`` — the controller
+  routes that to the ``store_insert`` phase histogram and the round
+  profile, so per-learner attribution stays honest (the enqueueing RPC
+  thread records nothing — no double count).
+- **Fail-soft**: a write that raises is logged and counted
+  (``errors()``); the learner's contribution is simply absent from the
+  next select, exactly like a malformed payload on the store path.
+
+``model_store.ingest_workers: 0`` (the default) builds no pipeline at
+all — the controller's hot path is then one attribute check and the
+synchronous insert keeps its current contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from metisfl_tpu.store.base import ModelStore
+
+logger = logging.getLogger("metisfl_tpu.store.ingest")
+
+
+class IngestPipeline:
+    """Bounded writer pool draining (learner_id, model) into a store."""
+
+    def __init__(self, store: ModelStore, workers: int,
+                 max_pending: int = 0,
+                 on_insert: Optional[Callable[[str, float], None]] = None,
+                 accept: Optional[Callable[[str], bool]] = None):
+        if workers < 1:
+            raise ValueError("ingest pipeline needs >= 1 worker")
+        self._store = store
+        self._on_insert = on_insert
+        # membership gate, re-checked by the WORKER immediately before
+        # the write: a queued write whose learner was erased between
+        # enqueue and execution (leave() racing a completion) must not
+        # land and resurrect the pruned lineage
+        self._accept = accept
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="store-ingest")
+        self.workers = workers
+        self.max_pending = int(max_pending) or max(8 * workers, 16)
+        self._cond = threading.Condition()
+        # learner_id -> queued-or-writing count (under _cond)
+        self._pending: Dict[str, int] = {}
+        self._pending_total = 0
+        self._error_count = 0
+        self._last_errors: List[str] = []
+        self._closed = False
+
+    # -- enqueue (RPC / completion-handler threads) ------------------------
+    def submit(self, learner_id: str, model: Any,
+               on_success: Optional[Callable[[float], None]] = None) -> None:
+        """Queue one write; blocks only when the bounded queue is full
+        (backpressure toward the transport, not unbounded RSS).
+
+        ``on_success(ms)`` runs in the worker after the write LANDS and
+        strictly before any ``drain()`` fence covering it can return —
+        the controller hangs result-metadata updates off it so a failed
+        (fail-soft) write never pairs fresh metadata with the learner's
+        older stored model."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("ingest pipeline is shut down")
+            while self._pending_total >= self.max_pending:
+                self._cond.wait()
+                if self._closed:
+                    raise RuntimeError("ingest pipeline is shut down")
+            self._pending[learner_id] = self._pending.get(learner_id, 0) + 1
+            self._pending_total += 1
+        try:
+            self._pool.submit(self._write, learner_id, model, on_success)
+        except BaseException:
+            # a shutdown racing this submit: roll the counters back so
+            # drain() fences don't wait on a write that will never run
+            self._settle(learner_id)
+            raise
+
+    def _settle(self, learner_id: str) -> None:
+        with self._cond:
+            count = self._pending.get(learner_id, 1) - 1
+            if count <= 0:
+                self._pending.pop(learner_id, None)
+            else:
+                self._pending[learner_id] = count
+            self._pending_total -= 1
+            self._cond.notify_all()
+
+    # -- worker ------------------------------------------------------------
+    def _write(self, learner_id: str, model: Any,
+               on_success: Optional[Callable[[float], None]]) -> None:
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            if self._accept is not None and not self._accept(learner_id):
+                # erased between enqueue and execution: dropping here is
+                # the leave() path's last line of defense against a
+                # queued write resurrecting a pruned lineage
+                logger.info("ingest write for departed %s dropped",
+                            learner_id)
+                ok = False
+            else:
+                self._store.insert(learner_id, model)
+        except Exception as exc:  # noqa: BLE001 - fail-soft, see docstring
+            ok = False
+            logger.exception("ingest write for %s failed", learner_id)
+            with self._cond:
+                self._error_count += 1
+                self._last_errors.append(f"{learner_id}: {exc!r}")
+                del self._last_errors[:-8]
+        ms = (time.perf_counter() - t0) * 1e3
+        if ok:
+            # success callbacks run BEFORE the pending decrement so a
+            # drain() fence returning implies their effects are visible
+            if self._on_insert is not None:
+                try:
+                    self._on_insert(learner_id, ms)
+                except Exception:  # noqa: BLE001 - best-effort hook
+                    logger.exception("ingest attribution callback failed")
+            if on_success is not None:
+                try:
+                    on_success(ms)
+                except Exception:  # noqa: BLE001 - best-effort hook
+                    logger.exception("ingest success callback failed")
+        self._settle(learner_id)
+
+    # -- fences ------------------------------------------------------------
+    def drain(self, learner_id: Optional[str] = None,
+              timeout: Optional[float] = None) -> bool:
+        """Block until queued writes land (all, or one learner's), then
+        flush the store (batched directory fsyncs). Returns False on
+        timeout — the caller decides whether a torn fence is fatal."""
+        if learner_id is None:
+            pred = lambda: self._pending_total == 0  # noqa: E731
+        else:
+            pred = lambda: learner_id not in self._pending  # noqa: E731
+        with self._cond:
+            done = self._cond.wait_for(pred, timeout)
+        if done:
+            self._store.flush()
+        return done
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return self._pending_total
+
+    def errors(self) -> Tuple[int, List[str]]:
+        with self._cond:
+            return self._error_count, list(self._last_errors)
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Drain (bounded) and stop the workers; further submits raise."""
+        self.drain(timeout=timeout)
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._pool.shutdown(wait=True)

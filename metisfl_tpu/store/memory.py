@@ -1,4 +1,10 @@
-"""In-memory model store (reference hash_map_model_store.cc:1-123)."""
+"""In-memory model store (reference hash_map_model_store.cc:1-123).
+
+Concurrency: per-learner list mutations are serialized by the base
+class's per-learner locks (store/base.py thread-safety contract); the
+outer dict is touched only through GIL-atomic single operations
+(defaultdict item access, ``pop``, ``list(keys())``), so the store-global
+registry lock is never needed on the hot path."""
 
 from __future__ import annotations
 

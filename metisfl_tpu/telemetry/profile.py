@@ -257,6 +257,11 @@ class RoundProfile:
     # store-layer time: per-model insert (overlaps wait_uplinks) and the
     # aggregation path's lineage selects
     store: Dict[str, float] = field(default_factory=dict)
+    # overlay timings recorded via note_phase that OVERLAP the tiled
+    # waterfall rather than extending it (stream_fold inside
+    # wait_uplinks, ingest_drain/select inside aggregate) — kept out of
+    # ``phases`` so its coverage invariant holds
+    extras: Dict[str, float] = field(default_factory=dict)
     # learner → {uplink_bytes, downlink_bytes, codec_encode_s,
     #            codec_decode_s, insert_ms, device{...}}
     learners: Dict[str, Dict[str, Any]] = field(default_factory=dict)
@@ -484,6 +489,7 @@ class ProfileCollector:
                 or phases["aggregate"], 3),
             store={"insert_ms": round(sum(insert_ms.values()), 3),
                    "select_ms": round(select_ms, 3)},
+            extras={k: round(v, 3) for k, v in sorted(extra.items())},
             learners=learners,
             totals={"uplink_bytes": float(sum(uplink.values())),
                     "downlink_bytes": float(sum(downlink.values()))},

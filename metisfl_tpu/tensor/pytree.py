@@ -11,6 +11,7 @@ exact same tree structure.
 
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -38,6 +39,10 @@ _MAGIC = b"MTFB"  # metisfl-tpu federated blob
 # would silently poison an aggregation. v1 blobs (pre-integrity
 # checkpoints) still parse — unverified.
 _BLOB_VERSION = 2
+# v3: length-framed, crc field written as zero and never verified —
+# store-local files only (write_named_tensors(checksum=False)); the wire
+# always ships v2
+_BLOB_VERSION_NOCRC = 3
 
 # Payloads rejected by the integrity framing (length or checksum). The
 # RPC layer surfaces the ValueError as INVALID_ARGUMENT; the controller's
@@ -143,13 +148,24 @@ class ModelBlob:
         ])
 
     @classmethod
-    def from_bytes(cls, buf, copy: bool = True) -> "ModelBlob":
+    def from_bytes(cls, buf, copy: bool = True,
+                   allow_nocrc: bool = False) -> "ModelBlob":
+        """``allow_nocrc=True`` accepts the v3 store-local variant; the
+        default REJECTS it so a wire payload whose version byte got
+        flipped (or a peer deliberately shipping v3) cannot sidestep the
+        v2 integrity framing — only the disk store's own read path,
+        whose files it wrote itself, opts in (docs/SCALE.md)."""
         view = memoryview(buf)
         if bytes(view[:4]) != _MAGIC:
             raise ValueError("not a metisfl-tpu model blob")
         version, count = struct.unpack_from("<BI", view, 4)
         offset = 9
-        if version == 2:
+        if version == 3 and not allow_nocrc:
+            _M_CORRUPT.inc()
+            raise ValueError(
+                "unchecksummed v3 model blob rejected outside the store "
+                "read path (wire payloads must carry the v2 crc framing)")
+        if version in (2, 3):
             try:
                 body_len, crc = struct.unpack_from("<QI", view, offset)
             except struct.error:
@@ -163,7 +179,10 @@ class ModelBlob:
                     f"model blob length mismatch (framed {body_len} body "
                     f"bytes, have {len(body)}) — truncated or spliced "
                     "payload")
-            if zlib.crc32(body) != crc:
+            # v3 (store-local, write_named_tensors(checksum=False)) is
+            # length-framed only: truncation still rejects, the model was
+            # crc-verified at the wire before it ever reached the store
+            if version == 2 and zlib.crc32(body) != crc:
                 _M_CORRUPT.inc()
                 raise ValueError(
                     "model blob checksum mismatch — corrupt payload "
@@ -182,6 +201,75 @@ class ModelBlob:
             else:
                 blob.opaque[name] = (value, spec)
         return blob
+
+
+def write_named_tensors(fd: int, named: NamedTensors,
+                        checksum: bool = True) -> int:
+    """Stream a tensors-only blob to an open file descriptor with ZERO
+    staging copies; with ``checksum=True`` the file bytes are identical
+    to ``ModelBlob(tensors=named).to_bytes()``.
+
+    ``to_bytes`` pays three full-model memcpys (per-tensor ``tobytes``,
+    the body join, the framing join) before the file write — ~3x the
+    model size in pure memory traffic, which is what capped disk-store
+    ingest at ~21 models/s (VERDICT weak #5, BENCH_r05). Here each
+    tensor contributes a read-only ``memoryview`` straight over its
+    buffer: the crc folds incrementally across the views and ``writev``
+    gathers them into the file, so the only model-sized copy left is the
+    kernel's. Returns the number of bytes written.
+
+    ``checksum=False`` writes the v3 length-framed variant: same layout,
+    crc field zero and never verified. For STORE-LOCAL files only
+    (docs/SCALE.md): the uplink was already crc-checked at the RPC
+    decode, ``os.replace`` keeps half-written files from ever appearing
+    under their final name, and the length frame still rejects
+    truncation — re-hashing the model on every insert AND select was
+    pure hot-path overhead (~half the write cost at bench model size).
+    Wire blobs keep the v2 checksum."""
+    chunks: List = []
+    for name, arr in named:
+        arr = np.asarray(arr)
+        if arr.dtype.byteorder == ">":  # wire is little-endian (spec.py)
+            arr = arr.astype(arr.dtype.newbyteorder("="))
+        # header shape BEFORE ascontiguousarray: it promotes 0-d scalars
+        # to 1-d, which would change the wire header vs tensor_to_bytes
+        shape = arr.shape
+        arr = np.ascontiguousarray(arr)
+        nb = name.encode("utf-8")
+        from metisfl_tpu.tensor.spec import _header_bytes, wire_dtype_of
+
+        chunks.append(struct.pack("<H", len(nb)) + nb + _header_bytes(
+            TensorSpec(shape, wire_dtype_of(arr.dtype),
+                       TensorKind.PLAINTEXT), arr.nbytes))
+        # flat byte view — keeps the (possibly temporary contiguous)
+        # array alive through the write, no serialization copy
+        chunks.append(arr.data.cast("B"))
+    body_len = sum(len(c) for c in chunks)
+    crc = 0
+    if checksum:
+        for c in chunks:
+            crc = zlib.crc32(c, crc)
+    header = b"".join([
+        _MAGIC,
+        struct.pack("<BI",
+                    _BLOB_VERSION if checksum else _BLOB_VERSION_NOCRC,
+                    len(named)),
+        struct.pack("<QI", body_len, crc),
+    ])
+    total = len(header) + body_len
+    buffers: List = [header] + chunks
+    if hasattr(os, "writev"):
+        while buffers:
+            written = os.writev(fd, buffers[:64])
+            while buffers and written >= len(buffers[0]):
+                written -= len(buffers[0])
+                buffers.pop(0)
+            if written:
+                buffers[0] = memoryview(buffers[0])[written:]
+    else:  # pragma: no cover - non-POSIX fallback
+        for buf in buffers:
+            os.write(fd, buf)
+    return total
 
 
 def pack_model(params_tree) -> bytes:

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# CI bench-regression gate (ISSUE 7 satellite; docs/SCALE.md).
+#
+# Compares the newest bench capture against the previous one with the
+# direction-aware relative thresholds in `python -m metisfl_tpu.perf`
+# and FAILS the build on a regression — the ingest-throughput keys
+# (cohort_*_insert_s, cohort_*_insert_models_per_sec, round_10k_wall_s)
+# are lower/higher-better classified there, so a slowdown past the
+# threshold exits 1.
+#
+# Usage:
+#   scripts/check_bench.sh PREV.json CURR.json [THRESHOLD]
+#   scripts/check_bench.sh DIR [THRESHOLD]     # DIR holds BENCH_*.json;
+#                                              # compares the last two
+#
+# Exit codes: 0 clean / improved, 1 regression (build must fail),
+# 2 unparseable capture (fails the build too — a capture that cannot be
+# judged must not pass silently).
+set -u -o pipefail
+
+usage() { sed -n '2,15p' "$0"; exit 2; }
+
+PYTHON="${PYTHON:-python}"
+THRESHOLD=""
+
+case "$#" in
+  1) TARGET_DIR="$1" ;;
+  2) if [ -d "$1" ]; then TARGET_DIR="$1"; THRESHOLD="$2";
+     else PREV="$1"; CURR="$2"; fi ;;
+  3) PREV="$1"; CURR="$2"; THRESHOLD="$3" ;;
+  *) usage ;;
+esac
+
+if [ -n "${TARGET_DIR:-}" ]; then
+  # newest two captures by name order (BENCH_r01.json < BENCH_r02.json ...)
+  mapfile -t CAPTURES < <(ls "$TARGET_DIR"/BENCH_*.json 2>/dev/null | sort)
+  if [ "${#CAPTURES[@]}" -lt 2 ]; then
+    echo "check_bench: need >= 2 BENCH_*.json captures in $TARGET_DIR," \
+         "found ${#CAPTURES[@]} — nothing to compare (pass)" >&2
+    exit 0
+  fi
+  PREV="${CAPTURES[-2]}"
+  CURR="${CAPTURES[-1]}"
+fi
+
+echo "check_bench: $PREV -> $CURR (threshold ${THRESHOLD:-default})"
+if [ -n "$THRESHOLD" ]; then
+  "$PYTHON" -m metisfl_tpu.perf --compare "$PREV" "$CURR" \
+    --threshold "$THRESHOLD"
+else
+  "$PYTHON" -m metisfl_tpu.perf --compare "$PREV" "$CURR"
+fi
+rc=$?
+case "$rc" in
+  0) echo "check_bench: PASS (no regression past threshold)" ;;
+  1) echo "check_bench: FAIL — bench regression (see rows above)" >&2 ;;
+  2) echo "check_bench: FAIL — unparseable capture (a result that" \
+          "cannot be judged must not pass)" >&2 ;;
+  *) echo "check_bench: FAIL — perf CLI exited $rc" >&2 ;;
+esac
+exit "$rc"
