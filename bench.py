@@ -1050,6 +1050,45 @@ def bench_cohort(sizes=(1024, 4096), stride: int = 64,
     return out
 
 
+def bench_churn():
+    """Cross-device churn probe (ISSUE 9): the seeded 1024-virtual-client
+    federation from metisfl_tpu/driver/crossdevice.py — per-round
+    sampling at quorum 12 (over-provisioned 2x), 30% per-round dropout
+    plus one flapping and one partitioned learner — measured for quorum
+    round wall-clock and the RSS bound. Host-side (the harness stresses
+    the controller's scheduling planes, not device math); keys are
+    direction-classified for ``python -m metisfl_tpu.perf --trajectory``
+    (wall/rss lower-better, rounds_per_sec/accuracy higher-better)."""
+    import statistics
+
+    from metisfl_tpu.driver.crossdevice import ChurnScenario, run_scenario
+
+    res = run_scenario(ChurnScenario(
+        seed=7, clients=1024, rounds=5, quorum=12, overprovision=1.0,
+        dropout=0.3, timeout_s=180.0))
+    walls = res.get("round_walls_s") or [0.0]
+    out = {
+        "round_churn_clients": res["clients"],
+        "round_churn_quorum": res["quorum"],
+        "round_churn_rounds": res["rounds_completed"],
+        "round_churn_ok": bool(res["ok"]),
+        "round_churn_wall_s": res["wall_s"],
+        "round_churn_join_s": res["join_s"],
+        "round_churn_round_ms_median": round(
+            1e3 * statistics.median(walls), 1),
+        "round_churn_rounds_per_sec": round(
+            res["rounds_completed"] / max(res["wall_s"], 1e-9), 2),
+        "round_churn_accuracy": res["accuracy"],
+        "round_churn_faults_injected": sum(res["faults"].values()),
+        "round_churn_peak_rss_kb": res["peak_rss_kb"],
+        "round_churn_rss_growth_kb": res["rss_growth_kb"],
+        # the bounding claim: a 1024-client churn federation must not
+        # grow the controller by more than 256 MiB over the run
+        "round_churn_bounded": bool(res["rss_growth_kb"] < (256 << 10)),
+    }
+    return out
+
+
 def bench_lora(require_tpu: bool = True):
     """Single-chip LoRA execution proof (VERDICT r4 #7): a ~1.2B-param
     frozen bf16 LlamaLite base + rank-16 adapters on q/v, real optimizer
@@ -1123,6 +1162,7 @@ _SECTIONS = {
     "cohort": lambda a: bench_cohort(),
     "health": lambda a: bench_health(),
     "serving": lambda a: bench_serving(),
+    "churn": lambda a: bench_churn(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1338,7 +1378,7 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
                      "e2e": 600, "cohort": 1200, "health": 240,
-                     "serving": 300, "lora": 600}
+                     "serving": 300, "churn": 240, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1385,7 +1425,7 @@ WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
 _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
-_HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving")
+_HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn")
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_partial.json")
 

@@ -57,7 +57,8 @@ def streaming_supported(rule_name: str, protocol: str,
                         secure_enabled: bool,
                         store_lineage_length: int,
                         required_lineage: int,
-                        checkpointed: bool = False) -> bool:
+                        checkpointed: bool = False,
+                        buffer_size: int = 0) -> bool:
     """Can the controller fold uplinks on arrival for this federation?
 
     - only the weighted-sum rules (robust/fednova/serveropt need full
@@ -67,24 +68,32 @@ def streaming_supported(rule_name: str, protocol: str,
       history than the rule needs wants the store written — skipping it
       would silently break that contract;
     - ``fedavg``/``fedstride`` are round-scoped sums over the sync
-      barrier's cohort; under the asynchronous protocol the selector
-      aggregates ALL active learners' stored lineage on every single
-      completion, which only the store can serve. ``fedrec`` is the
-      async streaming rule (its rolling state IS the lineage);
+      barrier's cohort; under the plain asynchronous protocol the
+      selector aggregates ALL active learners' stored lineage on every
+      single completion, which only the store can serve. ``fedrec`` is
+      the async streaming rule (its rolling state IS the lineage);
+    - under ``asynchronous_buffered`` the aggregating cohort is exactly
+      the buffer, so fedavg/fedstride stream per buffer-fill — but only
+      with ``buffer_size >= 2``: a 1-deep buffer degenerates to plain
+      async (the cardinality selector then widens a single-reporter
+      schedule to all active learners, which needs the store);
     - ``fedrec`` + checkpointing needs the store written: crash-restore
       rehydrates the cross-round rolling sum FROM store lineage
       (controller ``rehydrate``), and a zero-store round path would make
       ``--resume`` silently restore 0 contributions. fedavg/fedstride
-      are round-scoped — a resumed round re-dispatches from scratch, so
-      they stream safely under checkpointing.
+      are round/buffer-scoped — a resumed round re-dispatches from
+      scratch, so they stream safely under checkpointing.
     """
     rule = rule_name.lower()
     if rule not in STREAMING_RULES or secure_enabled:
         return False
     if store_lineage_length > required_lineage:
         return False
-    if rule in ("fedavg", "fedstride") and protocol == "asynchronous":
-        return False
+    if rule in ("fedavg", "fedstride"):
+        if protocol == "asynchronous":
+            return False
+        if protocol == "asynchronous_buffered" and buffer_size < 2:
+            return False
     if rule == "fedrec" and checkpointed:
         return False
     return True

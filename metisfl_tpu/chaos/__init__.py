@@ -2,8 +2,11 @@
 
 Jepsen-style chaos as a first-class subsystem: seeded, reproducible fault
 profiles (drop → UNAVAILABLE, delay, hang, payload corruption,
-process-kill-at-phase) hooked into :mod:`metisfl_tpu.comm.rpc` on both the
-client and server side of every bytes method. The recovery machinery this
+process-kill-at-phase, periodic flap windows, scaled-train-duration slow
+learners, and timed network partitions) hooked into
+:mod:`metisfl_tpu.comm.rpc` on both the client and server side of every
+bytes method (``slow`` is consumed by the learner train loop instead —
+a slow survivor is not a wire fault). The recovery machinery this
 exercises — straggler deadlines, learner rejoin, controller failover —
 is only trustworthy if the faults that trigger it are reproducible, so
 every injector runs off one seeded RNG and a fixed rule list.

@@ -27,10 +27,25 @@ Faults:
   model blobs must reject the result, not deserialize garbage weights).
 - ``kill``     — ``os._exit(137)``: the crash-at-phase primitive (e.g.
   kill the controller the first time a completion arrives = mid-round).
+- ``flap``     — periodic leave/rejoin as the wire sees it: calls landing
+  in the down window of each ``period_s`` cycle (first ``down_s``
+  seconds, default half the period) raise UNAVAILABLE; calls in the up
+  phase pass. The cycle anchors at the rule's first matched call.
+- ``slow``     — scaled train duration: the learner's train loop asks
+  :meth:`ChaosInjector.train_slowdown` after each task and stretches its
+  wall-clock by ``factor`` (default 2.0). RPC-path inert by design — the
+  point is a slow *survivor*, not a dead wire.
+- ``partition``— drop ALL matching traffic for one window: calls between
+  ``after_s`` and ``after_s + window_s`` (from the rule's first matched
+  call) raise UNAVAILABLE. Process subsets come from the existing
+  ``process``/``side``/``method`` routing — e.g. partition one learner
+  from the controller while the rest keep training.
 
 Counting (``after_calls`` skip window, ``max_fires`` budget) is exact and
 deterministic; ``prob`` draws come from the one seeded RNG, so a fixed
-seed and call sequence replays the identical fault schedule.
+seed and call sequence replays the identical fault schedule. ``flap`` and
+``partition`` windows are wall-clock relative to the rule's first match —
+deterministic in phase structure, not in exact call counts.
 """
 
 from __future__ import annotations
@@ -84,7 +99,8 @@ class FaultRule:
     ``process`` is driver-side routing only (which subprocess gets the
     rule) and is ignored by the injector itself."""
 
-    fault: str                    # drop | delay | hang | corrupt | kill
+    fault: str                    # drop | delay | hang | corrupt | kill |
+                                  # flap | slow | partition
     side: str = ""                # client | server | "" (both)
     service: str = ""
     method: str = ""
@@ -93,11 +109,21 @@ class FaultRule:
     after_calls: int = 0          # skip the first N matching calls
     max_fires: int = 0            # 0 = unlimited
     delay_s: float = 0.0          # delay/hang duration (hang: 0 → 3600)
+    # flap: leave/rejoin cycle length and the down window inside it
+    period_s: float = 0.0         # 0 → 10 s cycle
+    down_s: float = 0.0           # 0 → period_s / 2
+    # partition: window offset + duration from the rule's first match
+    after_s: float = 0.0
+    window_s: float = 0.0         # 0 → 10 s
+    # slow: train wall-clock multiplier applied by the learner hook
+    factor: float = 0.0           # 0 → 2.0
     # runtime counters (not part of the spec)
     matched: int = field(default=0, compare=False)
     fired: int = field(default=0, compare=False)
+    anchor: float = field(default=0.0, compare=False)  # first-match clock
 
-    _FAULTS = ("drop", "delay", "hang", "corrupt", "kill")
+    _FAULTS = ("drop", "delay", "hang", "corrupt", "kill",
+               "flap", "slow", "partition")
 
     def __post_init__(self):
         if self.fault not in self._FAULTS:
@@ -120,7 +146,7 @@ class ChaosInjector:
     @classmethod
     def from_spec(cls, spec: Dict) -> "ChaosInjector":
         known = {f for f in FaultRule.__dataclass_fields__
-                 if f not in ("matched", "fired")}
+                 if f not in ("matched", "fired", "anchor")}
         rules = []
         for raw in spec.get("rules", []):
             unknown = set(raw) - known
@@ -136,6 +162,10 @@ class ChaosInjector:
         on delay/hang, exits the process on kill."""
         for rule in self.rules:
             with self._lock:
+                if rule.fault == "slow":
+                    # RPC-path inert: the learner's train loop consumes
+                    # slow rules through train_slowdown()
+                    continue
                 if not rule.matches(side, service, method):
                     continue
                 rule.matched += 1
@@ -145,6 +175,25 @@ class ChaosInjector:
                     continue
                 if rule.prob < 1.0 and self._rng.random() >= rule.prob:
                     continue
+                if rule.fault in ("flap", "partition"):
+                    # time-windowed faults: the cycle/window anchors at
+                    # the rule's first eligible call; calls outside the
+                    # down window pass untouched (and do not count as
+                    # fires — max_fires budgets actual outages)
+                    now = time.monotonic()
+                    if rule.anchor == 0.0:
+                        rule.anchor = now
+                    elapsed = now - rule.anchor
+                    if rule.fault == "flap":
+                        period = rule.period_s or 10.0
+                        down = rule.down_s or period / 2.0
+                        if (elapsed % period) >= down:
+                            continue  # up phase: the learner is "joined"
+                    else:
+                        start = rule.after_s
+                        window = rule.window_s or 10.0
+                        if not (start <= elapsed < start + window):
+                            continue  # outside the partition window
                 rule.fired += 1
             _M_FAULTS.inc(fault=rule.fault, side=side, method=method)
             _tevents.emit(_tevents.FaultInjected, fault=rule.fault,
@@ -162,7 +211,7 @@ class ChaosInjector:
                 # diagnosable crash
                 logging.shutdown()
                 os._exit(_KILL_EXIT_CODE)
-            if rule.fault == "drop":
+            if rule.fault in ("drop", "flap", "partition"):
                 raise FaultInjected("UNAVAILABLE", rule)
             if rule.fault == "delay":
                 time.sleep(rule.delay_s)
@@ -171,6 +220,35 @@ class ChaosInjector:
             elif rule.fault == "corrupt":
                 payload = self._corrupt(payload)
         return payload
+
+    def train_slowdown(self) -> float:
+        """The train wall-clock multiplier from armed ``slow`` rules (the
+        learner's train loop calls this once per completed task and
+        sleeps the extra time — a *slow survivor*, which only straggler
+        deadlines / quorum barriers can defend against, unlike a dead
+        wire the retry ladder sees). Returns 1.0 with no eligible rule;
+        each eligible rule's application counts one fire toward its
+        ``max_fires`` budget."""
+        factor = 1.0
+        for rule in self.rules:
+            if rule.fault != "slow":
+                continue
+            with self._lock:
+                rule.matched += 1
+                if rule.matched <= rule.after_calls:
+                    continue
+                if rule.max_fires and rule.fired >= rule.max_fires:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+            _M_FAULTS.inc(fault="slow", side="learner", method="Train")
+            _tevents.emit(_tevents.FaultInjected, fault="slow",
+                          side="learner", method="Train")
+            factor = max(factor, rule.factor or 2.0)
+        if factor > 1.0:
+            logger.warning("chaos: slowing train task by %.1fx", factor)
+        return factor
 
     @staticmethod
     def _corrupt(payload: bytes) -> bytes:

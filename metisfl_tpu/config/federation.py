@@ -30,6 +30,51 @@ class TerminationConfig:
 
 
 @dataclass
+class SchedulingConfig:
+    """Churn-tolerant round scheduling (docs/RESILIENCE.md "Cross-device
+    churn"): quorum barriers, FedBuff buffer sizing, churn-aware
+    admission, and bounded dispatch retry. Every plane here is opt-out:
+    the defaults reduce each controller hot path to one attribute check
+    and keep round behavior bit-identical to the plain barriers."""
+
+    # K-of-N quorum for sync/semi-sync rounds: the round releases the
+    # moment `quorum` dispatched learners reported (reporters become the
+    # cohort; the stragglers' tasks expire exactly like deadline drops).
+    # 0 = full-cohort barrier (today's behavior, bit-identical); any
+    # quorum >= the dispatched size is likewise the full barrier.
+    quorum: int = 0
+    # over-provisioned dispatch (Oort-style): with a quorum configured,
+    # each round dispatches ceil(quorum * (1 + overprovision)) learners
+    # so ~30% per-round dropout still leaves a quorum of reporters
+    overprovision: float = 0.0
+    # protocol=asynchronous_buffered: uplinks fold into a buffer of this
+    # many reporters; aggregation triggers per buffer-fill (FedBuff K)
+    buffer_size: int = 10
+    # churn/flap scoring (selection.py ChurnTracker): EWMA of leave /
+    # flap-rejoin / failed-dispatch events per learner, alongside the
+    # straggler and divergence scores. false: no tracker constructed
+    # (one attribute check on every membership path)
+    churn_tracking: bool = True
+    churn_alpha: float = 0.3
+    # quarantine: a churn event lifting a learner's score past this
+    # excludes it from cohort sampling for quarantine_s seconds
+    # (0 = scoring only, never quarantine)
+    quarantine_score: float = 0.0
+    quarantine_s: float = 30.0
+    # bounded dispatch retry-with-backoff: when a train dispatch provably
+    # fails, drop the dead learner from the round barrier and dispatch a
+    # replacement learner after backoff, up to this many retries per
+    # round (0 = off: a failed dispatch stalls to the deadline, today's
+    # behavior). Doubles retry_backoff_s per consecutive retry.
+    dispatch_retries: int = 0
+    retry_backoff_s: float = 0.5
+    # consecutive zero-reporter round deadlines tolerated before the
+    # round HALTS with a lineage error instead of re-dispatching forever
+    # (0 = unbounded re-dispatch, today's behavior)
+    max_empty_redispatch: int = 8
+
+
+@dataclass
 class TreeAggregationConfig:
     """Tree-aggregation tier (aggregation/tree.py): partition the cohort
     into ``branch`` slices, fold each in a worker (parallel store selects
@@ -357,7 +402,9 @@ class LearnerEndpoint:
 
 @dataclass
 class FederationConfig:
-    protocol: str = "synchronous"            # synchronous | semi_synchronous | asynchronous
+    protocol: str = "synchronous"            # synchronous | semi_synchronous |
+                                             # asynchronous |
+                                             # asynchronous_buffered
     semi_sync_lambda: float = 1.0
     semi_sync_recompute_every_round: bool = False
     # Straggler deadline for sync/semi-sync rounds: a dispatched learner that
@@ -371,6 +418,7 @@ class FederationConfig:
     # until it completes a task or rejoins (the reference only logs failed
     # dispatches and keeps scheduling them, controller.cc:783-786). 0 → off.
     max_dispatch_failures: int = 3
+    scheduling: SchedulingConfig = field(default_factory=SchedulingConfig)
     aggregation: AggregationConfig = field(default_factory=AggregationConfig)
     model_store: ModelStoreConfig = field(default_factory=ModelStoreConfig)
     secure: SecureAggConfig = field(default_factory=SecureAggConfig)
@@ -405,16 +453,63 @@ class FederationConfig:
                 "masking secure aggregation requires the 'participants' "
                 "scaler (pairwise masks only cancel under uniform scales)")
         if (self.secure.enabled and self.secure.scheme == "masking"
-                and self.protocol == "asynchronous"):
+                and self.protocol.startswith("asynchronous")):
             # Pairwise masks only cancel when ALL parties' payloads enter one
-            # combine — structurally a synchronous barrier. Async secure
-            # federations need a partial-cohort-capable scheme (ckks).
+            # combine — structurally a synchronous barrier (a FedBuff
+            # buffer is a partial cohort too). Async secure federations
+            # need a partial-cohort-capable scheme (ckks).
             raise ValueError(
                 "masking secure aggregation requires a synchronous or "
                 "semi-synchronous protocol; use scheme='ckks' for "
                 "asynchronous secure federations")
-        if self.protocol not in ("synchronous", "semi_synchronous", "asynchronous"):
+        if self.protocol not in ("synchronous", "semi_synchronous",
+                                 "asynchronous", "asynchronous_buffered"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
+        sched = self.scheduling
+        if sched.quorum < 0:
+            raise ValueError("scheduling.quorum must be >= 0")
+        if sched.quorum > 0 and self.protocol.startswith("asynchronous"):
+            # the asynchronous protocols have no round barrier a quorum
+            # could shorten — a silently ignored knob would "validate"
+            # churn tolerance that was never armed
+            raise ValueError(
+                "scheduling.quorum requires a synchronous or "
+                "semi-synchronous protocol (asynchronous rounds have no "
+                "barrier; use scheduling.buffer_size for "
+                "asynchronous_buffered)")
+        if sched.overprovision < 0.0:
+            raise ValueError("scheduling.overprovision must be >= 0")
+        if sched.overprovision > 0.0 and sched.quorum <= 0:
+            # only the quorum sampler reads overprovision — accepting it
+            # alone would silently arm nothing (same posture as the
+            # quorum/asynchronous rejection above)
+            raise ValueError(
+                "scheduling.overprovision requires scheduling.quorum > 0 "
+                "(over-provisioning sizes the quorum dispatch)")
+        if sched.buffer_size < 1:
+            raise ValueError("scheduling.buffer_size must be >= 1")
+        if not 0.0 < sched.churn_alpha <= 1.0:
+            # same posture as telemetry.health.alpha: a typo'd blend
+            # weight would silently freeze or unsmooth every churn score
+            raise ValueError("scheduling.churn_alpha must be in (0, 1]")
+        if sched.quarantine_score < 0.0:
+            raise ValueError("scheduling.quarantine_score must be >= 0")
+        if sched.quarantine_score > 0.0 and sched.quarantine_s <= 0.0:
+            raise ValueError(
+                "scheduling.quarantine_s must be > 0 when quarantine is "
+                "armed (a zero-length quarantine never excludes anyone)")
+        if sched.quarantine_score > 0.0 and not sched.churn_tracking:
+            raise ValueError(
+                "scheduling.quarantine_score requires churn_tracking "
+                "(quarantine is driven by the churn scores)")
+        if sched.dispatch_retries < 0:
+            raise ValueError("scheduling.dispatch_retries must be >= 0")
+        if sched.dispatch_retries > 0 and sched.retry_backoff_s <= 0.0:
+            raise ValueError(
+                "scheduling.retry_backoff_s must be > 0 when "
+                "dispatch_retries is armed")
+        if sched.max_empty_redispatch < 0:
+            raise ValueError("scheduling.max_empty_redispatch must be >= 0")
         if self.chaos.enabled:
             # a typo'd fault name must fail at config time, not fire-time
             # (an injector that silently never fires "validates" nothing)
@@ -558,7 +653,7 @@ class FederationConfig:
                     "with secure aggregation (HE/masking payloads have "
                     "their own fixed-point encoding)")
             if (topk_denom is not None
-                    and self.protocol.lower() == "asynchronous"):
+                    and self.protocol.lower().startswith("asynchronous")):
                 # the controller densifies a topk update against ITS
                 # community model; under async that model advances between
                 # dispatch and completion, so the reconstruction reference

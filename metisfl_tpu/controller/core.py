@@ -51,9 +51,10 @@ from metisfl_tpu.comm.messages import (
     TrainTask,
 )
 from metisfl_tpu.config import FederationConfig
-from metisfl_tpu.scaling import apply_staleness_decay, make_scaler, raw_weight
+from metisfl_tpu.scaling import (apply_staleness_decay, make_scaler,
+                                 raw_weight, staleness_factor)
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
-from metisfl_tpu.selection import make_selector
+from metisfl_tpu.selection import ChurnTracker, make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
 from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
@@ -97,6 +98,25 @@ _M_DIVERGENCE = _REG.gauge(
 _M_ROUND_UPDATE_NORM = _REG.gauge(
     _tel.M_ROUND_UPDATE_NORM,
     "L2 norm of the latest community-model update (telemetry/health.py)")
+# churn-tolerant scheduling (quorum barriers, dispatch retry, admission)
+_M_DROPPED = _REG.counter(
+    _tel.M_LEARNER_DROPPED_TOTAL,
+    "Learner contributions dropped from rounds, by cause "
+    "(deadline straggler, quorum straggler, leave, quarantine)",
+    ("reason",))
+_M_DISPATCH_RETRIES = _REG.counter(
+    _tel.M_DISPATCH_RETRIES_TOTAL,
+    "Failed train dispatches retried to replacement learners "
+    "(scheduling.dispatch_retries)")
+_M_REDISPATCH = _REG.counter(
+    _tel.M_ROUNDS_REDISPATCHED_TOTAL,
+    "Rounds abandoned and re-dispatched to a fresh cohort (no-reporter "
+    "deadline, whole-cohort departure, aggregation-failure retry)")
+_M_CHURN = _REG.gauge(
+    _tel.M_LEARNER_CHURN_SCORE,
+    "Churn/flap score: EWMA of leave, flap-rejoin, and failed-dispatch "
+    "events (0 = stable, approaching 1 = flapping; selection.py "
+    "ChurnTracker)", ("learner",))
 
 # EWMA smoothing for per-learner train/eval durations (straggler
 # analytics): ~the last 3-4 rounds dominate, so a recovered learner's
@@ -180,6 +200,12 @@ class RoundMetadata:
     # the contribution weights actually applied this round (post scaler and
     # staleness damping) — reference lineage has nothing comparable
     scales: Dict[str, float] = field(default_factory=dict)
+    # per-uplink dispatch-version lag at aggregation time (rounds the
+    # community model advanced between a task's dispatch and its uplink
+    # entering this aggregate) — nonzero only under the asynchronous
+    # protocols / quorum stragglers; zero entries are omitted so silo
+    # runs' lineage is unchanged
+    staleness: Dict[str, float] = field(default_factory=dict)
     model_insertion_duration_ms: Dict[str, float] = field(default_factory=dict)
     model_size: Dict[str, int] = field(default_factory=dict)
     # bytes each learner actually sent this round (the wire-compression
@@ -264,12 +290,35 @@ class Controller:
         self._scaffold_c_blob: Optional[bytes] = None   # pack cache
         self._scaffold_deltas: Dict[str, bytes] = {}
         self._selector = make_selector("scheduled_cardinality")
+        sched_cfg = config.scheduling
         if config.protocol == "semi_synchronous":
             self._scheduler = make_scheduler(
                 "semi_synchronous", lambda_=config.semi_sync_lambda,
-                recompute_every_round=config.semi_sync_recompute_every_round)
+                recompute_every_round=config.semi_sync_recompute_every_round,
+                quorum=sched_cfg.quorum)
+        elif config.protocol == "asynchronous_buffered":
+            self._scheduler = make_scheduler(
+                "asynchronous_buffered", buffer_size=sched_cfg.buffer_size)
+        elif config.protocol == "synchronous":
+            self._scheduler = make_scheduler("synchronous",
+                                             quorum=sched_cfg.quorum)
         else:
             self._scheduler = make_scheduler(config.protocol)
+        # quorum barrier (scheduling.quorum): 0 = full-cohort barrier —
+        # every quorum hot path below is then one attribute check, and
+        # round behavior is bit-identical to the plain synchronous path
+        self._quorum = (sched_cfg.quorum
+                        if config.protocol in ("synchronous",
+                                               "semi_synchronous") else 0)
+        # churn-aware admission (selection.py ChurnTracker): per-learner
+        # churn/flap scores + optional quarantine. None when opted out —
+        # every membership path then costs one attribute check.
+        self._churn: Optional[ChurnTracker] = None
+        if sched_cfg.churn_tracking:
+            self._churn = ChurnTracker(
+                alpha=sched_cfg.churn_alpha,
+                quarantine_score=sched_cfg.quarantine_score,
+                quarantine_s=sched_cfg.quarantine_s)
 
         store_cfg = config.model_store
         lineage = store_cfg.lineage_length or self._aggregator.required_lineage
@@ -311,7 +360,8 @@ class Controller:
             if streaming_supported(self._aggregator.name, config.protocol,
                                    config.secure.enabled, lineage,
                                    self._aggregator.required_lineage,
-                                   checkpointed=bool(config.checkpoint.dir)):
+                                   checkpointed=bool(config.checkpoint.dir),
+                                   buffer_size=sched_cfg.buffer_size):
                 self._streaming = StreamingAggregator(
                     self._aggregator, stride=agg.stride_length)
             else:
@@ -369,6 +419,17 @@ class Controller:
         # transient partial-cohort failures from a deterministically broken
         # federation, which must halt instead of retraining forever
         self._agg_failures = 0
+        # consecutive zero-reporter round deadlines (reset whenever a round
+        # completes): scheduling.max_empty_redispatch bounds the re-dispatch
+        # loop the deadline path would otherwise spin forever. The halt it
+        # triggers is recoverable: _halted_no_reporters marks it so a later
+        # delivered uplink resumes dispatch (scheduling-executor-only state)
+        self._empty_deadlines = 0
+        self._halted_no_reporters = False
+        # dispatch-retry budget used this round (scheduling.dispatch_retries)
+        # and the live backoff timers shutdown() must cancel
+        self._dispatch_retries_used = 0
+        self._retry_timers: Dict[object, None] = {}
         # guards against recursive checkpointing while restore itself
         # replays the community model through set_community_model
         self._in_restore = False
@@ -432,6 +493,12 @@ class Controller:
         with self._lock:
             if self._deadline_timer is not None:
                 self._deadline_timer.cancel()
+            # dispatch-retry backoff timers must not fire into the
+            # torn-down pool either (their submit is guarded anyway,
+            # but cancel keeps shutdown deterministic)
+            for timer in list(self._retry_timers):
+                timer.cancel()
+            self._retry_timers.clear()
         self._pool.shutdown(wait=True)
         # A task that was already draining on the pool when the first
         # cancel ran may have re-armed the timer (complete-round →
@@ -484,6 +551,7 @@ class Controller:
                               learner_id=record.learner_id,
                               hostname=record.hostname, port=record.port,
                               rejoined=True)
+                self._note_churn(record.learner_id, "flap_rejoin")
                 # Re-dispatch the current community model so a crash-restarted
                 # learner rejoins the in-flight round instead of idling until
                 # the next dispatch (the reference leaves the sync round
@@ -532,6 +600,7 @@ class Controller:
                                   learner_id=match.learner_id,
                                   hostname=match.hostname, port=match.port,
                                   rejoined=True)
+                    self._note_churn(match.learner_id, "flap_rejoin")
                     if not self._shutdown.is_set():
                         self._pool.submit(self._guard, self._schedule_initial,
                                           match.learner_id)
@@ -612,6 +681,10 @@ class Controller:
                               learner_id)
         logger.info("learner %s left", learner_id)
         _tevents.emit(_tevents.LearnerLost, learner_id=learner_id)
+        _M_DROPPED.inc(reason="leave")
+        # churn memory deliberately SURVIVES the leave (a flapper's
+        # history is the signal); only the gauge series is pruned above
+        self._note_churn(learner_id, "leave")
         # Re-evaluate the round barrier: if the departed learner was the last
         # pending one, no completion event would ever release the round.
         if not self._shutdown.is_set():
@@ -625,6 +698,7 @@ class Controller:
         _M_UPLINK.remove(learner=learner_id)
         _M_STRAGGLER.remove(learner=learner_id)
         _M_DIVERGENCE.remove(learner=learner_id)
+        _M_CHURN.remove(learner=learner_id)
         if self._health is not None:
             self._health.drop(learner_id)
         if self._profile is not None:
@@ -636,6 +710,30 @@ class Controller:
             # minted earlier (e.g. before a config change + resume) —
             # those series must never outlive the learner either
             _tprofile.prune_attribution_series(learner_id)
+
+    def _note_churn(self, learner_id: str, event: str) -> None:
+        """Fold one membership event into the learner's churn/flap score
+        (selection.py ChurnTracker) and surface it: gauge (membership-
+        gated under the registry lock, same prune-race posture as the
+        straggler gauge), quarantine event + drop counter when the score
+        newly crosses the threshold. One attribute check when the churn
+        plane is off."""
+        if self._churn is None:
+            return
+        was_quarantined = self._churn.quarantined(learner_id)
+        score = self._churn.note(learner_id, event)
+        with self._lock:
+            if learner_id in self._learners:
+                _M_CHURN.set(round(score, 4), learner=learner_id)
+        if not was_quarantined and self._churn.quarantined(learner_id):
+            _M_DROPPED.inc(reason="quarantine")
+            _tevents.emit(_tevents.LearnerQuarantined,
+                          learner_id=learner_id, score=round(score, 4),
+                          until_s=self._churn.quarantine_s)
+            logger.warning(
+                "learner %s quarantined for %.1fs (churn score %.2f >= "
+                "%.2f after %s)", learner_id, self._churn.quarantine_s,
+                score, self._churn.quarantine_score, event)
 
     def active_learners(self) -> List[str]:
         with self._lock:
@@ -836,6 +934,10 @@ class Controller:
                       learner_id=result.learner_id, round=result.round_id,
                       stale=stale, uplink_bytes=len(result.model))
         self._update_straggler_gauge()
+        # a delivered uplink is the churn score's decay tick: a learner
+        # that reports steadily recovers from past flaps within a few
+        # rounds (same recovery posture as the straggler EWMA)
+        self._note_churn(result.learner_id, "completion")
 
         if stale and self._topk_uplink():
             # a topk payload is a delta against the community model AT
@@ -947,6 +1049,21 @@ class Controller:
                     self._current_meta.epoch_metrics[result.learner_id] = [
                         finite_metrics(epoch)
                         for epoch in result.epoch_metrics]
+        if self._halted_no_reporters:
+            # the no-reporter halt is recoverable by evidence of life: a
+            # delivered uplink (stale or not — every in-flight task was
+            # expired at the halt) proves the federation is reachable
+            # again, so resume dispatch with a fresh sample. The model
+            # above was already stored/streamed like any other.
+            self._halted_no_reporters = False
+            self._empty_deadlines = 0
+            logger.warning("completion from %s after no-reporter halt; "
+                           "resuming dispatch", result.learner_id)
+            self._scheduler.reset()
+            if self._streaming is not None:
+                self._streaming.abandon()
+            self._dispatch_train(self._sample_cohort())
+            return
         if stale:
             logger.info("late completion from %s for expired task %s stored "
                         "but not scheduled", result.learner_id, result.task_id)
@@ -955,7 +1072,19 @@ class Controller:
         to_schedule = self._scheduler.schedule_next(
             result.learner_id, self.active_learners())
         if not to_schedule:
+            if getattr(self._scheduler, "redispatch_on_completion", False):
+                # buffered async (FedBuff): the reporter never idles on
+                # the buffer barrier — it trains against the current
+                # community model while the buffer keeps filling
+                self._dispatch_train([result.learner_id],
+                                     restart_deadline=False)
             return
+        if self._quorum > 0:
+            # quorum release: tasks still in flight belong to the round
+            # that just closed — expire them so their late completions
+            # are stored (fresh lineage) but never advance the NEXT
+            # round's barrier (exactly the deadline path's semantics)
+            self._expire_unreported(to_schedule)
         self._complete_round(to_schedule)
 
     def _handle_membership_change(self) -> None:
@@ -964,6 +1093,8 @@ class Controller:
             return
         cohort = self._scheduler.handle_leave(active)
         if cohort:
+            if self._quorum > 0:
+                self._expire_unreported(cohort)
             self._complete_round(cohort)
             return
         if self._scheduler.round_stalled(active):
@@ -971,10 +1102,48 @@ class Controller:
             # complete: abandon it and dispatch a fresh sample so the
             # surviving learners keep making progress
             logger.info("round abandoned (dispatched cohort left); re-dispatching")
+            _M_REDISPATCH.inc()
             self._scheduler.reset()
             if self._streaming is not None:
                 self._streaming.abandon()
             self._dispatch_train(self._sample_cohort())
+
+    def _expire_tasks_locked(self, pending: Dict[str, str]) -> None:
+        """Move ``pending`` (task_id -> learner_id) to the bounded expired
+        set and prune dispatch stamps down to tasks a completion can
+        still reference (in-flight or expired — the EWMA pop needs
+        them). ONE definition for the quorum and deadline triggers, so
+        their bookkeeping can never diverge. Call with ``self._lock``
+        held."""
+        for tid in pending:
+            self._tasks_in_flight.pop(tid, None)
+        self._expired_tasks.update(dict.fromkeys(pending))
+        while len(self._expired_tasks) > 512:
+            self._expired_tasks.pop(next(iter(self._expired_tasks)))
+        keep = set(self._tasks_in_flight) | set(self._expired_tasks)
+        self._task_dispatched_at = {
+            tid: t for tid, t in self._task_dispatched_at.items()
+            if tid in keep}
+
+    def _expire_unreported(self, cohort: Sequence[str]) -> None:
+        """Quorum release (scheduling.quorum): the releasing cohort is the
+        first K reporters — every task still in flight to a learner
+        outside it belongs to the round that just closed. Move those to
+        the expired set so a straggler's late completion is stored (fresh
+        lineage for later rounds) but never advances the next round's
+        barrier — the same bookkeeping `_handle_deadline` does, with the
+        quorum instead of the clock as the trigger."""
+        cohort_set = set(cohort)
+        with self._lock:
+            pending = {tid: lid for tid, lid in self._tasks_in_flight.items()
+                       if lid not in cohort_set}
+            if not pending:
+                return
+            self._expire_tasks_locked(pending)
+        dropped = sorted(set(pending.values()))
+        _M_DROPPED.inc(len(dropped), reason="quorum")
+        logger.info("quorum reached: expiring %d straggler task(s) from %s",
+                    len(pending), dropped)
 
     # -- straggler deadline ----------------------------------------------
 
@@ -1000,7 +1169,8 @@ class Controller:
             if (not restart and self._deadline_timer is not None
                     and self._deadline_timer.is_alive()):
                 return
-            self._round_serial += 1
+            # the serial advanced in _dispatch_train (every fresh round
+            # dispatch, deadline configured or not) — capture, don't bump
             serial = self._round_serial
             if self._deadline_timer is not None:
                 self._deadline_timer.cancel()
@@ -1027,18 +1197,11 @@ class Controller:
             if serial != self._round_serial:
                 return  # round already completed; stale timer
             pending = dict(self._tasks_in_flight)
-            self._expired_tasks.update(dict.fromkeys(pending))
-            while len(self._expired_tasks) > 512:
-                self._expired_tasks.pop(next(iter(self._expired_tasks)))
-            self._tasks_in_flight.clear()
-            # keep dispatch stamps only for tasks a late completion can
-            # still reference (the bounded expired set) — the EWMA pop
-            # needs them, everything else would leak
-            self._task_dispatched_at = {
-                tid: t for tid, t in self._task_dispatched_at.items()
-                if tid in self._expired_tasks}
+            self._expire_tasks_locked(pending)
         cohort = self._scheduler.expire_pending(self.active_learners())
         dropped = sorted(set(pending.values()))
+        if dropped:
+            _M_DROPPED.inc(len(dropped), reason="deadline")
         if cohort:
             logger.warning(
                 "round deadline (%.1fs) expired; aggregating %d reporter(s), "
@@ -1049,10 +1212,59 @@ class Controller:
             # impossible (< min_recovery_parties survivors) aggregation
             # fails and _complete_round re-dispatches a fresh full cohort
             self._complete_round(cohort)
+            if (getattr(self._scheduler, "redispatch_on_completion", False)
+                    and dropped and not self._shutdown.is_set()):
+                # buffered async: the post-aggregation dispatch only
+                # covers buffer reporters — the expired (dropped)
+                # learners are lost training concurrency and must be
+                # re-dispatched or they idle for the rest of the run
+                revive = self._idle_reporters(dropped)
+                if revive:
+                    self._dispatch_train(revive, restart_deadline=False)
         else:
+            self._empty_deadlines += 1
+            limit = self.config.scheduling.max_empty_redispatch
+            if limit > 0 and self._empty_deadlines >= limit:
+                # nothing has reported for `limit` consecutive deadline
+                # windows: the federation is not making progress and
+                # re-dispatching forever would never terminate — halt
+                # with a clear lineage error (the driver's wall-clock
+                # cutoff or an operator takes it from here; a learner
+                # DELIVERING an uplink later resumes dispatch via the
+                # _halted_no_reporters check in _handle_completed — all
+                # the halted round's tasks were just expired, so the
+                # resume trigger must be explicit, not the barrier)
+                reason = (f"{self._empty_deadlines} consecutive round "
+                          f"deadlines expired with no reporters "
+                          f"(last dropped: {dropped})")
+                logger.error("halting re-dispatch: %s", reason)
+                self._halted_no_reporters = True
+                with self._lock:
+                    self._current_meta.errors.append(
+                        f"round halted: {reason}")
+                    round_sp, self._round_span = self._round_span, None
+                    # close the wait span WITH its round: left open it
+                    # would outlive its ended parent, and the first
+                    # post-resume round would inherit it and book the
+                    # whole halted idle period as wait_uplinks time
+                    wait_sp, self._wait_span = self._wait_span, None
+                    self._phase = "halted"
+                _tevents.emit(_tevents.RoundHalted,
+                              round=self.global_iteration, reason=reason)
+                if wait_sp is not None:
+                    wait_sp.end()
+                    with self._lock:
+                        self._current_meta.wait_duration_ms += \
+                            wait_sp.duration_ms
+                if round_sp is not None:
+                    round_sp.set_attr("error", f"halted: {reason}")
+                    round_sp.end()
+                return
             logger.warning(
                 "round deadline (%.1fs) expired with no reporters (%s); "
-                "re-dispatching", self.config.round_deadline_secs, dropped)
+                "re-dispatching (%d/%s)", self.config.round_deadline_secs,
+                dropped, self._empty_deadlines, limit or "unbounded")
+            _M_REDISPATCH.inc()
             if self._streaming is not None:
                 self._streaming.abandon()
             self._dispatch_train(self._sample_cohort())
@@ -1115,8 +1327,10 @@ class Controller:
             return True
         decay = self.config.aggregation.staleness_decay
         if decay > 0.0:
+            # dispatch-version lag, damped by the same kernel the batch
+            # path applies (scaling.staleness_factor — one definition)
             staleness = max(0, self.global_iteration - result.round_id)
-            weight *= (1.0 + float(staleness)) ** -decay
+            weight *= staleness_factor(staleness, decay)
         t0 = time.perf_counter()
         self._streaming.fold(result.learner_id, model, weight)
         fold_ms = (time.perf_counter() - t0) * 1e3
@@ -1234,14 +1448,15 @@ class Controller:
             logger.warning("aggregation failed (%r); re-dispatching", exc)
             if self._shutdown.is_set():
                 return
-            if self._scheduler.name == "asynchronous":
-                active = self.active_learners()
-                self._dispatch_train([lid for lid in cohort if lid in active])
+            _M_REDISPATCH.inc()
+            if self._scheduler.name.startswith("asynchronous"):
+                self._dispatch_train(self._idle_reporters(cohort))
             else:
                 self._scheduler.reset()
                 self._dispatch_train(self._sample_cohort())
             return
         self._agg_failures = 0
+        self._empty_deadlines = 0
         if self._profile is not None:
             self._profile.note_mark("aggregate_end")
         with self._lock:
@@ -1295,13 +1510,45 @@ class Controller:
         self._maybe_recompute_semisync()
         if self._shutdown.is_set():
             return
-        if self._scheduler.name == "asynchronous":
-            # async: re-dispatch only the reporting learner(s)
-            active = self.active_learners()
-            next_ids = [lid for lid in cohort if lid in active]
+        if self._scheduler.name.startswith("asynchronous"):
+            # async: re-dispatch only the reporting learner(s). Buffered
+            # async re-dispatched most reporters the moment they uplinked
+            # (redispatch_on_completion) — only the fill-triggering
+            # reporter is still idle here, so filter out the busy ones
+            # (plain async cohorts are never in flight at this point).
+            next_ids = self._idle_reporters(cohort)
         else:
             next_ids = self._sample_cohort()
         self._dispatch_train(next_ids)
+
+    def _idle_reporters(self, cohort: Sequence[str]) -> List[str]:
+        """The cohort members that are active and NOT already carrying an
+        in-flight task — the only ones an async-family re-dispatch may
+        target (a double dispatch would cancel a training run mid-task)."""
+        active = set(self.active_learners())
+        with self._lock:
+            busy = set(self._tasks_in_flight.values())
+        return [lid for lid in cohort if lid in active and lid not in busy]
+
+    def _admission_pool(self) -> List[str]:
+        """Dispatchable learners: active, under the consecutive-dispatch-
+        failure limit, and not churn-quarantined. Degrades instead of
+        emptying — an all-dead / all-quarantined registry keeps trying
+        rather than halting."""
+        limit = self.config.max_dispatch_failures
+        with self._lock:
+            pool = [lid for lid, r in self._learners.items()
+                    if limit <= 0 or r.dispatch_failures < limit]
+            if not pool:
+                # every learner looks dead: keep trying rather than halting
+                pool = list(self._learners.keys())
+        if self._churn is not None:
+            quarantined = set(self._churn.quarantined_ids())
+            if quarantined:
+                healthy = [lid for lid in pool if lid not in quarantined]
+                if healthy:  # never quarantine the whole federation
+                    pool = healthy
+        return pool
 
     def _sample_cohort(self) -> List[str]:
         """Sample next round's participants from reachable active learners
@@ -1310,15 +1557,23 @@ class Controller:
 
         Learners with ``max_dispatch_failures`` consecutive failed dispatches
         are skipped until they complete a task or rejoin — a dead endpoint
-        must not keep re-entering sync barriers (SURVEY.md §5.3)."""
+        must not keep re-entering sync barriers (SURVEY.md §5.3) — and
+        churn-quarantined learners sit out until their window expires.
+
+        With a quorum configured the dispatch is over-provisioned
+        (Oort-style): ``ceil(quorum * (1 + overprovision))`` learners get
+        tasks so the expected per-round dropout still leaves K reporters;
+        ``participation_ratio`` is ignored in that mode (the quorum gives
+        an absolute cohort size, the ratio a relative one)."""
+        pool = self._admission_pool()
+        if self._quorum > 0:
+            k = math.ceil(self._quorum
+                          * (1.0 + self.config.scheduling.overprovision))
+            k = max(1, min(len(pool), k))
+            if k >= len(pool):
+                return pool
+            return random.sample(pool, k)
         ratio = self.config.aggregation.participation_ratio
-        limit = self.config.max_dispatch_failures
-        with self._lock:
-            pool = [lid for lid, r in self._learners.items()
-                    if limit <= 0 or r.dispatch_failures < limit]
-            if not pool:
-                # every learner looks dead: keep trying rather than halting
-                pool = list(self._learners.keys())
         if ratio >= 1.0 or not pool:
             return pool
         k = max(1, int(round(ratio * len(pool))))
@@ -1579,6 +1834,12 @@ class Controller:
             meta.selected_learners = list(selected)
             meta.scales = {lid: round(float(w), 6)
                            for lid, w in scales.items()}
+            # per-uplink dispatch-version lag (FedBuff staleness-aware
+            # scaling's input) — nonzero entries only, so synchronous
+            # silo lineage serializes unchanged
+            meta.staleness = {
+                lid: float(m["staleness"])
+                for lid, m in metadata.items() if m.get("staleness")}
             meta.aggregation_block_sizes = meta_blocks
             meta.aggregation_block_duration_ms = meta_durations
             meta.aggregation_duration_ms = agg_sp.duration_ms
@@ -1753,6 +2014,16 @@ class Controller:
         if blob is None:
             logger.warning("no community model yet; cannot dispatch train tasks")
             return
+        if restart_deadline:
+            with self._lock:
+                # a fresh round dispatch renews the per-round retry budget
+                # (rejoin/replacement single-learner dispatches do not) and
+                # advances the round serial — the staleness fence for BOTH
+                # the deadline timer and the retry backoff timers. The bump
+                # lives here, not in _arm_round_deadline, so the fence works
+                # even with round_deadline_secs=0 (no deadline to arm).
+                self._dispatch_retries_used = 0
+                self._round_serial += 1
         # The dispatched set is the synchronous round barrier (participation
         # sampling means it can be a strict subset of the active learners).
         self._scheduler.notify_dispatched(list(learner_ids))
@@ -1876,6 +2147,98 @@ class Controller:
                 "learner %s unreachable after %d failed dispatches (%r); "
                 "excluded from cohort sampling until it reports or rejoins",
                 learner_id, count, exc)
+        self._note_churn(learner_id, "dispatch_failure")
+        self._maybe_retry_dispatch(learner_id)
+
+    def _maybe_retry_dispatch(self, failed_id: str) -> None:
+        """Bounded dispatch retry-with-backoff (scheduling.dispatch_retries):
+        a provably failed dispatch schedules a replacement dispatch after
+        doubling backoff, up to the per-round budget. Off (the default)
+        this is one attribute check and a failed dispatch keeps today's
+        stall-until-deadline behavior."""
+        cfg = self.config.scheduling
+        if cfg.dispatch_retries <= 0 or self._shutdown.is_set():
+            return
+        with self._lock:
+            if self._dispatch_retries_used >= cfg.dispatch_retries:
+                return
+            self._dispatch_retries_used += 1
+            attempt = self._dispatch_retries_used
+            # staleness fence, same posture as the deadline timer: a
+            # backoff timer armed for round N must not fire actions into
+            # round N+1 (the serial advances per deadline re-arm)
+            serial = self._round_serial
+        delay = cfg.retry_backoff_s * (2 ** (attempt - 1))
+
+        def _fire():
+            with self._lock:
+                self._retry_timers.pop(timer, None)
+            if self._shutdown.is_set():
+                return
+            try:
+                self._pool.submit(self._guard, self._retry_dispatch,
+                                  failed_id, attempt, serial)
+            except RuntimeError:  # pool already shut down
+                pass
+
+        timer = threading.Timer(delay, _fire)
+        timer.daemon = True
+        with self._lock:
+            if self._shutdown.is_set():
+                return
+            self._retry_timers[timer] = None
+        timer.start()
+
+    def _retry_dispatch(self, failed_id: str, attempt: int,
+                        serial: int = 0) -> None:
+        """Runs on the scheduling executor after the backoff: drop the
+        dead endpoint from the round barrier (the round must not wait on
+        a task that was never delivered) and dispatch a replacement
+        learner in its place — the reporter pool stays at strength under
+        endpoint churn instead of shrinking toward the deadline."""
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            if serial != self._round_serial:
+                return  # the round that armed this retry already closed
+            busy = set(self._tasks_in_flight.values())
+            record = self._learners.get(failed_id)
+            healed = record is not None and record.dispatch_failures == 0
+        if failed_id in busy or healed:
+            # the endpoint healed since the failure (a completion reset
+            # its failure count, or a rejoin re-dispatch gave it a LIVE
+            # task): ejecting it from the barrier now would silently
+            # exclude a deliverable — or already delivered — contribution
+            return
+        drop = getattr(self._scheduler, "drop_dispatched", None)
+        released: List[str] = []
+        if drop is not None:
+            released = drop(failed_id, self.active_learners())
+        dispatched: set = set()
+        getter = getattr(self._scheduler, "dispatched_ids", None)
+        if getter is not None:
+            dispatched = getter()
+        pool = [lid for lid in self._admission_pool()
+                if lid != failed_id and lid not in dispatched
+                and lid not in busy]
+        replacement = random.choice(pool) if pool else ""
+        _M_DISPATCH_RETRIES.inc()
+        _tevents.emit(_tevents.DispatchRetried, learner_id=failed_id,
+                      replacement=replacement, attempt=attempt)
+        if released:
+            # dropping the dead endpoint satisfied the (quorum) barrier:
+            # finish the round instead of growing it by a replacement
+            if self._quorum > 0:
+                self._expire_unreported(released)
+            self._complete_round(released)
+            return
+        if not replacement:
+            logger.warning("dispatch retry %d for %s: no replacement "
+                           "learner available", attempt, failed_id)
+            return
+        logger.info("dispatch retry %d: replacing unreachable %s with %s",
+                    attempt, failed_id, replacement)
+        self._dispatch_train([replacement], restart_deadline=False)
 
     def _send_eval_tasks(self) -> None:
         """SendEvaluationTasks (controller.cc:571-647) + digest callback."""
@@ -2332,6 +2695,11 @@ class Controller:
         if self._health is not None:
             div_scores = self._health.scores()
             div_last = self._health.last_stats()
+        churn_scores: Dict[str, float] = {}
+        quarantined: set = set()
+        if self._churn is not None:
+            churn_scores = self._churn.scores()
+            quarantined = set(self._churn.quarantined_ids(now))
         with self._lock:
             scores = self._straggler_scores()
             limit = self.config.max_dispatch_failures
@@ -2355,6 +2723,11 @@ class Controller:
                         "last_update_norm":
                         div_last.get(lid, {}).get("update_norm", 0.0)}
                        if self._health is not None else {}),
+                    # churn-aware admission analytics (keys present iff
+                    # the churn plane is on)
+                    **({"churn_score": round(churn_scores.get(lid, 0.0), 4),
+                        "quarantined": lid in quarantined}
+                       if self._churn is not None else {}),
                 }
                 for lid, r in sorted(self._learners.items())
             ]
@@ -2384,6 +2757,27 @@ class Controller:
             "events": _tevents.tail(event_tail) if event_tail else [],
             "time": round(now, 6),
         })
+        sched_cfg = self.config.scheduling
+        if (self._quorum > 0 or sched_cfg.dispatch_retries > 0
+                or self._scheduler.name == "asynchronous_buffered"
+                or quarantined):
+            # churn-tolerant scheduling section: present only when one of
+            # its planes is armed, so silo-regime snapshots are unchanged
+            section: Dict[str, Any] = {}
+            if self._quorum > 0:
+                section["quorum"] = self._quorum
+                section["overprovision"] = sched_cfg.overprovision
+            if self._scheduler.name == "asynchronous_buffered":
+                section["buffer_size"] = self._scheduler.buffer_size
+                section["buffer_pending"] = self._scheduler.pending()
+            if sched_cfg.dispatch_retries > 0:
+                with self._lock:
+                    section["dispatch_retries_used"] = \
+                        self._dispatch_retries_used
+                section["dispatch_retries"] = sched_cfg.dispatch_retries
+            if quarantined:
+                section["quarantined"] = sorted(quarantined)
+            snapshot["scheduling"] = section
         if self._ingest is not None:
             errors, _ = self._ingest.errors()
             snapshot["ingest"] = {"workers": self._ingest.workers,

@@ -1,0 +1,459 @@
+"""Seeded in-process cross-device churn harness.
+
+The cross-silo tests drive a handful of real JAX learners; the
+cross-device regime (ROADMAP "massive cross-device simulation") is the
+opposite shape — thousands of unreliable *virtual clients*, per-round
+sampling, and heavy per-round dropout — and what it stresses is the
+controller's scheduling planes (quorum barriers, deadlines, churn
+admission, dispatch retry), not the training math. So the harness keeps
+the controller 100% real (registry, scheduler, store, aggregation,
+telemetry) and replaces each learner with a virtual client: a seeded
+softmax-regression shard trained with plain numpy in a small worker
+pool. A 1024-client federation under 30% per-round dropout runs in
+seconds with bounded RSS, which is what lets churn tolerance sit in
+tier-1 CI (``scripts/chaos_smoke.sh``) next to the bench gate.
+
+Fault model per dispatched task (all draws from the scenario seed):
+
+- **dropout** — with probability ``dropout`` the client silently never
+  reports (the cross-device baseline fault; quorum or the deadline
+  releases the round without it);
+- **flap** — ``flappers`` clients crash-flap: on their first task of
+  every round they are sampled into, they ignore the task and
+  immediately re-attach with their previous identity (the crash-rejoin
+  path, which feeds the churn tracker's ``flap_rejoin`` events and
+  re-dispatches them; the re-dispatched task trains normally);
+- **partition** — ``partitioned`` clients are unreachable (dispatch
+  raises) for rounds ``[1, 1 + partition_rounds)``, exercising the
+  dispatch-failure ladder: liveness counting, churn scoring, and
+  retry-to-replacement.
+
+Determinism: client shards, fault draws, and cohort-size arithmetic are
+all seed-derived, so a fixed scenario replays the same fault schedule;
+uplink *arrival order* inside a round follows thread timing, which under
+the ``participants`` scaler moves the aggregate only by fp
+reassociation. Convergence assertions therefore compare accuracies
+within a tolerance, not bit-exact models.
+
+CLI (what ``scripts/chaos_smoke.sh`` gates on)::
+
+    python -m metisfl_tpu.driver.crossdevice --clients 512 --rounds 5
+    # runs the churn scenario AND the no-churn same-seed control,
+    # prints one JSON line, exits non-zero on a failed round or an
+    # accuracy gap beyond --tolerance
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import random
+import resource
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from metisfl_tpu.comm.messages import JoinRequest, TaskResult
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    HealthConfig,
+    ProfileConfig,
+    SchedulingConfig,
+    TelemetryConfig,
+)
+from metisfl_tpu.controller.core import Controller, LearnerRecord
+from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+logger = logging.getLogger("metisfl_tpu.crossdevice")
+
+
+@dataclass
+class ChurnScenario:
+    """One reproducible cross-device run. Defaults are the fast CI shape
+    (tests/test_churn.py pins the 1024-client acceptance scenario)."""
+
+    seed: int = 7
+    clients: int = 1024
+    rounds: int = 5
+    # quorum barrier: rounds release at `quorum` reporters out of an
+    # over-provisioned dispatch of ceil(quorum * (1 + overprovision))
+    quorum: int = 12
+    overprovision: float = 1.0
+    # per-task silent-dropout probability, plus the named fault clients
+    dropout: float = 0.3
+    flappers: int = 1
+    partitioned: int = 1
+    partition_rounds: int = 2
+    # the virtual task: seeded softmax regression on per-client shards
+    dim: int = 8
+    classes: int = 4
+    samples_per_client: int = 32
+    local_steps: int = 8
+    lr: float = 0.25
+    # controller knobs under test
+    round_deadline_secs: float = 5.0
+    quarantine_score: float = 0.55
+    quarantine_s: float = 2.0
+    dispatch_retries: int = 4
+    # >0: run protocol=asynchronous_buffered with this buffer instead of
+    # the quorum barrier (FedBuff mode; quorum is then ignored)
+    buffer_size: int = 0
+    # simulation plumbing
+    workers: int = 8
+    timeout_s: float = 120.0
+
+
+def _local_train(weights: Dict[str, np.ndarray], x: np.ndarray,
+                 y: np.ndarray, steps: int, lr: float) -> Dict[str, np.ndarray]:
+    """Full-batch softmax-regression SGD — deterministic, sub-millisecond
+    at harness scale, and genuinely converges when federated."""
+    w = np.asarray(weights["w"], np.float32).copy()
+    b = np.asarray(weights["b"], np.float32).copy()
+    n = len(x)
+    rows = np.arange(n)
+    for _ in range(max(1, steps)):
+        logits = x @ w + b
+        logits -= logits.max(axis=1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(axis=1, keepdims=True)
+        p[rows, y] -= 1.0
+        p /= n
+        w -= lr * (x.T @ p)
+        b -= lr * p.sum(axis=0)
+    return {"w": w, "b": b}
+
+
+class _VirtualClientProxy:
+    """Controller → virtual-client transport: applies the scenario's
+    fault model, then trains on the harness worker pool."""
+
+    def __init__(self, harness: "CrossDeviceHarness", record: LearnerRecord):
+        self._h = harness
+        self._learner_id = record.learner_id
+
+    def run_task(self, task) -> None:
+        self._h._on_dispatch(self._learner_id, task)
+
+    def evaluate(self, task, callback) -> None:
+        pass  # community eval is host-side in the harness (eval cfg off)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class CrossDeviceHarness:
+    """See module docstring. Lifecycle: construct → :meth:`run` → result
+    dict (the harness owns controller startup and shutdown)."""
+
+    def __init__(self, scenario: ChurnScenario):
+        self.scenario = scenario
+        s = scenario
+        if s.buffer_size > 0:
+            protocol, sched = "asynchronous_buffered", SchedulingConfig(
+                buffer_size=s.buffer_size,
+                quarantine_score=s.quarantine_score,
+                quarantine_s=s.quarantine_s,
+                dispatch_retries=s.dispatch_retries)
+        else:
+            protocol, sched = "synchronous", SchedulingConfig(
+                quorum=s.quorum, overprovision=s.overprovision,
+                quarantine_score=s.quarantine_score,
+                quarantine_s=s.quarantine_s,
+                dispatch_retries=s.dispatch_retries)
+        self.config = FederationConfig(
+            protocol=protocol,
+            scheduling=sched,
+            round_deadline_secs=s.round_deadline_secs,
+            aggregation=AggregationConfig(
+                rule="fedavg", scaler="participants",
+                staleness_decay=0.5 if s.buffer_size > 0 else 0.0),
+            eval=EvalConfig(every_n_rounds=0),
+            # the harness measures scheduling, not observability: the
+            # health/profile planes stay off so a 1024-client round costs
+            # controller bookkeeping only
+            telemetry=TelemetryConfig(
+                health=HealthConfig(enabled=False),
+                profile=ProfileConfig(enabled=False)),
+        )
+        self.controller = Controller(self.config, self._make_proxy)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, s.workers),
+            thread_name_prefix="virtual-client")
+        self._lock = threading.Lock()
+        # learner_id -> (client index, live auth token)
+        self._clients: Dict[str, int] = {}
+        self._tokens: Dict[str, str] = {}
+        # fault roles are assigned to the FIRST round-1 dispatched
+        # clients (per-round sampling of a huge population would almost
+        # never pick a pre-designated index — the faults must provably
+        # fire, not probably)
+        self._flap_idx: set = set()
+        self._part_idx: set = set()
+        self._last_flap_round: Dict[int, int] = {}
+        self._data_cache: Dict[int, Any] = {}
+        self._truth = np.random.default_rng(s.seed).standard_normal(
+            (s.dim, s.classes)).astype(np.float32)
+        self.faults = {"dropped": 0, "flapped": 0, "partitioned": 0}
+
+    # -- data ------------------------------------------------------------
+
+    def _client_data(self, idx: int):
+        with self._lock:
+            cached = self._data_cache.get(idx)
+        if cached is not None:
+            return cached
+        s = self.scenario
+        rng = np.random.default_rng((s.seed, idx))
+        x = rng.standard_normal((s.samples_per_client, s.dim)).astype(
+            np.float32)
+        noise = 0.1 * rng.standard_normal((s.samples_per_client, s.classes))
+        y = np.argmax(x @ self._truth + noise, axis=-1).astype(np.int32)
+        with self._lock:
+            self._data_cache[idx] = (x, y)
+        return x, y
+
+    def _test_data(self):
+        s = self.scenario
+        rng = np.random.default_rng((s.seed, 99991))
+        x = rng.standard_normal((1024, s.dim)).astype(np.float32)
+        y = np.argmax(x @ self._truth, axis=-1).astype(np.int32)
+        return x, y
+
+    # -- controller plumbing ---------------------------------------------
+
+    def _make_proxy(self, record: LearnerRecord):
+        return _VirtualClientProxy(self, record)
+
+    def _join_all(self) -> None:
+        for idx in range(self.scenario.clients):
+            reply = self.controller.join(JoinRequest(
+                hostname="vclient", port=20000 + idx,
+                num_train_examples=self.scenario.samples_per_client))
+            with self._lock:
+                self._clients[reply.learner_id] = idx
+                self._tokens[reply.learner_id] = reply.auth_token
+
+    def _on_dispatch(self, learner_id: str, task) -> None:
+        """The scenario's fault model, then a worker-pool training job."""
+        s = self.scenario
+        with self._lock:
+            idx = self._clients.get(learner_id)
+            token = self._tokens.get(learner_id, "")
+        if idx is None:
+            return
+        if task.round_id == 1:
+            with self._lock:
+                if (len(self._part_idx) < s.partitioned
+                        and idx not in self._flap_idx):
+                    self._part_idx.add(idx)
+                elif (len(self._flap_idx) < s.flappers
+                        and idx not in self._part_idx):
+                    self._flap_idx.add(idx)
+        if idx in self._part_idx and (
+                1 <= task.round_id < 1 + s.partition_rounds):
+            # network partition: the dispatch itself fails, feeding the
+            # dispatch-failure ladder (liveness, churn score, retry)
+            self.faults["partitioned"] += 1
+            raise RuntimeError(f"chaos: client {idx} partitioned")
+        if idx in self._flap_idx:
+            if self._last_flap_round.get(idx) != task.round_id:
+                # crash-flap: ignore the task, re-attach as ourselves —
+                # the controller notes flap_rejoin and re-dispatches; the
+                # re-dispatched task (same round) trains normally below
+                self._last_flap_round[idx] = task.round_id
+                self.faults["flapped"] += 1
+                self._pool.submit(self._rejoin, learner_id, idx, token)
+                return
+        if idx not in self._flap_idx and idx not in self._part_idx:
+            # int-composed seed (tuple seeding is deprecated and
+            # hash-randomized): deterministic per (seed, round, client)
+            draw = random.Random(
+                (s.seed << 40) ^ (task.round_id << 24) ^ idx).random()
+            if draw < s.dropout:
+                self.faults["dropped"] += 1
+                return  # silent per-round dropout: never reports
+        self._pool.submit(self._train_and_complete, learner_id, idx,
+                          token, task)
+
+    def _rejoin(self, learner_id: str, idx: int, token: str) -> None:
+        try:
+            reply = self.controller.join(JoinRequest(
+                hostname="vclient", port=20000 + idx,
+                num_train_examples=self.scenario.samples_per_client,
+                previous_id=learner_id, auth_token=token))
+            with self._lock:
+                self._clients[reply.learner_id] = idx
+                self._tokens[reply.learner_id] = reply.auth_token
+        except Exception:  # noqa: BLE001 - harness fault path, never fatal
+            logger.exception("virtual client %d rejoin failed", idx)
+
+    def _train_and_complete(self, learner_id: str, idx: int, token: str,
+                            task) -> None:
+        try:
+            blob = ModelBlob.from_bytes(task.model)
+            weights = {name: np.asarray(arr) for name, arr in blob.tensors}
+            x, y = self._client_data(idx)
+            s = self.scenario
+            trained = _local_train(weights, x, y, s.local_steps, s.lr)
+            self.controller.task_completed(TaskResult(
+                task_id=task.task_id, learner_id=learner_id,
+                auth_token=token, round_id=task.round_id,
+                model=pack_model(trained),
+                num_train_examples=len(x),
+                completed_steps=s.local_steps,
+                completed_batches=s.local_steps,
+                processing_ms_per_step=1.0))
+        except Exception:  # noqa: BLE001 - harness fault path, never fatal
+            logger.exception("virtual client %d train failed", idx)
+
+    # -- run -------------------------------------------------------------
+
+    def accuracy(self) -> float:
+        """Community-model accuracy on the held-out seeded test set."""
+        raw = self.controller.community_model_bytes()
+        if raw is None:
+            return 0.0
+        weights = {name: np.asarray(arr)
+                   for name, arr in ModelBlob.from_bytes(raw).tensors}
+        x, y = self._test_data()
+        pred = np.argmax(x @ weights["w"] + weights["b"], axis=-1)
+        return float(np.mean(pred == y))
+
+    def run(self) -> Dict[str, Any]:
+        s = self.scenario
+        # the controller samples cohorts (and retry replacements) from
+        # the process-global `random` — seed it so the dispatch schedule
+        # replays for a fixed scenario seed
+        random.seed(s.seed)
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        t0 = time.time()
+        # join BEFORE seeding: an unseeded controller skips the per-join
+        # initial dispatch, so round 1 is a SAMPLED cohort, not an
+        # all-clients broadcast (the cross-device shape under test).
+        # The expected no-model warnings are silenced for the bulk join.
+        ctrl_logger = logging.getLogger("metisfl_tpu.controller")
+        level = ctrl_logger.level
+        ctrl_logger.setLevel(logging.ERROR)
+        try:
+            self._join_all()
+            # drain the per-join initial-dispatch no-ops (single-worker
+            # executor) BEFORE seeding: a queued initial dispatch running
+            # after the seed would broadcast round 0 outside the sample
+            self.controller._pool.submit(lambda: None).result(timeout=60)
+        finally:
+            ctrl_logger.setLevel(level)
+        joined_s = time.time() - t0
+        rng = np.random.default_rng((s.seed, 77777))
+        seed_model = {
+            "w": (0.01 * rng.standard_normal((s.dim, s.classes))).astype(
+                np.float32),
+            "b": np.zeros((s.classes,), np.float32)}
+        self.controller.set_community_model(pack_model(seed_model))
+        round_walls: List[float] = []
+        halted = False
+        try:
+            assert self.controller.resume_round(), "nothing to dispatch"
+            deadline = time.time() + s.timeout_s
+            for target in range(1, s.rounds + 1):
+                r0 = time.time()
+                while self.controller.global_iteration < target:
+                    if time.time() > deadline:
+                        break
+                    # light-weight phase probe (describe() builds a
+                    # 1024-learner snapshot — far too heavy for a 10 ms
+                    # poll; a str attribute read is atomic)
+                    if self.controller._phase == "halted":
+                        halted = True
+                        break
+                    time.sleep(0.01)
+                if halted or self.controller.global_iteration < target:
+                    break
+                round_walls.append(round(time.time() - r0, 3))
+        finally:
+            completed = self.controller.global_iteration
+            metas = self.controller.get_runtime_metadata()
+            acc = self.accuracy()
+            self.controller.shutdown()
+            self._pool.shutdown(wait=True)
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        reporters = [len(m.get("train_received_at", {})) for m in metas]
+        return {
+            "clients": s.clients,
+            "protocol": self.config.protocol,
+            "quorum": 0 if s.buffer_size else s.quorum,
+            "buffer_size": s.buffer_size,
+            "dropout": s.dropout,
+            "seed": s.seed,
+            "rounds_target": s.rounds,
+            "rounds_completed": completed,
+            "halted": halted,
+            "ok": completed >= s.rounds and not halted,
+            "accuracy": round(acc, 4),
+            "join_s": round(joined_s, 3),
+            "wall_s": round(time.time() - t0, 3),
+            "round_walls_s": round_walls,
+            "reporters_per_round": reporters[:s.rounds],
+            "faults": dict(self.faults),
+            "errors": [e for m in metas for e in m.get("errors", [])],
+            "peak_rss_kb": rss1,
+            "rss_growth_kb": rss1 - rss0,
+        }
+
+
+def run_scenario(scenario: ChurnScenario) -> Dict[str, Any]:
+    return CrossDeviceHarness(scenario).run()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "metisfl_tpu.driver.crossdevice",
+        description="seeded cross-device churn harness (chaos smoke gate)")
+    parser.add_argument("--clients", type=int, default=1024)
+    parser.add_argument("--rounds", type=int, default=5)
+    parser.add_argument("--quorum", type=int, default=12)
+    parser.add_argument("--overprovision", type=float, default=1.0)
+    parser.add_argument("--dropout", type=float, default=0.3)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--buffer", type=int, default=0,
+                        help=">0: FedBuff asynchronous_buffered mode with "
+                             "this buffer size")
+    parser.add_argument("--deadline", type=float, default=5.0)
+    parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--tolerance", type=float, default=0.2,
+                        help="max |accuracy(churn) - accuracy(no churn)|")
+    parser.add_argument("--skip-control", action="store_true",
+                        help="skip the no-churn same-seed control run")
+    args = parser.parse_args(argv)
+
+    scenario = ChurnScenario(
+        seed=args.seed, clients=args.clients, rounds=args.rounds,
+        quorum=args.quorum, overprovision=args.overprovision,
+        dropout=args.dropout, buffer_size=args.buffer,
+        round_deadline_secs=args.deadline, timeout_s=args.timeout)
+    churn = run_scenario(scenario)
+    out: Dict[str, Any] = {"churn": churn}
+    ok = churn["ok"]
+    if not args.skip_control:
+        control = run_scenario(dataclasses.replace(
+            scenario, dropout=0.0, flappers=0, partitioned=0))
+        out["control"] = control
+        gap = abs(churn["accuracy"] - control["accuracy"])
+        out["accuracy_gap"] = round(gap, 4)
+        out["tolerance"] = args.tolerance
+        ok = ok and control["ok"] and gap <= args.tolerance
+    out["ok"] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

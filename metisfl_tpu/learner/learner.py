@@ -658,6 +658,18 @@ class Learner:
                 # step baseline — its wall-clock is not compile time
                 _M_TRAIN_STEP_MS.observe(out.ms_per_step)
                 _M_JIT_COMPILE.observe(compile_s)
+            # chaos 'slow' fault (chaos/injector.py): stretch this task's
+            # wall-clock by the armed factor — a slow SURVIVOR, the churn
+            # case only straggler deadlines / quorum barriers can defend
+            # against (a dead wire is the retry ladder's job). One
+            # attribute read + is-None check when chaos is off.
+            from metisfl_tpu import chaos as _chaos
+            injector = _chaos.get()
+            if injector is not None:
+                slow = injector.train_slowdown()
+                if slow > 1.0:
+                    time.sleep(min(300.0, (train_sp.duration_ms / 1e3)
+                               * (slow - 1.0)))
             device_stats = {}
             if (getattr(params, "device_stats", False)
                     and out.completed_steps > 0 and out.ms_per_step > 0):

@@ -359,7 +359,7 @@ def flatten_bench(capture: Dict[str, Any]) -> Dict[str, Any]:
 # otherwise match the lower-better "_s"/"secs" time patterns.
 _HIGHER_BETTER = ("mfu", "per_sec", "tokens_per", "samples_per",
                   "throughput", "vs_baseline", "hit_rate", "tflops",
-                  "rows_per", "speedup")
+                  "rows_per", "speedup", "accuracy")
 _LOWER_BETTER = ("_ms", "ms_per", "_secs", "seconds", "_bytes", "_mb",
                  "_kb", "rss", "wall", "latency", "pause")
 

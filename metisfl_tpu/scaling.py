@@ -32,6 +32,20 @@ def train_dataset_size_scaler(metadata: Metadata) -> Dict[str, float]:
     return {lid: s / total for lid, s in sizes.items()}
 
 
+def staleness_factor(staleness: float, decay: float) -> float:
+    """The polynomial staleness damping kernel: ``(1 + s)^-decay``
+    (FedAsync / FedBuff staleness-aware scaling). ``staleness`` is the
+    dispatch-version lag — how many rounds the community model advanced
+    between the task's dispatch and its uplink landing (0 under a
+    synchronous barrier). One definition shared by the batch path
+    (:func:`apply_staleness_decay`), the streaming fold, and the
+    buffered-async scheduler's per-uplink weights, so the three paths
+    cannot drift apart."""
+    if decay <= 0.0 or staleness <= 0.0:
+        return 1.0
+    return (1.0 + float(staleness)) ** -float(decay)
+
+
 def apply_staleness_decay(scales: Dict[str, float], metadata: Metadata,
                           decay: float) -> Dict[str, float]:
     """Down-weight stale contributions: scale *= (1 + staleness)^-decay,
@@ -39,13 +53,14 @@ def apply_staleness_decay(scales: Dict[str, float], metadata: Metadata,
 
     ``staleness`` is how many rounds behind the current community model a
     learner's latest contribution was computed — 0 for everyone under a
-    synchronous barrier (no-op there); under the asynchronous protocol a
+    synchronous barrier (no-op there); under the asynchronous protocols a
     slow learner's update trained against an old model stops steering the
     aggregate as hard as a fresh one. The reference weighs all async
     contributions equally regardless of age.
     """
     damped = {
-        lid: w * (1.0 + float(metadata[lid].get("staleness", 0.0))) ** -decay
+        lid: w * staleness_factor(
+            float(metadata[lid].get("staleness", 0.0)), decay)
         for lid, w in scales.items()
     }
     total = sum(damped.values())

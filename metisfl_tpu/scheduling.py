@@ -1,10 +1,14 @@
-"""Round scheduling policies: synchronous, semi-synchronous, asynchronous.
+"""Round scheduling policies: synchronous, semi-synchronous, asynchronous,
+and FedBuff-style buffered asynchronous.
 
 Equivalent of the reference's ``Scheduler`` strategies
 (reference metisfl/controller/scheduling/synchronous_scheduler.h:13-40,
 asynchronous_scheduler.h:12-20) plus the semi-synchronous per-learner step
 recomputation the reference keeps inside the controller
-(controller.cc:520-569). Pure in-memory policy objects — no I/O.
+(controller.cc:520-569), extended for the cross-device regime: quorum
+barriers (release at K reporters out of an over-provisioned dispatch) and
+buffered asynchronous aggregation (Nguyen et al., AISTATS 2022). Pure
+in-memory policy objects — no I/O.
 """
 
 from __future__ import annotations
@@ -23,16 +27,31 @@ class SynchronousScheduler:
     (e.g. the policy object is driven directly in tests) the barrier falls
     back to all active learners, matching the reference's semantics
     (synchronous_scheduler.h:13-40).
+
+    ``quorum`` (scheduling.quorum) turns the full barrier into a K-of-N
+    one: the round releases the moment K dispatched learners reported,
+    with the reporters as the cohort — the cross-device answer to
+    per-round dropout (over-provision the dispatch, take the first K).
+    ``quorum=0`` (default) and any quorum >= the dispatched-cohort size
+    are IDENTICAL to the full barrier — the target clamps to the barrier
+    size, so every release decision reduces to "all reported" (the
+    bit-identity pin in tests/test_churn.py).
     """
 
     name = "synchronous"
 
-    def __init__(self):
+    def __init__(self, quorum: int = 0):
+        self.quorum = int(quorum)
         self._completed: Set[str] = set()
         self._dispatched: Set[str] = set()
 
     def notify_dispatched(self, learner_ids: Sequence[str]) -> None:
         self._dispatched.update(learner_ids)
+
+    def dispatched_ids(self) -> Set[str]:
+        """The current round's dispatched barrier set (read-only copy) —
+        the dispatch-retry path samples replacements outside it."""
+        return set(self._dispatched)
 
     def _barrier(self, active: Sequence[str]) -> List[str]:
         # Only count learners that are still active (a learner leaving
@@ -40,6 +59,13 @@ class SynchronousScheduler:
         if self._dispatched:
             return [lid for lid in active if lid in self._dispatched]
         return list(active)
+
+    def _target(self, barrier: Sequence[str]) -> int:
+        """How many reporters release the round: the full barrier, or the
+        quorum when one is configured and the barrier is larger."""
+        if self.quorum <= 0:
+            return len(barrier)
+        return min(self.quorum, len(barrier))
 
     def _release(self, active: Sequence[str]) -> List[str]:
         cohort = [lid for lid in self._barrier(active) if lid in self._completed]
@@ -49,22 +75,46 @@ class SynchronousScheduler:
 
     def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
         self._completed.add(learner_id)
-        if any(lid not in self._completed for lid in self._barrier(active)):
+        barrier = self._barrier(active)
+        done = sum(1 for lid in barrier if lid in self._completed)
+        if not barrier or done < self._target(barrier):
             return []
         return self._release(active)
 
     def handle_leave(self, active: Sequence[str]) -> List[str]:
         """Re-evaluate the barrier after membership shrinks: if the departed
-        learner was the last pending one, release the round now (no later
-        completion event would ever re-check)."""
+        learner was the last pending one (or the shrunk barrier now meets
+        quorum), release the round now (no later completion event would
+        ever re-check)."""
         if not self._completed:
             return []
         barrier = self._barrier(active)
         # An empty barrier means every dispatched learner left — nothing to
         # aggregate; keep state so round_stalled() reports it for re-dispatch.
-        if not barrier or any(lid not in self._completed for lid in barrier):
+        if not barrier:
+            return []
+        done = sum(1 for lid in barrier if lid in self._completed)
+        if done < self._target(barrier):
             return []
         return self._release(active)
+
+    def drop_dispatched(self, learner_id: str,
+                        active: Sequence[str]) -> List[str]:
+        """A dispatch to this learner provably failed (unreachable
+        endpoint): remove it from the round barrier so the round never
+        waits on a task that was never delivered, and release the round
+        if the shrunk barrier is now satisfied. Only the dispatch-retry
+        plane calls this — with retries off, a failed dispatch keeps
+        today's stall-until-deadline behavior."""
+        if learner_id not in self._dispatched:
+            return []
+        if self._dispatched == {learner_id}:
+            # never empty the barrier: round_stalled()/the deadline own
+            # the no-survivors case, and an empty dispatched set would
+            # silently fall back to the all-active barrier
+            return []
+        self._dispatched.discard(learner_id)
+        return self.handle_leave(active)
 
     def round_stalled(self, active: Sequence[str]) -> bool:
         """True when a dispatched round can never complete because no
@@ -110,6 +160,81 @@ class AsynchronousScheduler:
         pass
 
 
+class BufferedAsynchronousScheduler:
+    """FedBuff-style buffered asynchronous aggregation (Nguyen et al.,
+    AISTATS 2022): uplinks fold into a size-K buffer and aggregation
+    triggers per buffer-fill. Learners never barrier on each other — a
+    reporter is re-dispatched immediately (``redispatch_on_completion``,
+    consumed by the controller), so slow learners keep training while
+    fast ones fill buffers; their eventual uplinks carry dispatch-version
+    staleness that ``aggregation.staleness_decay`` damps.
+
+    The effective fill target is ``min(buffer_size, active)`` so a
+    federation smaller than the buffer (or one that shrank mid-fill)
+    still aggregates. The buffer holds REPORTER IDS in arrival order —
+    each learner's latest uplink is what the store/streaming path
+    aggregates, and a duplicate arrival before the fill simply keeps the
+    learner's newest contribution (one buffer slot per learner).
+    """
+
+    name = "asynchronous_buffered"
+    # the controller re-dispatches each reporter immediately on completion
+    # (instead of waiting for the buffer-fill aggregation) so no learner
+    # ever idles on the buffer barrier
+    redispatch_on_completion = True
+
+    def __init__(self, buffer_size: int = 10):
+        self.buffer_size = max(1, int(buffer_size))
+        self._buffer: Dict[str, None] = {}  # ordered set: arrival order
+
+    def notify_dispatched(self, learner_ids: Sequence[str]) -> None:
+        pass
+
+    def _target(self, active: Sequence[str]) -> int:
+        return min(self.buffer_size, max(1, len(active)))
+
+    def _flush(self, active: Sequence[str]) -> List[str]:
+        act = set(active)
+        cohort = [lid for lid in self._buffer if lid in act]
+        self._buffer.clear()
+        return cohort
+
+    def schedule_next(self, learner_id: str, active: Sequence[str]) -> List[str]:
+        self._buffer[learner_id] = None
+        act = set(active)
+        live = sum(1 for lid in self._buffer if lid in act)
+        if live < self._target(active):
+            return []
+        return self._flush(active)
+
+    def handle_leave(self, active: Sequence[str]) -> List[str]:
+        """Membership shrank: drop departed reporters from the buffer
+        (their store lineage is erased with them) and release the buffer
+        if the shrunk fill target is now met — the same no-later-event
+        rationale as the synchronous barrier re-evaluation."""
+        act = set(active)
+        for lid in [l for l in self._buffer if l not in act]:
+            del self._buffer[lid]
+        if self._buffer and len(self._buffer) >= self._target(active):
+            return self._flush(active)
+        return []
+
+    def round_stalled(self, active: Sequence[str]) -> bool:
+        return False  # a partial buffer is progress, not a stall
+
+    def expire_pending(self, active: Sequence[str]) -> List[str]:
+        """Deadline fallback: flush whatever the buffer holds (possibly
+        nothing — the caller then re-dispatches) so a partial fill cannot
+        sit forever when the remaining reporters died."""
+        return self._flush(active)
+
+    def pending(self) -> int:
+        return len(self._buffer)
+
+    def reset(self) -> None:
+        self._buffer.clear()
+
+
 class SemiSynchronousScheduler(SynchronousScheduler):
     """Synchronous release + per-learner step budget matched to the slowest.
 
@@ -121,8 +246,9 @@ class SemiSynchronousScheduler(SynchronousScheduler):
 
     name = "semi_synchronous"
 
-    def __init__(self, lambda_: float = 1.0, recompute_every_round: bool = False):
-        super().__init__()
+    def __init__(self, lambda_: float = 1.0, recompute_every_round: bool = False,
+                 quorum: int = 0):
+        super().__init__(quorum=quorum)
         self.lambda_ = float(lambda_)
         self.recompute_every_round = recompute_every_round
         self._recomputed_once = False
@@ -157,6 +283,7 @@ SCHEDULERS = {
     "synchronous": SynchronousScheduler,
     "semi_synchronous": SemiSynchronousScheduler,
     "asynchronous": AsynchronousScheduler,
+    "asynchronous_buffered": BufferedAsynchronousScheduler,
 }
 
 

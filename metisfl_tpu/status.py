@@ -81,6 +81,25 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
             f"registry: stable={f'v{stable}' if stable else '-'}  "
             f"candidate={f'v{cand}' if cand else '-'}  "
             f"versions={len(versions)}{gate_cell}")
+    sched = snap.get("scheduling") or {}
+    if sched:
+        # churn-tolerant scheduling line (quorum / FedBuff / retry /
+        # quarantine); silo-regime controllers ship no "scheduling" key
+        # and render as before
+        cells = []
+        if "quorum" in sched:
+            cells.append(f"quorum={sched['quorum']}"
+                         f" overprov={sched.get('overprovision', 0.0):g}")
+        if "buffer_size" in sched:
+            cells.append(f"buffer={sched.get('buffer_pending', 0)}"
+                         f"/{sched['buffer_size']}")
+        if "dispatch_retries" in sched:
+            cells.append(f"retries={sched.get('dispatch_retries_used', 0)}"
+                         f"/{sched['dispatch_retries']}")
+        quarantined = sched.get("quarantined") or []
+        if quarantined:
+            cells.append(f"QUARANTINED={','.join(quarantined)}")
+        lines.append("scheduling: " + "  ".join(cells))
     prof = snap.get("profile") or {}
     if prof.get("enabled") and prof.get("rounds_profiled"):
         # performance-observatory line (telemetry/profile.py): the latest
@@ -98,11 +117,13 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
             + f"  up={float(prof.get('uplink_bytes', 0.0)) / 1e6:.2f}MB"
             f"  down={float(prof.get('downlink_bytes', 0.0)) / 1e6:.2f}MB")
     has_div = any("divergence_score" in l for l in learners)
+    has_churn = any("churn_score" in l for l in learners)
     if learners:
         lines.append("")
         div_header = f"{'diverg':>7} {'upd_norm':>8} " if has_div else ""
+        churn_header = f"{'churn':>6} " if has_churn else ""
         lines.append(f"{'learner':<28} {'live':>4} {'straggler':>9} "
-                     f"{div_header}"
+                     f"{div_header}{churn_header}"
                      f"{'ewma_train':>10} {'ewma_eval':>9} {'fails':>5} "
                      f"{'last_round':>10} {'stored':>6}")
         stored = (snap.get("store") or {}).get("models", {})
@@ -115,11 +136,17 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
                 div_cells = (
                     f"{(f'{div:.2f}' if div > 0 else '-'):>7} "
                     f"{(f'{norm:.3g}' if norm > 0 else '-'):>8} ")
+            churn_cells = ""
+            if has_churn:
+                churn = float(l.get("churn_score", 0.0))
+                cell = "QUAR" if l.get("quarantined") else (
+                    f"{churn:.2f}" if churn > 0 else "-")
+                churn_cells = f"{cell:>6} "
             lines.append(
                 f"{l.get('learner_id', '?'):<28} "
                 f"{'yes' if l.get('live') else 'NO':>4} "
                 f"{(f'{score:.2f}x' if score > 0 else '-'):>9} "
-                f"{div_cells}"
+                f"{div_cells}{churn_cells}"
                 f"{_fmt_s(float(l.get('ewma_train_s', 0.0))):>10} "
                 f"{_fmt_s(float(l.get('ewma_eval_s', 0.0))):>9} "
                 f"{l.get('dispatch_failures', 0):>5} "

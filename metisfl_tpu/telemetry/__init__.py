@@ -67,6 +67,11 @@ M_UPLINK_BYTES_TOTAL = "uplink_bytes_total"
 M_CONTROLLER_ACTIVE_LEARNERS = "controller_active_learners"
 M_AGGREGATION_FAILURES_TOTAL = "aggregation_failures_total"
 M_LEARNER_STRAGGLER_SCORE = "learner_straggler_score"
+# churn-tolerant scheduling (controller/core.py + selection.py)
+M_LEARNER_DROPPED_TOTAL = "learner_dropped_total"
+M_DISPATCH_RETRIES_TOTAL = "dispatch_retries_total"
+M_ROUNDS_REDISPATCHED_TOTAL = "rounds_redispatched_total"
+M_LEARNER_CHURN_SCORE = "learner_churn_score"
 # learning-health plane (controller/core.py + telemetry/health.py)
 M_LEARNER_DIVERGENCE_SCORE = "learner_divergence_score"
 M_ROUND_UPDATE_NORM = "round_update_norm"

@@ -156,6 +156,41 @@ class RoundHealth:
 
 
 @dataclass(frozen=True)
+class LearnerQuarantined:
+    """A flapping learner's churn score crossed the quarantine threshold
+    (selection.py ChurnTracker): excluded from cohort sampling until
+    ``until_s`` seconds of quarantine elapse."""
+
+    kind: ClassVar[str] = "learner_quarantined"
+    learner_id: str
+    score: float = 0.0
+    until_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class DispatchRetried:
+    """A failed train dispatch was retried to a replacement learner
+    (scheduling.dispatch_retries): the dead endpoint left the round
+    barrier and ``replacement`` was dispatched in its place."""
+
+    kind: ClassVar[str] = "dispatch_retried"
+    learner_id: str
+    replacement: str = ""
+    attempt: int = 0
+
+
+@dataclass(frozen=True)
+class RoundHalted:
+    """The controller stopped re-dispatching a round that can never
+    complete (consecutive zero-reporter deadlines past
+    scheduling.max_empty_redispatch, or the aggregation-failure limit)."""
+
+    kind: ClassVar[str] = "round_halted"
+    round: int
+    reason: str = ""
+
+
+@dataclass(frozen=True)
 class VersionRegistered:
     """The model registry minted a candidate version from an aggregated
     round (registry/registry.py)."""
@@ -204,7 +239,8 @@ EVENT_TYPES: Dict[str, type] = {
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
                 TaskCompleted, RetryScheduled, FaultInjected, EpochChanged,
                 AggregationDone, FailoverBegan, UpdateAnomalous,
-                RoundHealth, VersionRegistered, VersionPromoted,
+                RoundHealth, LearnerQuarantined, DispatchRetried,
+                RoundHalted, VersionRegistered, VersionPromoted,
                 VersionRolledBack, ServingSwapped)
 }
 

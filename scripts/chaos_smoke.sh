@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# CI churn-tolerance gate (ISSUE 9 satellite; docs/RESILIENCE.md
+# "Cross-device churn").
+#
+# Runs the seeded cross-device churn scenario — 1024 virtual clients,
+# per-round sampling at quorum, 30% per-round dropout plus one flapping
+# and one partitioned learner — AND the no-churn same-seed control, then
+# fails the build when any round fails to complete or the final accuracy
+# drifts past the tolerance from the control run. Deterministic fault
+# schedule (fixed seed), finishes in well under 60 s on one CPU core:
+# churn tolerance is gated exactly like bench regressions are by
+# scripts/check_bench.sh.
+#
+# Usage:
+#   scripts/chaos_smoke.sh                  # the pinned CI scenario
+#   scripts/chaos_smoke.sh --clients 256    # any crossdevice CLI override
+#
+# Exit codes: 0 all rounds completed within tolerance, 1 a round failed /
+# halted / accuracy drifted, 2 harness crashed (fails the build too).
+set -u -o pipefail
+
+PYTHON="${PYTHON:-python}"
+
+# CPU-pinned and time-bounded: the harness measures scheduling, not
+# accelerator math, and a wedged run must fail, not hang the build.
+JAX_PLATFORMS=cpu timeout -k 10 120 "$PYTHON" -m metisfl_tpu.driver.crossdevice \
+  --clients 1024 --rounds 5 --quorum 12 --dropout 0.3 --seed 7 \
+  --tolerance 0.2 "$@"
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: PASS (all rounds completed at quorum, accuracy" \
+          "within tolerance of the no-churn control)" ;;
+  1) echo "chaos_smoke: FAIL — a round failed/halted or accuracy drifted" \
+          "past tolerance (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: FAIL — harness crashed or timed out (rc=$rc)" >&2
+     rc=2 ;;
+esac
+exit "$rc"

@@ -239,6 +239,99 @@ def test_corrupted_blob_rejected_as_invalid_argument(chaos, echo_server):
         client.close()
 
 
+def test_flap_fault_follows_periodic_windows(chaos, monkeypatch):
+    """flap: calls in the down window of each period raise UNAVAILABLE,
+    calls in the up phase pass — a periodic leave/rejoin as the wire
+    sees it. The cycle anchors at the rule's first matched call."""
+    inj = chaos.ChaosInjector.from_spec({"rules": [
+        {"fault": "flap", "method": "M", "period_s": 10.0, "down_s": 4.0}]})
+    clock = {"t": 100.0}
+    monkeypatch.setattr("metisfl_tpu.chaos.injector.time.monotonic",
+                        lambda: clock["t"])
+
+    def probe(t):
+        clock["t"] = t
+        try:
+            inj.intercept("client", "s", "M", b"x")
+            return "up"
+        except chaos.FaultInjected:
+            return "down"
+
+    # anchor = first call at t=100: down [100,104), up [104,110), repeat
+    assert probe(100.0) == "down"
+    assert probe(103.9) == "down"
+    assert probe(104.0) == "up"
+    assert probe(109.9) == "up"
+    assert probe(110.5) == "down"   # second cycle's down window
+    assert probe(115.0) == "up"
+    # only the outages counted as fires
+    assert inj.fired_total("flap") == 3
+    # other methods never match
+    inj.intercept("client", "s", "Other", b"x")
+
+
+def test_partition_fault_drops_only_inside_window(chaos, monkeypatch):
+    """partition: all matching traffic between after_s and
+    after_s + window_s (from first match) raises UNAVAILABLE; before and
+    after, the wire heals."""
+    inj = chaos.ChaosInjector.from_spec({"rules": [
+        {"fault": "partition", "after_s": 5.0, "window_s": 3.0}]})
+    clock = {"t": 50.0}
+    monkeypatch.setattr("metisfl_tpu.chaos.injector.time.monotonic",
+                        lambda: clock["t"])
+
+    def probe(t):
+        clock["t"] = t
+        try:
+            inj.intercept("server", "s", "M", b"x")
+            return "ok"
+        except chaos.FaultInjected:
+            return "dropped"
+
+    assert probe(50.0) == "ok"       # anchor; before the window
+    assert probe(54.9) == "ok"
+    assert probe(55.0) == "dropped"  # window [55, 58)
+    assert probe(57.9) == "dropped"
+    assert probe(58.0) == "ok"       # partition healed
+    assert inj.fired_total("partition") == 2
+
+
+def test_slow_fault_is_rpc_inert_and_scales_train(chaos):
+    """slow: the RPC path never fires it (a slow survivor is not a wire
+    fault); the learner train hook consumes it as a wall-clock factor,
+    budgeted by max_fires."""
+    inj = chaos.ChaosInjector.from_spec({"rules": [
+        {"fault": "slow", "factor": 3.0, "max_fires": 2}]})
+    # RPC path: payload passes untouched, nothing fires
+    assert inj.intercept("client", "s", "Train", b"x") == b"x"
+    assert inj.fired_total("slow") == 0
+    # learner hook: factor applied, two fires then exhausted
+    assert inj.train_slowdown() == 3.0
+    assert inj.train_slowdown() == 3.0
+    assert inj.train_slowdown() == 1.0
+    assert inj.fired_total("slow") == 2
+    # default factor is 2.0
+    inj2 = chaos.ChaosInjector.from_spec({"rules": [{"fault": "slow"}]})
+    assert inj2.train_slowdown() == 2.0
+
+
+def test_learner_applies_slow_fault_to_train_wallclock(chaos):
+    """End-to-end slow fault: a 2-learner in-process federation with one
+    slow rule armed still completes rounds, and the injector records the
+    train-slowdown fires."""
+    from tests.test_federation_inprocess import _make_federation
+
+    chaos.configure({"rules": [{"fault": "slow", "factor": 1.5,
+                                "max_fires": 2}]})
+    fed, _ = _make_federation(num_learners=2)
+    try:
+        fed.start()
+        assert fed.wait_for_rounds(1, timeout_s=120)
+        assert chaos.get().fired_total("slow") >= 1
+    finally:
+        fed.shutdown()
+
+
 def test_env_var_arms_injector(chaos, monkeypatch):
     import json
 

@@ -1,0 +1,701 @@
+"""Churn-tolerant cross-device rounds (ISSUE 9): quorum barriers,
+buffered async aggregation, churn-aware admission/retry, the bounded
+no-reporter re-dispatch loop, and the seeded cross-device harness.
+
+Controller-level tests drive a real Controller over no-op proxies with
+direct ``task_completed`` submissions (the protocol-level fake-learner
+technique); the acceptance test at the bottom runs the full
+1024-virtual-client harness from ``metisfl_tpu/driver/crossdevice.py``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.messages import JoinRequest, TaskResult
+from metisfl_tpu.config import (
+    AggregationConfig,
+    EvalConfig,
+    FederationConfig,
+    SchedulingConfig,
+    SecureAggConfig,
+)
+from metisfl_tpu.controller.core import Controller
+from metisfl_tpu.tensor.pytree import ModelBlob, pack_model
+
+
+class _NopProxy:
+    def run_task(self, task):
+        pass
+
+    def evaluate(self, task, callback):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+def _fake_model(seed, shape=(4, 3)):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal(shape).astype(np.float32),
+            "b": rng.standard_normal((shape[1],)).astype(np.float32)}
+
+
+def _make_controller(protocol="synchronous", n=3, scheduling=None,
+                    proxy_factory=None, seed_first=False, aggregation=None,
+                    **cfg_kwargs):
+    """Controller + n joined no-op learners. By default learners join
+    BEFORE the model is seeded (no per-join initial dispatch — the
+    cross-device shape); ``seed_first=True`` restores the silo flow."""
+    config = FederationConfig(
+        protocol=protocol,
+        scheduling=scheduling or SchedulingConfig(),
+        aggregation=aggregation or AggregationConfig(
+            rule="fedavg", scaler="participants"),
+        eval=EvalConfig(every_n_rounds=0),
+        **cfg_kwargs,
+    )
+    ctrl = Controller(config, proxy_factory or (lambda record: _NopProxy()))
+    seed = _fake_model(0)
+    if seed_first:
+        ctrl.set_community_model(pack_model(seed))
+    ids = []
+    for i in range(n):
+        reply = ctrl.join(JoinRequest(hostname="h", port=6000 + i,
+                                      num_train_examples=10))
+        ids.append((reply.learner_id, reply.auth_token))
+    ctrl._pool.submit(lambda: None).result(timeout=30)  # drain joins
+    if not seed_first:
+        ctrl.set_community_model(pack_model(seed))
+    return ctrl, ids
+
+
+def _submit(ctrl, lid, token, model, task_id=None, round_id=None):
+    assert ctrl.task_completed(TaskResult(
+        task_id=task_id or f"t_{lid}_{time.monotonic_ns()}",
+        learner_id=lid, auth_token=token, model=pack_model(model),
+        round_id=ctrl.global_iteration if round_id is None else round_id,
+        num_train_examples=10, completed_batches=1))
+
+
+def _wait(predicate, timeout_s=30.0, msg="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _inflight_by_learner(ctrl):
+    with ctrl._lock:
+        return {lid: tid for tid, lid in ctrl._tasks_in_flight.items()}
+
+
+# --------------------------------------------------------------------- #
+# quorum barriers
+# --------------------------------------------------------------------- #
+
+class TestQuorumController:
+    def test_round_releases_at_quorum_and_expires_stragglers(self):
+        ctrl, ids = _make_controller(
+            scheduling=SchedulingConfig(quorum=2, overprovision=0.5))
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: len(_inflight_by_learner(ctrl)) == 3,
+                  msg="3 dispatched tasks")
+            tasks = _inflight_by_learner(ctrl)
+            tokens = dict(ids)
+            reporters = list(tasks)[:2]
+            straggler = [lid for lid in tasks if lid not in reporters][0]
+            straggler_task = tasks[straggler]
+            for lid in reporters:
+                _submit(ctrl, lid, tokens[lid], _fake_model(1),
+                        task_id=tasks[lid])
+            _wait(lambda: ctrl.global_iteration >= 1, msg="quorum release")
+            meta = ctrl.get_runtime_metadata()[0]
+            assert sorted(meta["selected_learners"]) == sorted(reporters)
+            # the straggler's task expired: its late completion is stored
+            # but never advances the next round's barrier
+            assert straggler_task in ctrl._expired_tasks
+            before = ctrl.global_iteration
+            _submit(ctrl, straggler, tokens[straggler], _fake_model(2),
+                    task_id=straggler_task, round_id=0)
+            ctrl._pool.submit(lambda: None).result(timeout=30)
+            assert ctrl.global_iteration == before
+        finally:
+            ctrl.shutdown()
+
+    def test_quorum_full_cohort_is_bit_identical(self):
+        """The bit-identity acceptance pin: quorum == dispatched-cohort
+        size produces byte-for-byte the community model of the plain
+        synchronous path under the same submissions."""
+        def run(quorum):
+            sched = SchedulingConfig(quorum=quorum)
+            ctrl, ids = _make_controller(scheduling=sched, seed_first=False)
+            try:
+                assert ctrl.resume_round()
+                _wait(lambda: len(_inflight_by_learner(ctrl)) == 3,
+                      msg="dispatch")
+                for round_id in range(2):
+                    for i, (lid, token) in enumerate(ids):
+                        _submit(ctrl, lid, token, _fake_model(10 + i),
+                                round_id=round_id)
+                    _wait(lambda: ctrl.global_iteration >= round_id + 1,
+                          msg=f"round {round_id}")
+                return ctrl.community_model_bytes()
+            finally:
+                ctrl.shutdown()
+
+        assert run(quorum=0) == run(quorum=3)
+
+    def test_quorum_overprovision_sizes_dispatch(self):
+        ctrl, _ = _make_controller(
+            n=64, scheduling=SchedulingConfig(quorum=8, overprovision=0.75))
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: len(_inflight_by_learner(ctrl)) == 14,
+                  msg="ceil(8*1.75)=14 dispatched")
+        finally:
+            ctrl.shutdown()
+
+    def test_leave_releases_quorum_round(self):
+        """SynchronousScheduler.handle_leave at controller level
+        (satellite): two report, the last pending learner leaves, the
+        membership change itself releases the round."""
+        ctrl, ids = _make_controller()
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: len(_inflight_by_learner(ctrl)) == 3,
+                  msg="dispatch")
+            tokens = dict(ids)
+            for lid, token in ids[:2]:
+                _submit(ctrl, lid, token, _fake_model(3))
+            ctrl._pool.submit(lambda: None).result(timeout=30)
+            assert ctrl.global_iteration == 0  # still barriered
+            assert ctrl.leave(*ids[2])
+            _wait(lambda: ctrl.global_iteration >= 1,
+                  msg="leave releases the round")
+            meta = ctrl.get_runtime_metadata()[0]
+            assert sorted(meta["selected_learners"]) == sorted(
+                [lid for lid, _ in ids[:2]])
+        finally:
+            ctrl.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# buffered async aggregation (FedBuff)
+# --------------------------------------------------------------------- #
+
+class TestBufferedAsyncController:
+    def test_aggregates_per_buffer_fill(self):
+        ctrl, ids = _make_controller(
+            protocol="asynchronous_buffered", seed_first=True,
+            scheduling=SchedulingConfig(buffer_size=2))
+        try:
+            tokens = dict(ids)
+            _submit(ctrl, ids[0][0], tokens[ids[0][0]], _fake_model(1))
+            ctrl._pool.submit(lambda: None).result(timeout=30)
+            assert ctrl.global_iteration == 0  # buffer 1/2: no aggregate
+            _submit(ctrl, ids[1][0], tokens[ids[1][0]], _fake_model(2))
+            _wait(lambda: ctrl.global_iteration >= 1, msg="buffer fill")
+            meta = ctrl.get_runtime_metadata()[0]
+            assert sorted(meta["selected_learners"]) == sorted(
+                [ids[0][0], ids[1][0]])
+        finally:
+            ctrl.shutdown()
+
+    def test_staleness_recorded_and_damped(self):
+        """Per-uplink dispatch-version lag lands in lineage and the
+        staleness decay produces non-uniform applied scales under the
+        uniform participants scaler."""
+        ctrl, ids = _make_controller(
+            protocol="asynchronous_buffered", seed_first=True,
+            scheduling=SchedulingConfig(buffer_size=2),
+            aggregation=AggregationConfig(
+                rule="fedavg", scaler="participants", staleness_decay=1.0))
+        try:
+            tokens = dict(ids)
+            # round 0 fills from two fresh reporters
+            _submit(ctrl, ids[0][0], tokens[ids[0][0]], _fake_model(1),
+                    round_id=0)
+            _submit(ctrl, ids[1][0], tokens[ids[1][0]], _fake_model(2),
+                    round_id=0)
+            _wait(lambda: ctrl.global_iteration >= 1, msg="round 1")
+            # round 1 fills from one STALE uplink (dispatched at round 0)
+            # and one fresh
+            _submit(ctrl, ids[2][0], tokens[ids[2][0]], _fake_model(3),
+                    round_id=0)
+            _submit(ctrl, ids[0][0], tokens[ids[0][0]], _fake_model(4),
+                    round_id=1)
+            _wait(lambda: ctrl.global_iteration >= 2, msg="round 2")
+            meta = ctrl.get_runtime_metadata()[1]
+            assert meta["staleness"].get(ids[2][0]) == 1.0
+            assert ids[0][0] not in meta["staleness"]  # zero omitted
+            scales = meta["scales"]
+            assert scales[ids[2][0]] < scales[ids[0][0]]  # damped
+        finally:
+            ctrl.shutdown()
+
+    def test_reporter_redispatched_while_buffer_fills(self):
+        ctrl, ids = _make_controller(
+            protocol="asynchronous_buffered", seed_first=True,
+            scheduling=SchedulingConfig(buffer_size=3))
+        try:
+            lid, token = ids[0]
+            _submit(ctrl, lid, token, _fake_model(1))
+            # the reporter gets a fresh task immediately — it never idles
+            # on the buffer barrier (FedBuff redispatch_on_completion)
+            _wait(lambda: lid in _inflight_by_learner(ctrl),
+                  msg="reporter re-dispatched")
+            assert ctrl.global_iteration == 0
+        finally:
+            ctrl.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# churn-aware admission + dispatch retry
+# --------------------------------------------------------------------- #
+
+class TestChurnAdmission:
+    def test_flap_rejoins_raise_score_and_quarantine(self):
+        ctrl, ids = _make_controller(
+            scheduling=SchedulingConfig(churn_alpha=0.5,
+                                        quarantine_score=0.7,
+                                        quarantine_s=60.0))
+        try:
+            lid, token = ids[2]
+            # two crash-rejoins (credentialed previous_id joins)
+            for _ in range(2):
+                reply = ctrl.join(JoinRequest(
+                    hostname="h", port=6002, num_train_examples=10,
+                    previous_id=lid, auth_token=token))
+                assert reply.rejoined and reply.learner_id == lid
+            assert ctrl._churn.score(lid) == pytest.approx(0.75)
+            assert ctrl._churn.quarantined(lid)
+            # quarantined learners sit out cohort sampling
+            for _ in range(5):
+                assert lid not in ctrl._sample_cohort()
+            snap = ctrl.describe(event_tail=10)
+            entry = [l for l in snap["learners"]
+                     if l["learner_id"] == lid][0]
+            assert entry["quarantined"] is True
+            assert entry["churn_score"] == pytest.approx(0.75)
+            assert lid in snap["scheduling"]["quarantined"]
+            kinds = [e["kind"] for e in snap["events"]]
+            assert "learner_quarantined" in kinds
+            # the status CLI renders the new plane: a scheduling line
+            # and a churn column with the quarantine marker
+            from metisfl_tpu.status import render_snapshot
+            screen = render_snapshot(snap)
+            assert "scheduling:" in screen and "QUARANTINED=" in screen
+            assert "churn" in screen and "QUAR" in screen
+        finally:
+            ctrl.shutdown()
+
+    def test_completions_decay_churn_score(self):
+        ctrl, ids = _make_controller(
+            seed_first=True,
+            scheduling=SchedulingConfig(churn_alpha=0.5))
+        try:
+            lid, token = ids[0]
+            ctrl.join(JoinRequest(hostname="h", port=6000,
+                                  num_train_examples=10,
+                                  previous_id=lid, auth_token=token))
+            assert ctrl._churn.score(lid) == pytest.approx(0.5)
+            _submit(ctrl, lid, token, _fake_model(1))
+            ctrl._pool.submit(lambda: None).result(timeout=30)
+            assert ctrl._churn.score(lid) == pytest.approx(0.25)
+        finally:
+            ctrl.shutdown()
+
+    def test_churn_gauge_pruned_on_leave_state_survives(self):
+        from metisfl_tpu import telemetry as _tel
+        from metisfl_tpu.telemetry import metrics as _tmetrics
+
+        _tmetrics.set_enabled(True)
+        ctrl, ids = _make_controller(
+            scheduling=SchedulingConfig(churn_alpha=0.5))
+        try:
+            lid, token = ids[0]
+            ctrl.join(JoinRequest(hostname="h", port=6000,
+                                  num_train_examples=10,
+                                  previous_id=lid, auth_token=token))
+            text = _tel.render_metrics()
+            assert f'learner_churn_score{{learner="{lid}"}}' in text
+            assert ctrl.leave(lid, token)
+            text = _tel.render_metrics()
+            assert f'learner_churn_score{{learner="{lid}"}}' not in text
+            # the tracker's memory survives the leave — a flapper's
+            # history is the signal (leave itself raised the score)
+            assert ctrl._churn.score(lid) == pytest.approx(0.75)
+        finally:
+            ctrl.shutdown()
+
+    def test_churn_tracking_disabled_is_one_attribute_check(self):
+        ctrl, ids = _make_controller(
+            scheduling=SchedulingConfig(churn_tracking=False))
+        try:
+            assert ctrl._churn is None
+            lid, token = ids[0]
+            ctrl.join(JoinRequest(hostname="h", port=6000,
+                                  num_train_examples=10,
+                                  previous_id=lid, auth_token=token))
+            snap = ctrl.describe(event_tail=0)
+            assert "churn_score" not in snap["learners"][0]
+            assert "scheduling" not in snap
+        finally:
+            ctrl.shutdown()
+
+    def test_dispatch_retry_replaces_unreachable_learner(self):
+        class _DeadProxy:
+            def run_task(self, task):
+                raise RuntimeError("unreachable endpoint")
+
+            def evaluate(self, task, callback):
+                pass
+
+            def shutdown(self):
+                pass
+
+        dead_ports = {6002}
+
+        def factory(record):
+            if record.port in dead_ports:
+                return _DeadProxy()
+            return _NopProxy()
+
+        ctrl, ids = _make_controller(
+            n=4, proxy_factory=factory,
+            scheduling=SchedulingConfig(dispatch_retries=2,
+                                        retry_backoff_s=0.02))
+        try:
+            tokens = dict(ids)
+            dead = ids[2][0]
+            spare = ids[3][0]
+            # dispatch the round to {healthy, healthy, dead}: the failed
+            # dispatch drops the dead endpoint from the barrier and
+            # dispatches the spare as a replacement after backoff
+            cohort = [ids[0][0], ids[1][0], dead]
+            ctrl._pool.submit(ctrl._guard, ctrl._dispatch_train,
+                              cohort).result(timeout=30)
+            _wait(lambda: spare in _inflight_by_learner(ctrl),
+                  msg="replacement dispatched")
+            for lid in (ids[0][0], ids[1][0], spare):
+                _submit(ctrl, lid, tokens[lid], _fake_model(5))
+            _wait(lambda: ctrl.global_iteration >= 1,
+                  msg="replacement round completes")
+            meta = ctrl.get_runtime_metadata()[0]
+            assert spare in meta["selected_learners"]
+            assert dead not in meta["selected_learners"]
+            snap = ctrl.describe(event_tail=20)
+            kinds = [e["kind"] for e in snap["events"]]
+            assert "dispatch_retried" in kinds
+        finally:
+            ctrl.shutdown()
+
+    def test_retries_disabled_keeps_barrier_stalled(self):
+        """Opt-out pin: with dispatch_retries=0 a failed dispatch leaves
+        the barrier untouched (today's stall-until-deadline behavior)."""
+        class _DeadProxy(_NopProxy):
+            def run_task(self, task):
+                raise RuntimeError("unreachable")
+
+        ctrl, ids = _make_controller(
+            n=3,
+            proxy_factory=lambda r: _DeadProxy() if r.port == 6002
+            else _NopProxy())
+        try:
+            tokens = dict(ids)
+            cohort = [lid for lid, _ in ids]
+            ctrl._pool.submit(ctrl._guard, ctrl._dispatch_train,
+                              cohort).result(timeout=30)
+            for lid, _ in ids[:2]:
+                _submit(ctrl, lid, tokens[lid], _fake_model(5))
+            ctrl._pool.submit(lambda: None).result(timeout=30)
+            # the dead learner is still in the barrier: round stalls
+            assert ctrl.global_iteration == 0
+            assert ctrl._dispatch_retries_used == 0
+        finally:
+            ctrl.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# bounded no-reporter re-dispatch (satellite)
+# --------------------------------------------------------------------- #
+
+class TestEmptyDeadlineBound:
+    def test_consecutive_empty_deadlines_halt_with_lineage_error(self):
+        ctrl, ids = _make_controller(
+            round_deadline_secs=0.2,
+            scheduling=SchedulingConfig(max_empty_redispatch=2))
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: ctrl.describe(event_tail=0)["phase"] == "halted",
+                  timeout_s=30, msg="halt after 2 empty deadlines")
+            assert ctrl.global_iteration == 0
+            errors = ctrl._current_meta.errors
+            assert any("halted" in e for e in errors), errors
+            snap = ctrl.describe(event_tail=50)
+            kinds = [e["kind"] for e in snap["events"]]
+            assert "round_halted" in kinds
+        finally:
+            ctrl.shutdown()
+
+    def test_halt_resumes_on_delivered_uplink(self):
+        """The halt is recoverable by evidence of life: a straggler's
+        late (stale) completion after the no-reporter halt resumes
+        dispatch with a fresh sample instead of leaving the federation
+        parked forever."""
+        ctrl, ids = _make_controller(
+            round_deadline_secs=0.2,
+            scheduling=SchedulingConfig(max_empty_redispatch=2))
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: ctrl.describe(event_tail=0)["phase"] == "halted",
+                  timeout_s=30, msg="halt")
+            lid, token = ids[0]
+            _submit(ctrl, lid, token, _fake_model(1), round_id=0)
+            _wait(lambda: ctrl.describe(event_tail=0)["phase"] != "halted",
+                  timeout_s=30, msg="resume after halt")
+            _wait(lambda: len(_inflight_by_learner(ctrl)) > 0,
+                  msg="fresh dispatch after resume")
+            assert ctrl._empty_deadlines < 2
+        finally:
+            ctrl.shutdown()
+
+    def test_reporters_reset_the_empty_deadline_counter(self):
+        ctrl, ids = _make_controller(
+            round_deadline_secs=0.3,
+            scheduling=SchedulingConfig(max_empty_redispatch=3))
+        try:
+            assert ctrl.resume_round()
+            # one empty deadline elapses, then the cohort reports: the
+            # counter must reset instead of marching toward the halt
+            time.sleep(0.45)
+            tokens = dict(ids)
+            for lid, token in ids:
+                _submit(ctrl, lid, token, _fake_model(1))
+            _wait(lambda: ctrl.global_iteration >= 1, msg="round completes")
+            assert ctrl._empty_deadlines == 0
+            assert ctrl.describe(event_tail=0)["phase"] != "halted"
+        finally:
+            ctrl.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# deadline → partial cohort under secure aggregation (satellite)
+# --------------------------------------------------------------------- #
+
+class TestSecurePartialCohort:
+    def _masked_controller(self, n=3, **cfg_kwargs):
+        from metisfl_tpu.secure import MaskingBackend
+
+        learner_backends = [
+            MaskingBackend(federation_secret="fed", party_index=i,
+                           num_parties=n) for i in range(n)]
+
+        class _MaskProxy(_NopProxy):
+            def __init__(self, backend):
+                self._backend = backend
+
+            def recover_masks(self, round_id, surviving, dropped, lengths):
+                return self._backend.recovery_correction(
+                    round_id, surviving, dropped, lengths)
+
+        by_port = {6000 + i: learner_backends[i] for i in range(n)}
+        ctrl = Controller(
+            FederationConfig(
+                protocol="synchronous",
+                aggregation=AggregationConfig(rule="secure_agg",
+                                              scaler="participants"),
+                secure=SecureAggConfig(enabled=True, scheme="masking",
+                                       num_parties=n),
+                eval=EvalConfig(every_n_rounds=0),
+                **cfg_kwargs,
+            ),
+            lambda record: _MaskProxy(by_port[record.port]),
+            secure_backend=MaskingBackend(num_parties=n))
+        ids = []
+        for i in range(n):
+            reply = ctrl.join(JoinRequest(
+                hostname="h", port=6000 + i, num_train_examples=10,
+                capabilities={"party_index": i}))
+            ids.append((reply.learner_id, reply.auth_token))
+        ctrl._pool.submit(lambda: None).result(timeout=30)
+        ctrl.set_community_model(pack_model(_fake_model(0, shape=(2, 2))))
+        return ctrl, ids, learner_backends
+
+    def _masked_result(self, backend, lid, token, vec, round_id=0):
+        from metisfl_tpu.tensor.spec import (DType, TensorKind, TensorSpec)
+        backend.begin_round(round_id)
+        payload = backend.encrypt(np.asarray(vec, np.float64).ravel())
+        spec = TensorSpec(np.asarray(vec).shape, DType.F32,
+                          TensorKind.CIPHERTEXT)
+        blob = ModelBlob(opaque={"w": (payload, spec)}).to_bytes()
+        return TaskResult(task_id=f"s_{lid}_{round_id}", learner_id=lid,
+                          auth_token=token, model=blob, round_id=round_id,
+                          num_train_examples=10, completed_batches=1)
+
+    def test_leave_midround_recovers_partial_masked_cohort(self):
+        """The dropout-recovery branch at controller level: a masking
+        party leaves mid-round after the others uplinked; handle_leave
+        releases the partial cohort and aggregation recovers via a
+        surviving learner's residual-mask correction."""
+        from metisfl_tpu.secure import MaskingBackend
+
+        ctrl, ids, learner_backends = self._masked_controller(n=3)
+        n = 3
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: len(_inflight_by_learner(ctrl)) == 3,
+                  msg="dispatch")
+            vecs = [np.full(4, float(i + 1)) for i in range(n)]
+            for i in (0, 1):
+                assert ctrl.task_completed(self._masked_result(
+                    learner_backends[i], ids[i][0], ids[i][1], vecs[i]))
+            ctrl._pool.submit(lambda: None).result(timeout=30)
+            assert ctrl.global_iteration == 0
+            # party 2 leaves: the membership change releases the partial
+            # cohort; masks no longer cancel pairwise, so aggregation
+            # must run the dropout-recovery unmasking round
+            assert ctrl.leave(*ids[2])
+            _wait(lambda: ctrl.global_iteration >= 1,
+                  msg="partial masked cohort aggregates")
+            meta = ctrl.get_runtime_metadata()[0]
+            assert len(meta["selected_learners"]) == 2
+            assert not any("aggregation failed" in e
+                           for e in meta["errors"]), meta["errors"]
+            # the unmasked community equals the survivors' mean
+            blob = ModelBlob.from_bytes(ctrl.community_model_bytes())
+            payload, _spec = blob.opaque["w"]
+            keyless = MaskingBackend(num_parties=n)
+            np.testing.assert_allclose(
+                keyless.decrypt(payload, 4),
+                (vecs[0] + vecs[1]) / 2.0, atol=1e-9)
+        finally:
+            ctrl.shutdown()
+
+    def test_deadline_recovers_partial_masked_cohort(self):
+        """The deadline → partial-cohort path under secure aggregation at
+        controller level (the branch noted at _handle_deadline's masking
+        comment): a masking straggler never reports, the round deadline
+        expires it, and the partial cohort aggregates through dropout
+        recovery — no full-cohort retry, no aggregation failure."""
+        from metisfl_tpu.secure import MaskingBackend
+
+        ctrl, ids, learner_backends = self._masked_controller(
+            n=3, round_deadline_secs=0.5)
+        try:
+            assert ctrl.resume_round()
+            _wait(lambda: len(_inflight_by_learner(ctrl)) == 3,
+                  msg="dispatch")
+            vecs = [np.full(4, float(i + 1)) for i in range(3)]
+            for i in (0, 1):
+                assert ctrl.task_completed(self._masked_result(
+                    learner_backends[i], ids[i][0], ids[i][1], vecs[i]))
+            # party 2 is a straggler: only the deadline releases the round
+            _wait(lambda: ctrl.global_iteration >= 1, timeout_s=30,
+                  msg="deadline releases the partial masked cohort")
+            meta = ctrl.get_runtime_metadata()[0]
+            assert len(meta["selected_learners"]) == 2
+            assert not any("aggregation failed" in e
+                           for e in meta["errors"]), meta["errors"]
+            blob = ModelBlob.from_bytes(ctrl.community_model_bytes())
+            payload, _spec = blob.opaque["w"]
+            keyless = MaskingBackend(num_parties=3)
+            np.testing.assert_allclose(
+                keyless.decrypt(payload, 4),
+                (vecs[0] + vecs[1]) / 2.0, atol=1e-9)
+        finally:
+            ctrl.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# opt-out / bit-identity pins for the whole plane
+# --------------------------------------------------------------------- #
+
+class TestDisabledPlaneInertness:
+    def test_default_config_arms_nothing(self):
+        ctrl, _ = _make_controller()
+        try:
+            assert ctrl._quorum == 0
+            assert ctrl._dispatch_retries_used == 0
+            assert not ctrl._retry_timers
+            assert ctrl.config.scheduling.dispatch_retries == 0
+            # the default snapshot carries no scheduling section
+            assert "scheduling" not in ctrl.describe(event_tail=0)
+        finally:
+            ctrl.shutdown()
+
+    def test_streaming_eligibility_for_buffered_async(self):
+        from metisfl_tpu.aggregation.streaming import streaming_supported
+
+        # fedavg streams under buffered async with a real buffer...
+        assert streaming_supported("fedavg", "asynchronous_buffered",
+                                   False, 1, 1, buffer_size=8)
+        # ...but a 1-deep buffer degenerates to plain async (store path)
+        assert not streaming_supported("fedavg", "asynchronous_buffered",
+                                       False, 1, 1, buffer_size=1)
+        assert not streaming_supported("fedavg", "asynchronous",
+                                       False, 1, 1)
+        assert streaming_supported("fedrec", "asynchronous_buffered",
+                                   False, 2, 2, buffer_size=1)
+
+
+# --------------------------------------------------------------------- #
+# the seeded cross-device acceptance scenario
+# --------------------------------------------------------------------- #
+
+class TestCrossDeviceHarness:
+    def test_churn_federation_converges_at_quorum(self):
+        """Acceptance: >= 1024 virtual clients, per-round sampling, 30%
+        per-round dropout plus one flapping and one partitioned learner,
+        >= 5 rounds completing at quorum, final accuracy within
+        tolerance of the no-churn same-seed run, bounded RSS."""
+        import dataclasses
+
+        from metisfl_tpu.driver.crossdevice import (ChurnScenario,
+                                                    run_scenario)
+
+        scenario = ChurnScenario(seed=7, clients=1024, rounds=5, quorum=12,
+                                 overprovision=1.0, dropout=0.3,
+                                 flappers=1, partitioned=1,
+                                 timeout_s=120.0)
+        churn = run_scenario(scenario)
+        assert churn["ok"], churn
+        assert churn["rounds_completed"] >= 5
+        assert not churn["halted"]
+        # every round completed AT quorum (the deadline is the fallback,
+        # not the mechanism: reporters == quorum, not the whole dispatch)
+        assert all(r >= scenario.quorum
+                   for r in churn["reporters_per_round"][:5]), churn
+        # the named faults provably fired
+        assert churn["faults"]["dropped"] > 0
+        assert churn["faults"]["flapped"] >= 1
+        assert churn["faults"]["partitioned"] >= 1
+        # bounded RSS: the churn run must not grow the process by more
+        # than 256 MiB over the 1024-client federation
+        assert churn["rss_growth_kb"] < (256 << 10), churn["rss_growth_kb"]
+
+        control = run_scenario(dataclasses.replace(
+            scenario, dropout=0.0, flappers=0, partitioned=0))
+        assert control["ok"], control
+        assert abs(churn["accuracy"] - control["accuracy"]) <= 0.2, (
+            churn["accuracy"], control["accuracy"])
+        # and the task is actually learned, not trivially matched
+        assert churn["accuracy"] > 0.6
+
+    def test_buffered_async_harness_mode(self):
+        """FedBuff mode end-to-end: the same harness with a size-8 buffer
+        instead of the quorum barrier completes its rounds."""
+        from metisfl_tpu.driver.crossdevice import (ChurnScenario,
+                                                    run_scenario)
+
+        res = run_scenario(ChurnScenario(
+            seed=11, clients=256, rounds=4, buffer_size=8, dropout=0.2,
+            flappers=0, partitioned=0, timeout_s=90.0))
+        assert res["ok"], res
+        assert res["protocol"] == "asynchronous_buffered"
+        assert res["rounds_completed"] >= 4

@@ -218,11 +218,17 @@ def test_join_dispatch_does_not_postpone_round_deadline():
     ctrl = Controller(cfg, lambda record: None)
     try:
         ctrl._arm_round_deadline(restart=True)
+        timer = ctrl._deadline_timer
         serial = ctrl._round_serial
         ctrl._arm_round_deadline(restart=False)  # live timer → no-op
-        assert ctrl._round_serial == serial
+        assert ctrl._deadline_timer is timer     # NOT postponed/replaced
         ctrl._arm_round_deadline(restart=True)   # round dispatch → restart
-        assert ctrl._round_serial == serial + 1
+        assert ctrl._deadline_timer is not timer
+        # the round serial is the staleness fence for deadline AND
+        # dispatch-retry timers; it advances per fresh round dispatch
+        # (_dispatch_train), never inside the arm itself — arming with
+        # the current serial keeps a pre-restart timer stale-detectable
+        assert ctrl._round_serial == serial
     finally:
         ctrl.shutdown()
 

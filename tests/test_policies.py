@@ -102,6 +102,173 @@ class TestSchedulers:
             make_scheduler("nope")
 
 
+class TestQuorumScheduler:
+    """K-of-N quorum barriers (scheduling.quorum; ISSUE 9 tentpole)."""
+
+    def test_quorum_releases_at_k_reporters(self):
+        s = SynchronousScheduler(quorum=2)
+        s.notify_dispatched(ACTIVE)
+        assert s.schedule_next("L0", ACTIVE) == []
+        cohort = s.schedule_next("L1", ACTIVE)
+        # the reporters ARE the cohort; the straggler is out
+        assert sorted(cohort) == ["L0", "L1"]
+        # barrier fully reset for the next round
+        s.notify_dispatched(ACTIVE)
+        assert s.schedule_next("L2", ACTIVE) == []
+
+    def test_quorum_of_cohort_size_is_full_barrier(self):
+        # bit-identity pin: quorum == dispatched size (or larger) behaves
+        # exactly like the plain barrier — every release needs everyone
+        for quorum in (3, 7):
+            s = SynchronousScheduler(quorum=quorum)
+            s.notify_dispatched(ACTIVE)
+            assert s.schedule_next("L0", ACTIVE) == []
+            assert s.schedule_next("L1", ACTIVE) == []
+            assert s.schedule_next("L2", ACTIVE) == ACTIVE
+
+    def test_quorum_leave_releases_when_target_met(self):
+        # 4 dispatched, quorum 3: two report, one leaves — the shrunk
+        # barrier (3) clamps the target to 3... still short; another
+        # leave clamps to 2 < quorum → target = barrier size = 2 → release
+        active4 = ["L0", "L1", "L2", "L3"]
+        s = SynchronousScheduler(quorum=3)
+        s.notify_dispatched(active4)
+        assert s.schedule_next("L0", active4) == []
+        assert s.schedule_next("L1", active4) == []
+        assert s.handle_leave(["L0", "L1", "L2"]) == []
+        assert sorted(s.handle_leave(["L0", "L1"])) == ["L0", "L1"]
+
+    def test_drop_dispatched_shrinks_barrier_and_releases(self):
+        s = SynchronousScheduler()
+        s.notify_dispatched(ACTIVE)
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert s.schedule_next("L1", ACTIVE) == []
+        # the failed-dispatch learner leaves the barrier; the round
+        # releases with the two reporters
+        assert sorted(s.drop_dispatched("L2", ACTIVE)) == ["L0", "L1"]
+
+    def test_drop_dispatched_never_empties_barrier(self):
+        s = SynchronousScheduler()
+        s.notify_dispatched(["L0"])
+        assert s.drop_dispatched("L0", ACTIVE) == []
+        # still stalled-detectable: the barrier kept its one member
+        assert s.dispatched_ids() == {"L0"}
+
+    def test_drop_dispatched_unknown_learner_is_noop(self):
+        s = SynchronousScheduler()
+        s.notify_dispatched(ACTIVE)
+        assert s.drop_dispatched("ghost", ACTIVE) == []
+        assert s.dispatched_ids() == set(ACTIVE)
+
+
+class TestBufferedAsyncScheduler:
+    """FedBuff-style buffered asynchronous aggregation (ISSUE 9)."""
+
+    def _sched(self, k=2):
+        from metisfl_tpu.scheduling import BufferedAsynchronousScheduler
+        return BufferedAsynchronousScheduler(buffer_size=k)
+
+    def test_aggregates_per_buffer_fill(self):
+        s = self._sched(k=2)
+        assert s.redispatch_on_completion is True
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert s.schedule_next("L1", ACTIVE) == ["L0", "L1"]
+        # buffer cleared; next fill starts fresh
+        assert s.pending() == 0
+        assert s.schedule_next("L2", ACTIVE) == []
+        assert s.schedule_next("L0", ACTIVE) == ["L2", "L0"]
+
+    def test_duplicate_reporter_keeps_one_slot(self):
+        s = self._sched(k=3)
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert s.schedule_next("L0", ACTIVE) == []  # newest model, one slot
+        assert s.pending() == 1
+
+    def test_fill_target_clamps_to_active(self):
+        # a federation smaller than the buffer still aggregates
+        s = self._sched(k=10)
+        assert s.schedule_next("L0", ["L0", "L1"]) == []
+        assert s.schedule_next("L1", ["L0", "L1"]) == ["L0", "L1"]
+
+    def test_leave_shrinks_and_releases(self):
+        s = self._sched(k=3)
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert s.schedule_next("L1", ACTIVE) == []
+        # L2 left: the target clamps to the 2 survivors → release
+        assert s.handle_leave(["L0", "L1"]) == ["L0", "L1"]
+        # departed reporters leave the buffer too
+        assert s.schedule_next("L0", ["L0", "L1"]) == []
+        assert s.handle_leave(["L1"]) == []
+        assert s.pending() == 0
+
+    def test_expire_flushes_partial_buffer(self):
+        # deadline fallback: a partial fill releases instead of stalling
+        s = self._sched(k=5)
+        assert s.schedule_next("L0", ACTIVE) == []
+        assert s.schedule_next("L1", ACTIVE) == []
+        assert s.expire_pending(ACTIVE) == ["L0", "L1"]
+        assert s.expire_pending(ACTIVE) == []
+        assert not s.round_stalled(ACTIVE)
+
+    def test_factory(self):
+        from metisfl_tpu.scheduling import BufferedAsynchronousScheduler
+        s = make_scheduler("asynchronous_buffered", buffer_size=4)
+        assert isinstance(s, BufferedAsynchronousScheduler)
+        assert s.buffer_size == 4
+
+
+class TestChurnTracker:
+    """Per-learner churn/flap scores + quarantine (selection.py)."""
+
+    def test_churn_events_raise_score_completions_decay(self):
+        from metisfl_tpu.selection import ChurnTracker
+        t = ChurnTracker(alpha=0.5)
+        assert t.score("L0") == 0.0
+        assert t.note("L0", "leave") == pytest.approx(0.5)
+        assert t.note("L0", "flap_rejoin") == pytest.approx(0.75)
+        assert t.note("L0", "dispatch_failure") == pytest.approx(0.875)
+        # steady completions decay it back toward zero
+        assert t.note("L0", "completion") == pytest.approx(0.4375)
+        assert t.scores() == {"L0": pytest.approx(0.4375)}
+
+    def test_quarantine_arms_on_threshold_and_expires(self):
+        from metisfl_tpu.selection import ChurnTracker
+        t = ChurnTracker(alpha=0.5, quarantine_score=0.7, quarantine_s=60.0)
+        t.note("L0", "leave", now=100.0)
+        assert not t.quarantined("L0", now=100.0)     # 0.5 < 0.7
+        t.note("L0", "flap_rejoin", now=101.0)        # 0.75 >= 0.7
+        assert t.quarantined("L0", now=101.0)
+        assert t.quarantined_ids(now=102.0) == ["L0"]
+        # window expiry frees it
+        assert not t.quarantined("L0", now=162.0)
+        assert t.quarantined_ids(now=162.0) == []
+
+    def test_completions_never_quarantine(self):
+        from metisfl_tpu.selection import ChurnTracker
+        t = ChurnTracker(alpha=1.0, quarantine_score=0.5)
+        t.note("L0", "leave", now=1.0)
+        t.note("L0", "completion", now=2.0)  # score 0, and no re-arm
+        assert t.score("L0") == 0.0
+
+    def test_state_is_bounded(self):
+        from metisfl_tpu.selection import ChurnTracker
+        t = ChurnTracker(max_entries=16)
+        for i in range(64):
+            t.note(f"L{i}", "leave")
+        assert len(t.scores()) == 16
+        # oldest-touched evicted, newest retained
+        assert "L63" in t.scores() and "L0" not in t.scores()
+
+
+class TestStalenessFactor:
+    def test_shared_kernel_matches_batch_path(self):
+        from metisfl_tpu.scaling import staleness_factor
+        assert staleness_factor(0.0, 1.0) == 1.0
+        assert staleness_factor(3.0, 0.0) == 1.0
+        assert staleness_factor(3.0, 1.0) == pytest.approx(0.25)
+        assert staleness_factor(1.0, 2.0) == pytest.approx(0.25)
+
+
 class TestSelector:
     def test_small_schedule_selects_all_active(self):
         sel = ScheduledCardinalitySelector()
