@@ -1089,6 +1089,72 @@ def bench_churn():
     return out
 
 
+def bench_obs(sizes=(1000, 10000, 100000), budget=256):
+    """Telemetry-at-scale section (ISSUE 10; docs/OBSERVABILITY.md
+    "Telemetry at scale"): exposition time, exposition bytes, simulated
+    ``describe()`` payload bytes, and checkpoint bytes for a per-learner
+    gauge family at 1k/10k/100k simulated learner series — exact vs
+    sketch (``telemetry.cardinality_budget``) — plus the sketch's
+    quantile error against exact. Host-side and self-contained (fresh
+    registries, no process-global state); keys are direction-classified
+    for ``python -m metisfl_tpu.perf --trajectory`` (ms/bytes
+    lower-better, relerr lower-better) so ``scripts/check_bench.sh``
+    gates a regression in either representation."""
+    import json as _json
+
+    from metisfl_tpu.telemetry.metrics import Registry
+
+    labels = {1000: "1k", 10000: "10k", 100000: "100k"}
+    rng = np.random.default_rng(11)
+    out = {"obs_budget": budget}
+    for n in sizes:
+        tag = labels.get(n, str(n))
+        # straggler-score-shaped fleet: most learners near 1x, a long
+        # tail of stragglers — the distribution the digest must hold
+        values = rng.gamma(4.0, 0.25, size=n).astype(np.float64)
+        exact_q = {q: float(np.quantile(values, q)) for q in (0.5, 0.99)}
+        sketch_q = {}
+        for mode in ("exact", "sketch"):
+            reg = Registry()
+            gauge = reg.gauge("learner_straggler_score", "",
+                              ("learner",), budget_label="learner")
+            if mode == "sketch":
+                reg.set_cardinality_budget(budget)
+            for i in range(n):
+                gauge.set(float(values[i]), learner=f"L{i}")
+            t0 = time.perf_counter()
+            text = reg.render()
+            expose_s = time.perf_counter() - t0
+            # describe() payload: the per-learner table vs the digest
+            # columns + top offenders the budget substitutes for it
+            if mode == "exact":
+                payload = [{"learner_id": f"L{i}",
+                            "straggler_score": round(float(values[i]), 4),
+                            "live": True, "dispatch_failures": 0}
+                           for i in range(n)]
+                ckpt = {f"L{i}": {"ewma_train_s": float(values[i])}
+                        for i in range(n)}
+            else:
+                sketch_q = {q: gauge.quantile(q) for q in (0.5, 0.99)}
+                payload = {"count": n, "budget": budget,
+                           "columns": {"straggler_score": {
+                               f"p{int(q * 100)}": sketch_q[q]
+                               for q in sketch_q}},
+                           "top": gauge.sketch_summary(10)}
+                ckpt = reg.budget_state()
+            out[f"obs_expose_ms_{tag}_{mode}"] = round(expose_s * 1e3, 2)
+            out[f"obs_expose_bytes_{tag}_{mode}"] = len(text)
+            out[f"obs_describe_bytes_{tag}_{mode}"] = len(
+                _json.dumps(payload))
+            out[f"obs_ckpt_bytes_{tag}_{mode}"] = len(
+                _json.dumps(ckpt, default=str))
+        for q in (0.5, 0.99):
+            rel = (abs(sketch_q[q] - exact_q[q])
+                   / max(abs(exact_q[q]), 1e-12))
+            out[f"obs_q{int(q * 100)}_relerr_{tag}"] = round(rel, 6)
+    return out
+
+
 def bench_lora(require_tpu: bool = True):
     """Single-chip LoRA execution proof (VERDICT r4 #7): a ~1.2B-param
     frozen bf16 LlamaLite base + rank-16 adapters on q/v, real optimizer
@@ -1163,6 +1229,7 @@ _SECTIONS = {
     "health": lambda a: bench_health(),
     "serving": lambda a: bench_serving(),
     "churn": lambda a: bench_churn(),
+    "obs": lambda a: bench_obs(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1378,7 +1445,7 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
                      "e2e": 600, "cohort": 1200, "health": 240,
-                     "serving": 300, "churn": 240, "lora": 600}
+                     "serving": 300, "churn": 240, "obs": 240, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1425,7 +1492,8 @@ WATCHDOG_FULL_SECS = (sum(_SECTION_TIMEOUTS.values())
 _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
-_HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn")
+_HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
+                  "obs")
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_partial.json")
 

@@ -45,7 +45,7 @@ _SPAN_MIN_BYTES = 1 << 18
 _M_CODEC_LEARNER = _tmetrics.registry().counter(
     _tel.M_CODEC_LEARNER_SECONDS,
     "Codec encode/decode seconds attributed to one learner's messages",
-    ("learner", "op"))
+    ("learner", "op"), budget_label="learner")
 _ATTR: "contextvars.ContextVar[str]" = contextvars.ContextVar(
     "metisfl_tpu_codec_attr", default="")
 _ATTR_LOCK = threading.Lock()

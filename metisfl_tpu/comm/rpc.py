@@ -84,7 +84,7 @@ _M_SERVER_ERRORS = _REG.counter(
 _M_PEER_BYTES = _REG.counter(
     _tel.M_RPC_PEER_BYTES_TOTAL,
     "Client payload bytes attributed to one peer (learner id), by "
-    "direction", ("peer", "direction"))
+    "direction", ("peer", "direction"), budget_label="peer")
 
 
 def prune_peer_series(peer: str) -> None:

@@ -233,6 +233,22 @@ class TelemetryConfig:
     # durations still flow into RoundMetadata); the driver fills this in
     # with <workdir>/telemetry so controller + learner files stitch.
     dir: str = ""
+    # Cardinality budget for the per-learner metric families
+    # (docs/OBSERVABILITY.md "Telemetry at scale"): past this many
+    # series a family collapses to quantile series + top-K offender
+    # series + a distinct count (mergeable sketches, telemetry/
+    # sketch.py), bounding exposition / describe() / checkpoint at
+    # O(budget) however large the fleet. 0 (default) = exact series,
+    # today's behavior bit-identically.
+    cardinality_budget: int = 0
+    # SLO alert rules (telemetry/alerts.py AlertEngine; schema in its
+    # module docstring): threshold / rate / digest-quantile expressions
+    # with for: hold durations and resolve hysteresis. Validated at
+    # config load; empty (default) constructs no engine.
+    alerts: List[Dict[str, Any]] = field(default_factory=list)
+    # alert-engine evaluation cadence (also the sampling period of the
+    # bounded time-series ring behind status --watch sparklines)
+    alerts_interval_s: float = 1.0
     # optional plain-HTTP /metrics listener on the controller (0 = off);
     # learners take --metrics-port on their CLI instead (N learners on
     # one host cannot share a configured port)
@@ -566,6 +582,20 @@ class FederationConfig:
             # a negative period would silently never fire via the modulo
             raise ValueError(
                 "telemetry.profile.trace_every_rounds must be >= 0")
+        if self.telemetry.cardinality_budget < 0:
+            raise ValueError("telemetry.cardinality_budget must be >= 0")
+        if self.telemetry.alerts_interval_s <= 0.0:
+            raise ValueError("telemetry.alerts_interval_s must be > 0")
+        if self.telemetry.alerts:
+            # a typo'd rule must fail at config time, not at fire time —
+            # an alert that silently never evaluates "watches" nothing
+            # (same posture as the chaos-rule validation below)
+            from metisfl_tpu.telemetry.alerts import validate_rules
+            try:
+                validate_rules(self.telemetry.alerts)
+            except (TypeError, ValueError) as exc:
+                raise ValueError(f"invalid telemetry.alerts rule: "
+                                 f"{exc}") from None
         if not 0.0 < self.aggregation.participation_ratio <= 1.0:
             raise ValueError("participation_ratio must be in (0, 1]")
         if self.model_store.ingest_workers < 0:

@@ -81,7 +81,7 @@ _M_PHASE = _REG.histogram(
     "aggregate_block/store_insert/close)", ("phase",))
 _M_UPLINK = _REG.counter(
     _tel.M_UPLINK_BYTES_TOTAL, "Model bytes received from learners",
-    ("learner",))
+    ("learner",), budget_label="learner")
 _M_ACTIVE_LEARNERS = _REG.gauge(
     _tel.M_CONTROLLER_ACTIVE_LEARNERS, "Currently registered learners")
 _M_AGG_FAILURES = _REG.counter(
@@ -89,12 +89,14 @@ _M_AGG_FAILURES = _REG.counter(
 _M_STRAGGLER = _REG.gauge(
     _tel.M_LEARNER_STRAGGLER_SCORE,
     "Round-relative straggler score: EWMA train duration over the "
-    "cohort median (1.0 = typical, >1 = slower)", ("learner",))
+    "cohort median (1.0 = typical, >1 = slower)", ("learner",),
+    budget_label="learner")
 _M_DIVERGENCE = _REG.gauge(
     _tel.M_LEARNER_DIVERGENCE_SCORE,
     "Learning-health divergence score: EWMA of the cohort-median/MAD "
     "robust z of each update's deviation from the cohort mean "
-    "(0 = typical, higher = pulling against the cohort)", ("learner",))
+    "(0 = typical, higher = pulling against the cohort)", ("learner",),
+    budget_label="learner")
 _M_ROUND_UPDATE_NORM = _REG.gauge(
     _tel.M_ROUND_UPDATE_NORM,
     "L2 norm of the latest community-model update (telemetry/health.py)")
@@ -116,7 +118,7 @@ _M_CHURN = _REG.gauge(
     _tel.M_LEARNER_CHURN_SCORE,
     "Churn/flap score: EWMA of leave, flap-rejoin, and failed-dispatch "
     "events (0 = stable, approaching 1 = flapping; selection.py "
-    "ChurnTracker)", ("learner",))
+    "ChurnTracker)", ("learner",), budget_label="learner")
 
 # EWMA smoothing for per-learner train/eval durations (straggler
 # analytics): ~the last 3-4 rounds dominate, so a recovered learner's
@@ -237,6 +239,11 @@ class RoundMetadata:
     # timings. Empty when the performance observatory is off — pre-profile
     # payloads lack the key and stats.py renders them unchanged.
     profile: Dict[str, Any] = field(default_factory=dict)
+    # cardinality-budget snapshot (telemetry/metrics.py): per collapsed
+    # per-learner family, the round-close quantiles / top offenders /
+    # distinct-series count. Empty below budget (and with the budget
+    # off) — pre-budget payloads lack the key and render unchanged.
+    metrics_digest: Dict[str, Any] = field(default_factory=dict)
     # non-fatal round errors (e.g. partial-cohort secure aggregation after a
     # deadline) — surfaced in lineage instead of vanishing into a log line
     errors: List[str] = field(default_factory=list)
@@ -430,6 +437,11 @@ class Controller:
         # and the live backoff timers shutdown() must cancel
         self._dispatch_retries_used = 0
         self._retry_timers: Dict[object, None] = {}
+        # round-scoped cache of the fleet's median observed train EWMA
+        # (collapsed-straggler-gauge fast path: the median only moves
+        # meaningfully at round granularity, so per-uplink O(fleet)
+        # median recomputation is wasted work under the controller lock)
+        self._straggler_median_cache: Optional[float] = None
         # guards against recursive checkpointing while restore itself
         # replays the community model through set_community_model
         self._in_restore = False
@@ -466,6 +478,33 @@ class Controller:
             # the flight recorder snapshots the active collector's tail
             # into crash bundles
             _tprofile.set_collector(self._profile)
+
+        # Telemetry-at-scale plane (docs/OBSERVABILITY.md "Telemetry at
+        # scale"): (a) cardinality budget — past it the per-learner
+        # metric families serve sketches, DescribeFederation serves
+        # digest columns, and the checkpoint persists digests instead of
+        # per-learner series. 0 (default) keeps everything exact.
+        self._cardinality_budget = 0
+        if config.telemetry.enabled:
+            self._cardinality_budget = int(
+                getattr(config.telemetry, "cardinality_budget", 0) or 0)
+            if self._cardinality_budget > 0:
+                _REG.set_cardinality_budget(self._cardinality_budget)
+        # (b) SLO alert engine (telemetry/alerts.py): None when no rules
+        # are configured — the round-close hook is one attribute check.
+        self._alerts = None
+        alert_specs = getattr(config.telemetry, "alerts", None) or []
+        if config.telemetry.enabled and alert_specs:
+            from metisfl_tpu.telemetry import alerts as _talerts
+            self._alerts = _talerts.AlertEngine(
+                _talerts.validate_rules(alert_specs),
+                registry=_REG,
+                interval_s=getattr(config.telemetry, "alerts_interval_s",
+                                   1.0))
+            # the flight recorder snapshots the live engine's active
+            # alerts into crash bundles ("alerts at death")
+            _talerts.set_engine(self._alerts)
+            self._alerts.start()
 
         # Model lifecycle plane (registry/registry.py): versioned
         # community-model lineage with eval-gated promotion. None when
@@ -509,6 +548,14 @@ class Controller:
         with self._lock:
             if self._deadline_timer is not None:
                 self._deadline_timer.cancel()
+        # the alert engine's evaluation daemon must not outlive the
+        # controller (and its active-alert gauge series must prune so a
+        # later in-process controller starts clean)
+        if self._alerts is not None:
+            from metisfl_tpu.telemetry import alerts as _talerts
+            if _talerts.engine() is self._alerts:
+                _talerts.set_engine(None)
+            self._alerts.shutdown()
         # ingest workers write INTO the store: stop them (bounded drain)
         # before the store's own shutdown
         if self._ingest is not None:
@@ -692,24 +739,21 @@ class Controller:
         return True
 
     def _prune_learner_series(self, learner_id: str) -> None:
-        """Drop every per-learner gauge/counter series and health state
+        """Drop every per-learner gauge/counter series and plane state
         for a learner that left or was replaced — long-churn runs must
-        not accumulate stale labels in the exposition."""
-        _M_UPLINK.remove(learner=learner_id)
-        _M_STRAGGLER.remove(learner=learner_id)
-        _M_DIVERGENCE.remove(learner=learner_id)
-        _M_CHURN.remove(learner=learner_id)
+        not accumulate stale labels in the exposition. The series prune
+        itself is ONE central call (telemetry.prune_learner covers every
+        family registered with a learner/peer cardinality label, plus
+        the codec/RPC attribution state behind them — the drift guard in
+        tests/test_scaletel.py keeps future per-learner families from
+        escaping it); the planes only drop their own non-series state."""
+        _tel.prune_learner(learner_id)
         if self._health is not None:
             self._health.drop(learner_id)
         if self._profile is not None:
-            # downlink bytes, MFU/step-time/HBM gauges, codec attribution
-            # and peer wire-byte series all prune together
+            # per-learner byte/insert/device attribution inside the
+            # collector (its gauge series are already pruned above)
             self._profile.drop(learner_id)
-        else:
-            # profile off NOW, but codec/peer attribution may have been
-            # minted earlier (e.g. before a config change + resume) —
-            # those series must never outlive the learner either
-            _tprofile.prune_attribution_series(learner_id)
 
     def _note_churn(self, learner_id: str, event: str) -> None:
         """Fold one membership event into the learner's churn/flap score
@@ -933,7 +977,7 @@ class Controller:
         _tevents.emit(_tevents.TaskCompleted, task_id=result.task_id,
                       learner_id=result.learner_id, round=result.round_id,
                       stale=stale, uplink_bytes=len(result.model))
-        self._update_straggler_gauge()
+        self._update_straggler_gauge(completed=result.learner_id)
         # a delivered uplink is the churn score's decay tick: a learner
         # that reports steadily recovers from past flaps within a few
         # rounds (same recovery posture as the straggler EWMA)
@@ -1471,6 +1515,7 @@ class Controller:
         close_sp = _ttrace.span("round.close", parent=self._round_span)
         self._fold_round_health()
         self._register_round_version()
+        self._note_round_telemetry()
         self._send_eval_tasks()
         close_ms = close_sp.end()
         _M_PHASE.observe(close_ms / 1e3, phase="close")
@@ -1492,6 +1537,8 @@ class Controller:
             self.round_metadata.append(self._current_meta)
             self._current_meta = RoundMetadata(
                 global_iteration=self.global_iteration)
+            # next round's uplinks re-derive the straggler median once
+            self._straggler_median_cache = None
             round_sp, self._round_span = self._round_span, None
         if profile_record is not None:
             # the JSONL sink write stays off the controller lock
@@ -1520,6 +1567,32 @@ class Controller:
         else:
             next_ids = self._sample_cohort()
         self._dispatch_train(next_ids)
+
+    def _note_round_telemetry(self) -> None:
+        """Round-close hook for the telemetry-at-scale plane: one
+        synchronous alert evaluation (round-paced even when the engine
+        daemon lags behind a fast federation) and the collapsed metric
+        families' digest snapshot into ``RoundMetadata.metrics_digest``.
+        Two attribute checks when the plane is off; never raises
+        (telemetry must not trip the aggregation-failure retry path)."""
+        if self._alerts is not None:
+            try:
+                self._alerts.poll()
+            except Exception:  # noqa: BLE001 - alerting never fails a round
+                logger.exception("round-close alert poll failed")
+        if self._cardinality_budget <= 0:
+            return
+        try:
+            digest: Dict[str, Any] = {}
+            for family in _REG.budget_families():
+                summary = family.sketch_summary()
+                if summary is not None:
+                    digest[family.name] = summary
+            if digest:
+                with self._lock:
+                    self._current_meta.metrics_digest = digest
+        except Exception:  # noqa: BLE001 - telemetry never fails a round
+            logger.exception("round-close metrics digest failed")
 
     def _idle_reporters(self, cohort: Sequence[str]) -> List[str]:
         """The cohort members that are active and NOT already carrying an
@@ -2384,6 +2457,14 @@ class Controller:
             # promoted model across a controller crash. Outside the
             # controller lock — the export takes the registry's own.
             state["registry"] = self._registry.export_state()
+        if self._cardinality_budget > 0:
+            # collapsed per-learner families persist as sketches —
+            # O(budget) checkpoint bytes however large the fleet, and
+            # the digest quantiles survive --resume failover (empty dict
+            # below budget: nothing has collapsed, series are exact)
+            budget_state = _REG.budget_state()
+            if budget_state:
+                state["metrics_budget"] = budget_state
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # unique temp per writer: concurrent saves (per-round auto-checkpoint
@@ -2473,6 +2554,12 @@ class Controller:
             # monotonic across incarnations and the serving gateway's
             # next poll sees the same stable head it served before
             self._registry.restore_state(registry_state)
+        metrics_budget = state.get("metrics_budget")
+        if metrics_budget and self._cardinality_budget > 0:
+            # rehydrate the collapsed families' sketches: the restored
+            # controller keeps answering digest quantiles for the whole
+            # pre-crash fleet instead of restarting from "no history"
+            _REG.restore_budget_state(metrics_budget)
         health_state = state.get("health")
         if health_state and self._health is not None:
             self._health.restore_state(health_state)
@@ -2527,6 +2614,46 @@ class Controller:
         mid = median(positive) if positive else 0.0
         return {lid: (v / mid if (v > 0.0 and mid > 0.0) else 0.0)
                 for lid, v in ewmas.items()}
+
+    def _describe_digest_locked(self, scores: Dict[str, float],
+                                div_scores: Dict[str, float],
+                                churn_scores: Dict[str, float],
+                                quarantined: set, limit: int
+                                ) -> Dict[str, Any]:
+        """Quantile columns for the above-budget DescribeFederation
+        snapshot: the registry records are exact controller state, so
+        the p50/p90/p99 here are exact — it is the *payload*, not the
+        math, the budget bounds. Call with ``self._lock`` held."""
+        def _q(values: List[float]) -> Dict[str, float]:
+            if not values:
+                return {"p50": 0.0, "p90": 0.0, "p99": 0.0, "max": 0.0}
+            ordered = sorted(values)
+            at = partial(_tmetrics.exact_quantile, ordered)
+            return {"p50": round(at(0.5), 4), "p90": round(at(0.9), 4),
+                    "p99": round(at(0.99), 4), "max": round(ordered[-1], 4)}
+
+        records = self._learners
+        live = sum(1 for r in records.values()
+                   if limit <= 0 or r.dispatch_failures < limit)
+        columns = {
+            "straggler_score": _q([scores.get(lid, 0.0) for lid in records]),
+            "ewma_train_s": _q([r.ewma_train_s for r in records.values()]),
+            "dispatch_failures": _q([float(r.dispatch_failures)
+                                     for r in records.values()]),
+        }
+        if self._health is not None:
+            columns["divergence_score"] = _q(
+                [div_scores.get(lid, 0.0) for lid in records])
+        if self._churn is not None:
+            columns["churn_score"] = _q(
+                [churn_scores.get(lid, 0.0) for lid in records])
+        return {
+            "count": len(records),
+            "live": live,
+            "budget": self._cardinality_budget,
+            "quarantined": len(quarantined),
+            "columns": columns,
+        }
 
     def _fold_round_health(self) -> None:
         """Learning-health cohort fold for the round that just aggregated
@@ -2674,12 +2801,40 @@ class Controller:
             self._checkpoint_async()
         return info
 
-    def _update_straggler_gauge(self) -> None:
+    def _update_straggler_gauge(self, completed: Optional[str] = None
+                                ) -> None:
         # set() under the controller lock, like _M_UPLINK.inc: leave()
         # deletes the record under this lock and prunes the series after,
         # so an unlocked set here could interleave and resurrect a
         # departed learner's series (unbounded cardinality under churn)
         with self._lock:
+            if completed is not None and _M_STRAGGLER.collapsed():
+                # cross-device scale: a full-fleet refresh per uplink is
+                # O(fleet) work 600 times a round at 10k clients. Once
+                # the family is actually COLLAPSED (not merely budget-
+                # armed: a sub-budget fleet keeps exact series, and
+                # exact series must keep re-normalizing against the
+                # moving median) only the reporter's score is
+                # re-observed — against the median of OBSERVED ewmas,
+                # which is what the full refresh normalizes by too.
+                record = self._learners.get(completed)
+                if record is None or record.ewma_train_s <= 0.0:
+                    return
+                mid = self._straggler_median_cache
+                if mid is None:
+                    # recomputed at most once per round (invalidated at
+                    # round close): the O(fleet) scan must not run per
+                    # uplink under the controller lock
+                    from statistics import median
+
+                    positive = [r.ewma_train_s
+                                for r in self._learners.values()
+                                if r.ewma_train_s > 0.0]
+                    mid = median(positive) if positive else 0.0
+                    self._straggler_median_cache = mid
+                score = record.ewma_train_s / mid if mid > 0.0 else 0.0
+                _M_STRAGGLER.set(round(score, 4), learner=completed)
+                return
             for lid, score in self._straggler_scores().items():
                 _M_STRAGGLER.set(round(score, 4), learner=lid)
 
@@ -2700,11 +2855,14 @@ class Controller:
         if self._churn is not None:
             churn_scores = self._churn.scores()
             quarantined = set(self._churn.quarantined_ids(now))
+        budget = self._cardinality_budget
+        learners_digest: Optional[Dict[str, Any]] = None
         with self._lock:
             scores = self._straggler_scores()
             limit = self.config.max_dispatch_failures
-            learners = [
-                {
+
+            def _row(lid: str, r: "LearnerRecord") -> Dict[str, Any]:
+                return {
                     "learner_id": r.learner_id,
                     "hostname": r.hostname,
                     "port": r.port,
@@ -2729,8 +2887,24 @@ class Controller:
                         "quarantined": lid in quarantined}
                        if self._churn is not None else {}),
                 }
-                for lid, r in sorted(self._learners.items())
-            ]
+
+            if budget > 0 and len(self._learners) > budget:
+                # cardinality-safe snapshot (docs/OBSERVABILITY.md
+                # "Telemetry at scale"): above budget the per-learner
+                # table would make every status poll O(fleet) — ship
+                # quantile columns + the top offenders instead. Below
+                # budget (or budget off) the snapshot is byte-identical
+                # to the exact shape (test-pinned).
+                learners_digest = self._describe_digest_locked(
+                    scores, div_scores, churn_scores, quarantined, limit)
+                offenders = sorted(
+                    self._learners,
+                    key=lambda lid: -scores.get(lid, 0.0))[:10]
+                learners = [_row(lid, self._learners[lid])
+                            for lid in sorted(offenders)]
+            else:
+                learners = [_row(lid, r)
+                            for lid, r in sorted(self._learners.items())]
             in_flight = [
                 {"task_id": tid, "learner_id": lid,
                  "age_s": round(max(
@@ -2746,17 +2920,29 @@ class Controller:
                 "aggregation_rule": self._aggregator.name,
                 "shutdown": self._shutdown.is_set(),
             }
-        # store occupancy OUTSIDE our lock (the store has its own)
+        # store occupancy OUTSIDE our lock (the store has its own). In
+        # digest mode the per-learner map is elided too — it is the same
+        # O(fleet) payload the learner table was.
         occupancy = {lid: self._store.size(lid)
                      for lid in self._store.learner_ids()}
         snapshot.update({
             "learners": learners,
             "in_flight": in_flight,
-            "store": {"models": occupancy,
-                      "total": sum(occupancy.values())},
+            "store": ({"models": {}, "learners": len(occupancy),
+                       "total": sum(occupancy.values())}
+                      if learners_digest is not None else
+                      {"models": occupancy,
+                       "total": sum(occupancy.values())}),
             "events": _tevents.tail(event_tail) if event_tail else [],
             "time": round(now, 6),
         })
+        if learners_digest is not None:
+            snapshot["learners_digest"] = learners_digest
+        if self._alerts is not None:
+            # SLO alerting plane: active alerts + lifecycle counts, and
+            # the bounded time-series ring behind status sparklines
+            snapshot["alerts"] = self._alerts.summary(now=now)
+            snapshot["timeseries"] = self._alerts.series_snapshot()
         sched_cfg = self.config.scheduling
         if (self._quorum > 0 or sched_cfg.dispatch_retries > 0
                 or self._scheduler.name == "asynchronous_buffered"

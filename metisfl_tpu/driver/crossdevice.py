@@ -107,6 +107,22 @@ class ChurnScenario:
     # >0: run protocol=asynchronous_buffered with this buffer instead of
     # the quorum barrier (FedBuff mode; quorum is then ignored)
     buffer_size: int = 0
+    # telemetry at scale (docs/OBSERVABILITY.md): >0 arms
+    # telemetry.cardinality_budget so the per-learner metric families
+    # collapse to sketches past this many series — the 10k+-client
+    # acceptance scenario runs under a budget of 256
+    cardinality_budget: int = 0
+    # arm the SLO alert smoke rule (a dispatch_retries_total rate rule
+    # that provably fires under the partition fault and stays silent in
+    # the no-churn control; scripts/chaos_smoke.sh gates on it)
+    alert_smoke: bool = False
+    alert_window_s: float = 3.0
+    # alert-smoke determinism: round 1's virtual clients hold their
+    # uplink this long so the round provably outlasts the (shortened)
+    # dispatch-retry backoff — the retry that feeds the rate rule must
+    # land while its round is still open, not race a 50 ms quorum
+    # release. Applied in churn AND control (same wall-clock shape).
+    alert_round1_delay_s: float = 0.15
     # simulation plumbing
     workers: int = 8
     timeout_s: float = 120.0
@@ -157,18 +173,40 @@ class CrossDeviceHarness:
     def __init__(self, scenario: ChurnScenario):
         self.scenario = scenario
         s = scenario
+        # alert-smoke mode needs the retry to land inside its round (see
+        # alert_round1_delay_s); the default 0.5 s backoff would lose the
+        # race against a fast quorum release every time
+        backoff = 0.05 if s.alert_smoke else 0.5
         if s.buffer_size > 0:
             protocol, sched = "asynchronous_buffered", SchedulingConfig(
                 buffer_size=s.buffer_size,
                 quarantine_score=s.quarantine_score,
                 quarantine_s=s.quarantine_s,
-                dispatch_retries=s.dispatch_retries)
+                dispatch_retries=s.dispatch_retries,
+                retry_backoff_s=backoff)
         else:
             protocol, sched = "synchronous", SchedulingConfig(
                 quorum=s.quorum, overprovision=s.overprovision,
                 quarantine_score=s.quarantine_score,
                 quarantine_s=s.quarantine_s,
-                dispatch_retries=s.dispatch_retries)
+                dispatch_retries=s.dispatch_retries,
+                retry_backoff_s=backoff)
+        alert_rules = []
+        if s.alert_smoke:
+            # fires only under churn: the partitioned client's dispatch
+            # raises, the retry plane replaces it, and the rate of
+            # dispatch_retries_total lifts off 0 — the no-churn control
+            # run never increments the counter, so the rule stays silent
+            # there (scripts/chaos_smoke.sh asserts both halves)
+            alert_rules = [{
+                "name": "dispatch_retry_burst",
+                "metric": "dispatch_retries_total",
+                "kind": "rate",
+                "window_s": s.alert_window_s,
+                "threshold": 0.01,
+                "for_s": 0.0,
+                "severity": "warning",
+            }]
         self.config = FederationConfig(
             protocol=protocol,
             scheduling=sched,
@@ -179,10 +217,14 @@ class CrossDeviceHarness:
             eval=EvalConfig(every_n_rounds=0),
             # the harness measures scheduling, not observability: the
             # health/profile planes stay off so a 1024-client round costs
-            # controller bookkeeping only
+            # controller bookkeeping only (the cardinality budget and the
+            # alert smoke rule are exactly the planes under test here)
             telemetry=TelemetryConfig(
                 health=HealthConfig(enabled=False),
-                profile=ProfileConfig(enabled=False)),
+                profile=ProfileConfig(enabled=False),
+                cardinality_budget=s.cardinality_budget,
+                alerts=alert_rules,
+                alerts_interval_s=0.25),
         )
         self.controller = Controller(self.config, self._make_proxy)
         self._pool = ThreadPoolExecutor(
@@ -304,6 +346,10 @@ class CrossDeviceHarness:
             x, y = self._client_data(idx)
             s = self.scenario
             trained = _local_train(weights, x, y, s.local_steps, s.lr)
+            if s.alert_smoke and task.round_id == 1:
+                # hold round 1 open past the retry backoff (see
+                # alert_round1_delay_s) — identical in churn + control
+                time.sleep(s.alert_round1_delay_s)
             self.controller.task_completed(TaskResult(
                 task_id=task.task_id, learner_id=learner_id,
                 auth_token=token, round_id=task.round_id,
@@ -327,6 +373,45 @@ class CrossDeviceHarness:
         x, y = self._test_data()
         pred = np.argmax(x @ weights["w"] + weights["b"], axis=-1)
         return float(np.mean(pred == y))
+
+    def _settle_alerts(self) -> Optional[Dict[str, Any]]:
+        """Drain the alert lifecycle before shutdown: with the faults
+        over, the rate windows slide empty and every firing alert must
+        resolve — the end-to-end firing→resolved proof the chaos smoke
+        gates on. None when the alert smoke is not armed."""
+        engine = self.controller._alerts
+        if engine is None:
+            return None
+        deadline = time.time() + 3.0 * self.scenario.alert_window_s + 2.0
+        while engine.active() and time.time() < deadline:
+            engine.poll()
+            time.sleep(0.1)
+        return {
+            "fired": engine.fired_total,
+            "resolved": engine.resolved_total,
+            "active_at_end": [a["name"] for a in engine.active()],
+        }
+
+    def _telemetry_stats(self) -> Optional[Dict[str, Any]]:
+        """Exposition-side evidence for the cardinality budget: series
+        and bytes in one scrape, plus which families collapsed. None
+        when the budget is not armed."""
+        if self.scenario.cardinality_budget <= 0:
+            return None
+        from metisfl_tpu import telemetry as _tel
+
+        text = _tel.render_metrics()
+        collapsed = sorted(
+            f.name for f in _tel.registry().budget_families()
+            if f.collapsed())
+        return {
+            "budget": self.scenario.cardinality_budget,
+            "exposition_bytes": len(text),
+            "exposition_series": sum(
+                1 for line in text.splitlines()
+                if line and not line.startswith("#")),
+            "collapsed_families": collapsed,
+        }
 
     def run(self) -> Dict[str, Any]:
         s = self.scenario
@@ -382,11 +467,16 @@ class CrossDeviceHarness:
             completed = self.controller.global_iteration
             metas = self.controller.get_runtime_metadata()
             acc = self.accuracy()
+            alerts_out = self._settle_alerts()
+            telemetry_out = self._telemetry_stats()
             self.controller.shutdown()
             self._pool.shutdown(wait=True)
         rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         reporters = [len(m.get("train_received_at", {})) for m in metas]
         return {
+            **({"alerts": alerts_out} if alerts_out is not None else {}),
+            **({"telemetry": telemetry_out}
+               if telemetry_out is not None else {}),
             "clients": s.clients,
             "protocol": self.config.protocol,
             "quorum": 0 if s.buffer_size else s.quorum,
@@ -432,16 +522,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="max |accuracy(churn) - accuracy(no churn)|")
     parser.add_argument("--skip-control", action="store_true",
                         help="skip the no-churn same-seed control run")
+    parser.add_argument("--budget", type=int, default=0,
+                        help=">0: arm telemetry.cardinality_budget — the "
+                             "per-learner metric families collapse to "
+                             "sketches past this many series")
+    parser.add_argument("--alert-smoke", action="store_true",
+                        help="arm the dispatch-retry rate alert and FAIL "
+                             "unless it fires and resolves under churn "
+                             "while staying silent in the control run")
     args = parser.parse_args(argv)
 
     scenario = ChurnScenario(
         seed=args.seed, clients=args.clients, rounds=args.rounds,
         quorum=args.quorum, overprovision=args.overprovision,
         dropout=args.dropout, buffer_size=args.buffer,
-        round_deadline_secs=args.deadline, timeout_s=args.timeout)
+        round_deadline_secs=args.deadline, timeout_s=args.timeout,
+        cardinality_budget=args.budget, alert_smoke=args.alert_smoke)
     churn = run_scenario(scenario)
     out: Dict[str, Any] = {"churn": churn}
     ok = churn["ok"]
+    if args.alert_smoke:
+        # the firing→resolved lifecycle, end to end: the partition fault
+        # must have tripped the rate rule, and the drained run must have
+        # resolved it (an alert that cannot resolve pages forever)
+        alerts = churn.get("alerts") or {}
+        alert_ok = (alerts.get("fired", 0) >= 1
+                    and alerts.get("resolved", 0) >= 1
+                    and not alerts.get("active_at_end"))
+        out["alert_lifecycle_ok"] = alert_ok
+        ok = ok and alert_ok
     if not args.skip_control:
         control = run_scenario(dataclasses.replace(
             scenario, dropout=0.0, flappers=0, partitioned=0))
@@ -450,6 +559,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         out["accuracy_gap"] = round(gap, 4)
         out["tolerance"] = args.tolerance
         ok = ok and control["ok"] and gap <= args.tolerance
+        if args.alert_smoke:
+            # same-seed control has no faults: the rule must stay silent
+            control_quiet = (control.get("alerts") or {}).get(
+                "fired", 0) == 0
+            out["alert_control_quiet"] = control_quiet
+            ok = ok and control_quiet
     out["ok"] = ok
     print(json.dumps(out))
     return 0 if ok else 1

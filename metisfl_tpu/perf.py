@@ -361,7 +361,10 @@ _HIGHER_BETTER = ("mfu", "per_sec", "tokens_per", "samples_per",
                   "throughput", "vs_baseline", "hit_rate", "tflops",
                   "rows_per", "speedup", "accuracy")
 _LOWER_BETTER = ("_ms", "ms_per", "_secs", "seconds", "_bytes", "_mb",
-                 "_kb", "rss", "wall", "latency", "pause")
+                 "_kb", "rss", "wall", "latency", "pause",
+                 # obs section: sketch-vs-exact quantile error — a
+                 # growing error means the digest got worse, a regression
+                 "relerr")
 
 
 def metric_direction(key: str) -> int:

@@ -20,6 +20,15 @@ effective step / participation entropy / cohort loss, plus per-learner
 occupancy, and the tail of the controller's event journal. ``--probe`` additionally reflects each
 registered endpoint's RPC surface over the ``ListMethods`` RPC
 (service-discovery parity with the reference's gRPC reflection).
+
+Telemetry at scale (docs/OBSERVABILITY.md): with
+``telemetry.cardinality_budget`` armed and the fleet above it, the
+snapshot ships a ``learners_digest`` instead of the O(fleet) table and
+this CLI renders quantile columns plus the top offenders; with
+``telemetry.alerts`` configured it adds an ``alerts:`` line (firing
+rules, lifecycle counts) and live sparklines from the controller's
+bounded time-series ring. Sub-budget snapshots render byte-identically
+to the per-learner table (test-pinned).
 """
 
 from __future__ import annotations
@@ -100,6 +109,38 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
         if quarantined:
             cells.append(f"QUARANTINED={','.join(quarantined)}")
         lines.append("scheduling: " + "  ".join(cells))
+    alerts = snap.get("alerts") or {}
+    if alerts.get("enabled"):
+        # SLO alerting plane (telemetry/alerts.py); controllers without
+        # an engine ship no "alerts" key and render as before
+        active = alerts.get("active") or []
+        if active:
+            cells = ", ".join(
+                f"{a.get('name', '?')}[{a.get('severity', '?')}] "
+                f"{a.get('expr', '')} value={a.get('value', 0.0):g} "
+                f"for {_fmt_s(float(a.get('active_s', 0.0)))}"
+                for a in active)
+            lines.append(f"alerts: FIRING {len(active)}: {cells}")
+        else:
+            lines.append(
+                f"alerts: none firing  rules={alerts.get('rules', 0)}  "
+                f"fired={alerts.get('fired_total', 0)}  "
+                f"resolved={alerts.get('resolved_total', 0)}")
+    series = snap.get("timeseries") or {}
+    if series:
+        # live time-series sparklines from the controller's bounded ring
+        # (telemetry/timeseries.py): newest sample on the right
+        from metisfl_tpu.telemetry.timeseries import sparkline
+        shown = 0
+        for name in sorted(series):
+            if shown >= 6:
+                break
+            points = (series[name] or {}).get("points") or []
+            if len(points) < 2:
+                continue
+            shown += 1
+            lines.append(f"  {name:<34} {sparkline(points):<24} "
+                         f"last={points[-1]:g}")
     prof = snap.get("profile") or {}
     if prof.get("enabled") and prof.get("rounds_profiled"):
         # performance-observatory line (telemetry/profile.py): the latest
@@ -116,6 +157,34 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
             + (f" ({phases.get(top, 0.0) / 1e3:.2f}s)" if phases else "")
             + f"  up={float(prof.get('uplink_bytes', 0.0)) / 1e6:.2f}MB"
             f"  down={float(prof.get('downlink_bytes', 0.0)) / 1e6:.2f}MB")
+    digest = snap.get("learners_digest") or {}
+    if digest:
+        # cardinality-safe snapshot (telemetry.cardinality_budget): the
+        # fleet is above budget, so quantile columns replace the
+        # per-learner table and only the top offenders list by name.
+        # Sub-budget snapshots ship no "learners_digest" key and the
+        # exact table below renders byte-identically (test-pinned).
+        lines.append("")
+        lines.append(
+            f"fleet: {digest.get('live', 0)}/{digest.get('count', 0)} live"
+            f"  (cardinality budget {digest.get('budget', 0)}: quantile "
+            "digest replaces the per-learner table)"
+            + (f"  quarantined={digest['quarantined']}"
+               if digest.get("quarantined") else ""))
+        columns = digest.get("columns") or {}
+        if columns:
+            lines.append(f"  {'metric':<20} {'p50':>9} {'p90':>9} "
+                         f"{'p99':>9} {'max':>9}")
+            for name in sorted(columns):
+                cells = columns[name] or {}
+                lines.append(
+                    f"  {name:<20} {cells.get('p50', 0.0):>9.4g} "
+                    f"{cells.get('p90', 0.0):>9.4g} "
+                    f"{cells.get('p99', 0.0):>9.4g} "
+                    f"{cells.get('max', 0.0):>9.4g}")
+        if learners:
+            lines.append(f"  top offenders by straggler score "
+                         f"({len(learners)} of {digest.get('count', 0)}):")
     has_div = any("divergence_score" in l for l in learners)
     has_churn = any("churn_score" in l for l in learners)
     if learners:

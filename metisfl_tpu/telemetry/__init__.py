@@ -26,10 +26,22 @@ while it is stuck, not after the experiment ends):
   surfaced through every plane above (opt-out via
   ``telemetry.health.enabled=false``; controller-local, so
   :func:`apply_config` has nothing process-global to arm for it).
+- :mod:`metisfl_tpu.telemetry.sketch` + cardinality budgets in the
+  metrics registry — past ``telemetry.cardinality_budget`` the
+  per-learner families collapse to mergeable quantile digests and
+  top-K heavy-hitter sketches, bounding exposition / status /
+  checkpoints at O(budget) for 100k-client fleets
+  (docs/OBSERVABILITY.md "Telemetry at scale").
+- :mod:`metisfl_tpu.telemetry.alerts` — the SLO alerting plane:
+  config-driven threshold / rate / digest-quantile rules with ``for:``
+  holds and resolve hysteresis, evaluated over the bounded
+  :mod:`metisfl_tpu.telemetry.timeseries` ring that also feeds the
+  ``status --watch`` sparklines.
 - ``python -m metisfl_tpu.telemetry <trace dir or .jsonl>`` renders a
   round's span tree from the sink; ``--postmortem`` renders the
-  pre-crash timeline from bundles; ``python -m metisfl_tpu.status``
-  live-watches a running federation over ``DescribeFederation``.
+  pre-crash timeline from bundles (including alerts at death);
+  ``python -m metisfl_tpu.status`` live-watches a running federation
+  over ``DescribeFederation``.
 
 Everything is opt-out via federation config ``telemetry.enabled=false``
 (:func:`apply_config`), and the event journal separately via
@@ -39,7 +51,16 @@ attribute-check cheap.
 
 from __future__ import annotations
 
-from metisfl_tpu.telemetry import events, health, metrics, postmortem, trace
+from metisfl_tpu.telemetry import (
+    events,
+    health,
+    metrics,
+    postmortem,
+    sketch,
+    timeseries,
+    trace,
+)
+from metisfl_tpu.telemetry import alerts  # needs events/metrics/timeseries
 from metisfl_tpu.telemetry.metrics import parse_exposition, registry
 from metisfl_tpu.telemetry.trace import (
     METADATA_KEY,
@@ -118,6 +139,12 @@ M_REGISTRY_VERSIONS_TOTAL = "registry_versions_total"
 M_REGISTRY_VERSION_STATE = "registry_version_state"
 M_REGISTRY_PROMOTIONS_TOTAL = "registry_promotions_total"
 M_REGISTRY_ROLLBACKS_TOTAL = "registry_rollbacks_total"
+# telemetry-at-scale plane (telemetry/metrics.py cardinality budgets +
+# telemetry/alerts.py; docs/OBSERVABILITY.md "Telemetry at scale")
+M_METRICS_SERIES_OVERFLOW_TOTAL = metrics.SERIES_OVERFLOW_TOTAL
+M_METRICS_FAMILY_SERIES = metrics.FAMILY_SERIES
+M_ALERTS_ACTIVE = alerts.ALERTS_ACTIVE
+M_ALERTS_FIRED_TOTAL = alerts.ALERTS_FIRED_TOTAL
 # serving gateway (serving/gateway.py)
 M_SERVING_REQUESTS_TOTAL = "serving_requests_total"
 M_SERVING_REQUEST_LATENCY_SECONDS = "serving_request_latency_seconds"
@@ -132,7 +159,11 @@ __all__ = [
     "events",
     "health",
     "postmortem",
+    "alerts",
+    "sketch",
+    "timeseries",
     "registry",
+    "prune_learner",
     "parse_exposition",
     "span",
     "current_context",
@@ -150,6 +181,24 @@ def render_metrics() -> str:
     return registry().render()
 
 
+def prune_learner(learner_id: str) -> None:
+    """Drop every per-learner metric series for a departed learner, in
+    ONE place: all registry families registered with a cardinality
+    label (``budget_label`` — the "learner"/"peer" families) plus the
+    codec/RPC attribution state that backs them. The straggler /
+    divergence / churn / profile planes used to hand-prune their own
+    gauges on ``leave()``; they all call (or are covered by) this
+    helper now, and the drift-guard test in tests/test_scaletel.py
+    asserts no ``M_*`` per-learner family leaks a series after a
+    join→leave cycle."""
+    registry().prune_label_value(learner_id)
+    # codec encode/decode process totals + any per-peer RPC byte state —
+    # non-series attribution that would re-mint series if left behind
+    from metisfl_tpu.telemetry import profile as _profile
+
+    _profile.prune_attribution_series(learner_id)
+
+
 def apply_config(telemetry_config, service: str = "",
                  config_hash: str = "") -> None:
     """Configure process-wide telemetry from a federation config's
@@ -159,6 +208,11 @@ def apply_config(telemetry_config, service: str = "",
     bundles so incidents from different configs are tellable apart."""
     enabled = bool(getattr(telemetry_config, "enabled", True))
     metrics.set_enabled(enabled)
+    # cardinality budget (docs/OBSERVABILITY.md "Telemetry at scale"):
+    # 0 (default) keeps every per-learner family exact — today's
+    # behavior, bit-identical exposition
+    registry().set_cardinality_budget(
+        int(getattr(telemetry_config, "cardinality_budget", 0) or 0))
     sink_dir = getattr(telemetry_config, "dir", "")
     ev_cfg = getattr(telemetry_config, "events", None)
     ev_enabled = enabled and bool(getattr(ev_cfg, "enabled", True))

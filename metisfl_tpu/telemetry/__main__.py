@@ -159,6 +159,27 @@ def render_postmortem(bundle: dict, show_metrics: bool = False) -> str:
                 f"top={top} {_fmt_dur(float(phases.get(top, 0.0)))} "
                 f"uplink {int(totals.get('uplink_bytes', 0))}B "
                 f"downlink {int(totals.get('downlink_bytes', 0))}B")
+    alerts = bundle.get("alerts") or {}
+    if alerts:
+        active = alerts.get("active") or []
+        if active:
+            lines.append(f"  alerts at death ({len(active)} firing, "
+                         f"{alerts.get('fired_total', 0)} fired / "
+                         f"{alerts.get('resolved_total', 0)} resolved "
+                         "this run):")
+            for alert in active:
+                lines.append(
+                    f"    FIRING {alert.get('name', '?')} "
+                    f"[{alert.get('severity', '?')}] "
+                    f"{alert.get('expr', '')} value="
+                    f"{alert.get('value', 0.0):g} for "
+                    f"{alert.get('active_s', 0.0):.1f}s")
+        else:
+            lines.append(
+                f"  alerts at death: none firing "
+                f"({alerts.get('rules', 0)} rule(s), "
+                f"{alerts.get('fired_total', 0)} fired / "
+                f"{alerts.get('resolved_total', 0)} resolved this run)")
     metrics_text = bundle.get("metrics", "")
     n_series = sum(1 for line in metrics_text.splitlines()
                    if line and not line.startswith("#"))

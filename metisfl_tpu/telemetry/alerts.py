@@ -1,0 +1,421 @@
+"""Config-driven SLO alerting over the in-process metrics registry.
+
+Every observability plane so far (PRs 1/3/4/6) produces signals an
+operator can *look at*; nothing watches them. This module is the
+watching half: declarative rules (federation config ``telemetry.alerts``)
+evaluated on a bounded cadence against the live registry, with the
+state machine production alerting systems converge on — a ``for:`` hold
+before firing (one slow sample is not an incident) and resolve
+hysteresis (a value oscillating at the threshold must not flap the
+alert). Firing and resolving emit typed journal events
+(:class:`~metisfl_tpu.telemetry.events.AlertFiring` /
+``AlertResolved``), drive the ``alerts_active`` / ``alerts_fired_total``
+metric families, surface in ``DescribeFederation`` → the ``status``
+CLI's ``alerts:`` line, and ride in post-mortem bundles ("alerts at
+death" — the firing page nobody got).
+
+Rule schema (one dict per rule; validated at config load exactly like
+chaos rules — a typo'd rule fails startup, not fire-time)::
+
+    telemetry:
+      alerts:
+        - name: drop_burst              # unique; the alert's identity
+          metric: learner_dropped_total # registry family name
+          kind: rate                    # value | rate | quantile
+          labels: {reason: quarantine}  # optional: one series; omitted
+                                        #   = sum across the family
+          window_s: 30                  # rate: trailing window
+          quantile: 0.99                # quantile: which one (digest-
+                                        #   backed past the budget)
+          op: ">"                       # > >= < <=
+          threshold: 0.5
+          for_s: 5                      # breach must HOLD this long
+          resolve_ratio: 0.8            # hysteresis: a ">" alert only
+                                        #   resolves below 0.8*threshold
+                                        #   ("<" ops: above thr/ratio)
+          severity: warning             # info | warning | critical
+
+Evaluation happens on the engine's daemon thread
+(``telemetry.alerts_interval_s``) plus a synchronous :meth:`poll` at
+every round close, over a bounded
+:class:`~metisfl_tpu.telemetry.timeseries.TimeSeriesRing` that doubles
+as the ``status --watch`` sparkline source. A rule whose family is not
+registered yet samples 0.0 — rules may be declared before the first
+learner mints the series.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from metisfl_tpu.telemetry import events as _events
+from metisfl_tpu.telemetry import metrics as _metrics
+from metisfl_tpu.telemetry.timeseries import TimeSeriesRing
+
+logger = logging.getLogger("metisfl_tpu.telemetry")
+
+_KINDS = ("value", "rate", "quantile")
+_OPS = (">", ">=", "<", "<=")
+_SEVERITIES = ("info", "warning", "critical")
+
+# registry families sampled into the ring every poll even with no rule
+# over them — the status CLI's default sparklines
+DEFAULT_SERIES = ("rounds_total", "controller_active_learners",
+                  "round_update_norm")
+
+ALERTS_ACTIVE = "alerts_active"
+ALERTS_FIRED_TOTAL = "alerts_fired_total"
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One validated alert rule (see module docstring for the schema)."""
+
+    name: str
+    metric: str
+    threshold: float
+    kind: str = "value"
+    labels: Dict[str, str] = field(default_factory=dict)
+    window_s: float = 60.0
+    quantile: float = 0.99
+    op: str = ">"
+    for_s: float = 0.0
+    resolve_ratio: float = 1.0
+    severity: str = "warning"
+
+    _FIELDS = ("name", "metric", "threshold", "kind", "labels", "window_s",
+               "quantile", "op", "for_s", "resolve_ratio", "severity")
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "AlertRule":
+        if not isinstance(spec, dict):
+            raise ValueError(f"alert rule must be a mapping, got {spec!r}")
+        unknown = set(spec) - set(cls._FIELDS)
+        if unknown:
+            raise ValueError(
+                f"alert rule {spec.get('name', '?')!r}: unknown keys "
+                f"{sorted(unknown)}")
+        name = str(spec.get("name", "") or "")
+        if not name:
+            raise ValueError("alert rule needs a non-empty 'name'")
+        metric = str(spec.get("metric", "") or "")
+        if not metric:
+            raise ValueError(f"alert rule {name!r} needs a 'metric'")
+        if "threshold" not in spec:
+            raise ValueError(f"alert rule {name!r} needs a 'threshold'")
+        try:
+            threshold = float(spec["threshold"])
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"alert rule {name!r}: threshold {spec['threshold']!r} "
+                "is not a number") from None
+        kind = str(spec.get("kind", "value"))
+        if kind not in _KINDS:
+            raise ValueError(
+                f"alert rule {name!r}: kind {kind!r} not in {_KINDS}")
+        op = str(spec.get("op", ">"))
+        if op not in _OPS:
+            raise ValueError(f"alert rule {name!r}: op {op!r} not in {_OPS}")
+        labels = spec.get("labels") or {}
+        if (not isinstance(labels, dict)
+                or not all(isinstance(k, str) for k in labels)):
+            raise ValueError(
+                f"alert rule {name!r}: labels must be a string mapping")
+        window_s = float(spec.get("window_s", 60.0))
+        if kind == "rate" and window_s <= 0.0:
+            raise ValueError(
+                f"alert rule {name!r}: rate rules need window_s > 0")
+        quantile = float(spec.get("quantile", 0.99))
+        if not 0.0 < quantile <= 1.0:
+            raise ValueError(
+                f"alert rule {name!r}: quantile must be in (0, 1]")
+        for_s = float(spec.get("for_s", 0.0))
+        if for_s < 0.0:
+            raise ValueError(f"alert rule {name!r}: for_s must be >= 0")
+        resolve_ratio = float(spec.get("resolve_ratio", 1.0))
+        if not 0.0 < resolve_ratio <= 1.0:
+            raise ValueError(
+                f"alert rule {name!r}: resolve_ratio must be in (0, 1] "
+                "(1 = no hysteresis)")
+        severity = str(spec.get("severity", "warning"))
+        if severity not in _SEVERITIES:
+            raise ValueError(
+                f"alert rule {name!r}: severity {severity!r} not in "
+                f"{_SEVERITIES}")
+        return cls(name=name, metric=metric, threshold=threshold, kind=kind,
+                   labels={str(k): str(v) for k, v in labels.items()},
+                   window_s=window_s, quantile=quantile, op=op, for_s=for_s,
+                   resolve_ratio=resolve_ratio, severity=severity)
+
+    def series_key(self) -> str:
+        if not self.labels:
+            return self.metric
+        pairs = ",".join(f"{k}={v}" for k, v in sorted(self.labels.items()))
+        return f"{self.metric}{{{pairs}}}"
+
+    def breaches(self, value: float) -> bool:
+        if self.op == ">":
+            return value > self.threshold
+        if self.op == ">=":
+            return value >= self.threshold
+        if self.op == "<":
+            return value < self.threshold
+        return value <= self.threshold
+
+    def resolved(self, value: float) -> bool:
+        """Hysteresis bound, margin-form so it stays monotone for zero
+        and negative thresholds (a multiplicative bound would invert
+        there and flap the alert every poll): the margin is
+        ``(1 - resolve_ratio) * |threshold|``; a ">"-family alert
+        resolves only below ``threshold - margin``, a "<"-family one
+        only above ``threshold + margin``. For positive thresholds the
+        ">" bound is exactly the familiar ``threshold * resolve_ratio``;
+        ratio 1 (or threshold 0) = plain de-breach."""
+        margin = abs(self.threshold) * (1.0 - self.resolve_ratio)
+        if self.op in (">", ">="):
+            return value < self.threshold - margin
+        return value > self.threshold + margin
+
+    def describe_expr(self) -> str:
+        head = {"value": self.series_key(),
+                "rate": f"rate({self.series_key()}[{self.window_s:g}s])",
+                "quantile": f"q{self.quantile:g}({self.metric})"}[self.kind]
+        return f"{head} {self.op} {self.threshold:g}"
+
+
+def validate_rules(specs: List[Dict[str, Any]]) -> List[AlertRule]:
+    """Parse + validate a config's rule list (duplicate names rejected —
+    two rules sharing an identity would fight over one state machine)."""
+    rules: List[AlertRule] = []
+    seen = set()
+    for spec in specs or []:
+        rule = AlertRule.from_spec(spec)
+        if rule.name in seen:
+            raise ValueError(f"duplicate alert rule name {rule.name!r}")
+        seen.add(rule.name)
+        rules.append(rule)
+    return rules
+
+
+class _RuleState:
+    __slots__ = ("status", "since", "fired_at", "value")
+
+    def __init__(self):
+        self.status = "ok"          # ok | pending | firing
+        self.since = 0.0            # breach start (pending/firing)
+        self.fired_at = 0.0
+        self.value = 0.0
+
+
+class AlertEngine:
+    """Evaluates a rule set against a metrics registry on a bounded
+    cadence; owns the time-series ring the rules (and the status CLI's
+    sparklines) read from. Thread-safe; ``poll()`` is also callable
+    synchronously (round close, tests — pass ``now`` for a fake clock)."""
+
+    def __init__(self, rules: List[AlertRule],
+                 registry: Optional[_metrics.Registry] = None,
+                 interval_s: float = 1.0,
+                 ring: Optional[TimeSeriesRing] = None):
+        self.rules = list(rules)
+        self.registry = registry or _metrics.registry()
+        self.interval_s = max(0.05, float(interval_s))
+        self.ring = ring or TimeSeriesRing()
+        self._states: Dict[str, _RuleState] = {
+            rule.name: _RuleState() for rule in self.rules}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired_total = 0
+        self.resolved_total = 0
+        # rules whose sampling raised (e.g. a rule mistargeting a
+        # histogram family) — logged once per rule, not per poll
+        self._broken_rules: set = set()
+        self._m_active = self.registry.gauge(
+            ALERTS_ACTIVE,
+            "Alert rules currently firing (1 while firing; series "
+            "removed on resolve)", ("alert",))
+        self._m_fired = self.registry.counter(
+            ALERTS_FIRED_TOTAL, "Alert firings by rule", ("alert",))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Arm the evaluation daemon (idempotent)."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="alert-engine", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 - alerting never takes a
+                logger.exception("alert poll failed")  # controller down
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+        # bounded cardinality: a dead engine's gauge series must not
+        # shadow a later controller's in the process-global registry
+        with self._lock:
+            for rule in self.rules:
+                self._m_active.remove(alert=rule.name)
+
+    # -- evaluation ------------------------------------------------------
+
+    def _sample(self, rule: AlertRule, now: float) -> float:
+        family = self.registry.get(rule.metric)
+        if family is None:
+            return 0.0  # rule declared before the family minted
+        if rule.kind == "quantile":
+            quantile = getattr(family, "quantile", None)
+            return float(quantile(rule.quantile)) if quantile else 0.0
+        if rule.labels:
+            try:
+                raw = float(family.value(**rule.labels))
+            except (ValueError, AttributeError):
+                return 0.0  # label-set mismatch: inert, never fatal
+        else:
+            total = getattr(family, "total", None)
+            raw = float(total()) if total else 0.0
+        if rule.kind == "value":
+            return raw
+        key = rule.series_key()
+        self.ring.record(key, raw, ts=now)
+        return self.ring.rate(key, rule.window_s, now=now)
+
+    def poll(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns the transitions it caused
+        (``[{"alert", "transition", "value"}, ...]``)."""
+        now = time.time() if now is None else float(now)
+        for name in DEFAULT_SERIES:
+            family = self.registry.get(name)
+            if family is not None and hasattr(family, "total"):
+                self.ring.record(name, float(family.total()), ts=now)
+        transitions: List[Dict[str, Any]] = []
+        for rule in self.rules:
+            try:
+                value = self._sample(rule, now)
+                self.ring.record(f"alert/{rule.name}", value, ts=now)
+                with self._lock:
+                    transition = self._step(rule, value, now)
+            except Exception:  # noqa: BLE001 - one broken rule must not
+                # stop the OTHER rules from being evaluated (a rule can
+                # mistarget a family whose read path raises)
+                if rule.name not in self._broken_rules:
+                    self._broken_rules.add(rule.name)
+                    logger.exception(
+                        "alert rule %s failed to evaluate; skipping it "
+                        "(other rules keep evaluating)", rule.name)
+                continue
+            if transition:
+                transitions.append(
+                    {"alert": rule.name, "transition": transition,
+                     "value": value})
+        return transitions
+
+    def _step(self, rule: AlertRule, value: float,
+              now: float) -> Optional[str]:
+        """Advance one rule's state machine; called under _lock. Event
+        emission happens here too — emits are lock-cheap appends."""
+        state = self._states[rule.name]
+        state.value = value
+        if state.status == "firing":
+            if rule.resolved(value):
+                state.status = "ok"
+                active_s = now - state.fired_at
+                self.resolved_total += 1
+                self._m_active.remove(alert=rule.name)
+                _events.emit(_events.AlertResolved, name=rule.name,
+                             value=round(value, 6),
+                             active_s=round(active_s, 3))
+                logger.info("alert %s RESOLVED (value %.6g after %.1fs)",
+                            rule.name, value, active_s)
+                return "resolved"
+            return None
+        breach = rule.breaches(value)
+        if not breach:
+            state.status = "ok"
+            return None
+        if state.status == "ok":
+            state.status = "pending"
+            state.since = now
+        if now - state.since >= rule.for_s:
+            state.status = "firing"
+            state.fired_at = now
+            self.fired_total += 1
+            self._m_active.set(1, alert=rule.name)
+            self._m_fired.inc(alert=rule.name)
+            _events.emit(_events.AlertFiring, name=rule.name,
+                         expr=rule.describe_expr(),
+                         value=round(value, 6), threshold=rule.threshold,
+                         severity=rule.severity)
+            logger.warning("alert %s FIRING: %s (value %.6g)",
+                           rule.name, rule.describe_expr(), value)
+            return "firing"
+        return None
+
+    # -- read side -------------------------------------------------------
+
+    def active(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            return [
+                {"name": rule.name, "severity": rule.severity,
+                 "expr": rule.describe_expr(),
+                 "value": round(self._states[rule.name].value, 6),
+                 "threshold": rule.threshold,
+                 "active_s": round(
+                     max(0.0, now - self._states[rule.name].fired_at), 3)}
+                for rule in self.rules
+                if self._states[rule.name].status == "firing"]
+
+    def summary(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``alerts`` section of a DescribeFederation snapshot."""
+        active = self.active(now=now)
+        with self._lock:
+            pending = sum(1 for s in self._states.values()
+                          if s.status == "pending")
+        return {"enabled": True, "rules": len(self.rules),
+                "active": active, "pending": pending,
+                "fired_total": self.fired_total,
+                "resolved_total": self.resolved_total}
+
+    def series_snapshot(self, points: int = 30) -> Dict[str, Any]:
+        return self.ring.snapshot(points=points)
+
+
+# --------------------------------------------------------------------- #
+# process-global handle (the flight recorder's "alerts at death")
+# --------------------------------------------------------------------- #
+
+_ENGINE: Optional[AlertEngine] = None
+
+
+def set_engine(engine: Optional[AlertEngine]) -> None:
+    global _ENGINE
+    _ENGINE = engine
+
+
+def engine() -> Optional[AlertEngine]:
+    return _ENGINE
+
+
+def active_summary() -> Optional[Dict[str, Any]]:
+    """The live engine's summary, or None when no engine is armed —
+    what post-mortem bundles record as the alerts at death."""
+    if _ENGINE is None:
+        return None
+    try:
+        return _ENGINE.summary()
+    except Exception:  # noqa: BLE001 - flight-recorder path never raises
+        return None

@@ -234,6 +234,30 @@ class ServingSwapped:
     previous: int = 0
 
 
+@dataclass(frozen=True)
+class AlertFiring:
+    """An alert rule's expression breached its threshold and held past
+    its ``for:`` duration (telemetry/alerts.py AlertEngine)."""
+
+    kind: ClassVar[str] = "alert_firing"
+    name: str
+    expr: str = ""
+    value: float = 0.0
+    threshold: float = 0.0
+    severity: str = "warning"
+
+
+@dataclass(frozen=True)
+class AlertResolved:
+    """A firing alert's value crossed back past its resolve-hysteresis
+    bound after ``active_s`` seconds."""
+
+    kind: ClassVar[str] = "alert_resolved"
+    name: str
+    value: float = 0.0
+    active_s: float = 0.0
+
+
 EVENT_TYPES: Dict[str, type] = {
     cls.kind: cls
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
@@ -241,7 +265,8 @@ EVENT_TYPES: Dict[str, type] = {
                 AggregationDone, FailoverBegan, UpdateAnomalous,
                 RoundHealth, LearnerQuarantined, DispatchRetried,
                 RoundHalted, VersionRegistered, VersionPromoted,
-                VersionRolledBack, ServingSwapped)
+                VersionRolledBack, ServingSwapped, AlertFiring,
+                AlertResolved)
 }
 
 

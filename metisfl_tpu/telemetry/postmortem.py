@@ -141,6 +141,16 @@ class _Recorder:
                 bundle["profiles"] = profiles
         except Exception:  # noqa: BLE001 - best-effort by contract
             pass
+        try:
+            # alerts at death (telemetry/alerts.py): the firing page
+            # nobody got — which rules were active, for how long
+            from metisfl_tpu.telemetry import alerts as _alerts
+
+            alert_summary = _alerts.active_summary()
+            if alert_summary is not None:
+                bundle["alerts"] = alert_summary
+        except Exception:  # noqa: BLE001 - best-effort by contract
+            pass
         if extra:
             bundle["extra"] = extra
         safe_reason = "".join(c if (c.isalnum() or c in "_-") else "_"

@@ -66,21 +66,21 @@ _REG = _tmetrics.registry()
 _M_DOWNLINK = _REG.counter(
     _tel.M_DOWNLINK_BYTES_TOTAL,
     "Community-model bytes dispatched to each learner (train + eval "
-    "downlink payloads)", ("learner",))
+    "downlink payloads)", ("learner",), budget_label="learner")
 _M_MFU = _REG.gauge(
     _tel.M_LEARNER_ACHIEVED_MFU,
     "Achieved model FLOPs utilization per learner (estimated step FLOPs "
     "over the chip's bf16 peak; 0 where the peak is unknown, e.g. CPU)",
-    ("learner",))
+    ("learner",), budget_label="learner")
 _M_STEP_EWMA = _REG.gauge(
     _tel.M_LEARNER_STEP_MS_EWMA,
     "EWMA steady-state optimizer-step time per learner (ms, from "
-    "TaskResult.device_stats)", ("learner",))
+    "TaskResult.device_stats)", ("learner",), budget_label="learner")
 _M_HBM = _REG.gauge(
     _tel.M_LEARNER_HBM_PEAK_BYTES,
     "Device-memory high-water mark per learner "
     "(device.memory_stats peak_bytes_in_use; 0 where unsupported)",
-    ("learner",))
+    ("learner",), budget_label="learner")
 
 # bf16 peak FLOP/s per chip by device_kind substring (first match wins) —
 # the MFU denominator. The ONE table: bench.py imports
@@ -389,12 +389,11 @@ class ProfileCollector:
             self._marks[name] = now
 
     def drop(self, learner_id: str) -> None:
-        """Prune every per-learner profile series and state for a learner
-        that left (the PR 3/4 bounded-cardinality posture)."""
-        _M_DOWNLINK.remove(learner=learner_id)
-        _M_MFU.remove(learner=learner_id)
-        _M_STEP_EWMA.remove(learner=learner_id)
-        _M_HBM.remove(learner=learner_id)
+        """Prune the collector's per-learner state for a learner that
+        left. The downlink/MFU/step/HBM *series* themselves are pruned
+        by the central ``telemetry.prune_learner`` registry helper
+        (they carry the "learner" cardinality label) — this drops only
+        the collector-internal attribution behind them."""
         with self._lock:
             self._downlink.pop(learner_id, None)
             self._insert_ms.pop(learner_id, None)
@@ -407,7 +406,9 @@ class ProfileCollector:
             for key in [k for k in self._codec_snapshot
                         if k[0] == learner_id]:
                 del self._codec_snapshot[key]
-        prune_attribution_series(learner_id)
+        # NOT calling prune_attribution_series here: the central
+        # telemetry.prune_learner already does, strictly before the
+        # controller calls this (one prune per departure, not two)
 
     # -- round assembly ----------------------------------------------------
     def assemble_round(self, meta: Any, close_ms: float = 0.0) -> dict:

@@ -11,12 +11,24 @@
 # churn tolerance is gated exactly like bench regressions are by
 # scripts/check_bench.sh.
 #
+# ISSUE 10 additions, gated in the same run:
+#  - SLO alert lifecycle (--alert-smoke): the partition fault must trip
+#    the dispatch_retries_total rate rule AND the drained run must
+#    resolve it (firing -> resolved, end to end), while the same-seed
+#    no-churn control stays silent — alerting that cannot fire, or
+#    cannot resolve, fails the build;
+#  - cardinality budget (--budget 256): the run's per-learner metric
+#    families serve sketches past the budget, proving the exposition
+#    stays bounded under churn.
+#
 # Usage:
 #   scripts/chaos_smoke.sh                  # the pinned CI scenario
 #   scripts/chaos_smoke.sh --clients 256    # any crossdevice CLI override
 #
-# Exit codes: 0 all rounds completed within tolerance, 1 a round failed /
-# halted / accuracy drifted, 2 harness crashed (fails the build too).
+# Exit codes: 0 all rounds completed within tolerance and the alert
+# lifecycle proved out, 1 a round failed / halted / accuracy drifted /
+# alert did not fire+resolve (or fired in the control), 2 harness
+# crashed (fails the build too).
 set -u -o pipefail
 
 PYTHON="${PYTHON:-python}"
@@ -25,13 +37,15 @@ PYTHON="${PYTHON:-python}"
 # accelerator math, and a wedged run must fail, not hang the build.
 JAX_PLATFORMS=cpu timeout -k 10 120 "$PYTHON" -m metisfl_tpu.driver.crossdevice \
   --clients 1024 --rounds 5 --quorum 12 --dropout 0.3 --seed 7 \
-  --tolerance 0.2 "$@"
+  --tolerance 0.2 --budget 256 --alert-smoke "$@"
 rc=$?
 case "$rc" in
   0) echo "chaos_smoke: PASS (all rounds completed at quorum, accuracy" \
-          "within tolerance of the no-churn control)" ;;
-  1) echo "chaos_smoke: FAIL — a round failed/halted or accuracy drifted" \
-          "past tolerance (see JSON above)" >&2 ;;
+          "within tolerance of the no-churn control, alert fired and" \
+          "resolved under churn and stayed silent in the control)" ;;
+  1) echo "chaos_smoke: FAIL — a round failed/halted, accuracy drifted" \
+          "past tolerance, or the alert lifecycle did not prove out" \
+          "(see JSON above)" >&2 ;;
   *) echo "chaos_smoke: FAIL — harness crashed or timed out (rc=$rc)" >&2
      rc=2 ;;
 esac
