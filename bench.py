@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import platform as platform_mod
 import resource
 import subprocess
 import sys
@@ -1155,6 +1156,88 @@ def bench_obs(sizes=(1000, 10000, 100000), budget=256):
     return out
 
 
+def bench_fabric(peer_counts=(2, 8, 32), spans=1500, events=400,
+                 series=2000, budget=256):
+    """Fleet-telemetry-fabric section (ISSUE 11; docs/OBSERVABILITY.md
+    "Fleet fabric"): CollectTelemetry pull latency and reply bytes vs
+    simulated peer count. Boots N real-gRPC endpoints over this
+    process's telemetry (pre-filled with a span/event backlog plus a
+    budget-collapsed per-learner gauge family, so replies carry the
+    sketch shape they would at cross-device scale), then measures a
+    FleetCollector's full-backlog sweep and the steady-state
+    incremental sweep separately, plus the fleet-wide metrics merge.
+    Host-side; keys are direction-classified for
+    ``python -m metisfl_tpu.perf --trajectory`` (ms/kb lower-better,
+    spans_per_sec higher-better)."""
+    from metisfl_tpu.comm.rpc import BytesService, RpcServer
+    from metisfl_tpu.telemetry import events as tevents
+    from metisfl_tpu.telemetry import fabric as tfabric
+    from metisfl_tpu.telemetry import metrics as tmetrics
+    from metisfl_tpu.telemetry import trace as ttrace
+
+    tfabric.configure(enabled=True)
+    ttrace.configure(enabled=True, service="bench-fabric", dir="")
+    tevents.configure(enabled=True, service="bench-fabric", dir="")
+    reg = tmetrics.registry()
+    reg.set_cardinality_budget(budget)
+    gauge = reg.gauge("learner_straggler_score", "", ("learner",),
+                      budget_label="learner")
+    rng = np.random.default_rng(17)
+    for i in range(series):
+        gauge.set(float(rng.gamma(4.0, 0.25)), learner=f"L{i}")
+    for i in range(spans):
+        ttrace.event(f"bench.work/{i % 11}", 0.001)
+    for i in range(events):
+        tevents.emit(tevents.TaskDispatched, task_id=f"t{i}",
+                     learner_id=f"L{i % 64}", round=i // 50)
+
+    out = {"fabric_span_backlog": spans, "fabric_event_backlog": events,
+           "fabric_series": series, "fabric_budget": budget}
+    max_k = max(peer_counts)
+    servers = []
+    try:
+        for i in range(max_k):
+            server = RpcServer("127.0.0.1", 0)
+            server.add_service(BytesService(f"bench.Fabric{i}", {},
+                                            role="learner"))
+            servers.append((server, server.start(), i))
+        for k in peer_counts:
+            collector = tfabric.FleetCollector(probe_health=False)
+            for server, port, i in servers[:k]:
+                collector.add_peer(f"peer-{i}", "127.0.0.1", port,
+                                   f"bench.Fabric{i}", role="learner")
+            t0 = time.perf_counter()
+            collector.poll_once(timeout=30.0)
+            backlog_s = time.perf_counter() - t0
+            backlog_bytes = sum(p.bytes_collected
+                                for p in collector.peers())
+            t0 = time.perf_counter()
+            collector.poll_once(timeout=30.0)
+            incr_s = time.perf_counter() - t0
+            out[f"fabric_peers_{k}_backlog_ms"] = round(backlog_s * 1e3, 2)
+            out[f"fabric_peers_{k}_incr_ms"] = round(incr_s * 1e3, 2)
+            out[f"fabric_peers_{k}_backlog_kb"] = round(
+                backlog_bytes / 1024.0, 1)
+            if k == max_k:
+                total_spans = sum(p.spans_collected
+                                  for p in collector.peers())
+                out["fabric_spans_per_sec"] = int(
+                    total_spans / max(backlog_s, 1e-9))
+                t0 = time.perf_counter()
+                text = collector.merged_exposition()
+                out["fabric_merge_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 2)
+                out["fabric_merged_kb"] = round(len(text) / 1024.0, 1)
+            collector.stop(final_poll=False)
+    finally:
+        for server, _port, _i in servers:
+            try:
+                server.stop(grace=0.1)
+            except Exception:  # noqa: BLE001
+                pass
+    return out
+
+
 def bench_lora(require_tpu: bool = True):
     """Single-chip LoRA execution proof (VERDICT r4 #7): a ~1.2B-param
     frozen bf16 LlamaLite base + rank-16 adapters on q/v, real optimizer
@@ -1230,6 +1313,7 @@ _SECTIONS = {
     "serving": lambda a: bench_serving(),
     "churn": lambda a: bench_churn(),
     "obs": lambda a: bench_obs(),
+    "fabric": lambda a: bench_fabric(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1388,6 +1472,11 @@ def _emit(result) -> None:
     }
     if "mfu" in result:
         marker["mfu"] = result["mfu"]
+    if result.get("host"):
+        # host provenance must survive tail truncation too: a degraded
+        # marker-only capture still declares where it ran, so the
+        # cross-host comparison rule keeps applying
+        marker["host"] = result["host"]
     backend = result.get("details", {}).get("backend")
     if backend:
         marker["backend"] = backend
@@ -1402,6 +1491,11 @@ def _result_from(details, errors, num_learners):
         "value": round(value, 2),
         "unit": "ms",
         "vs_baseline": round(BASELINE_MS / value, 2) if value else 0.0,
+        # host provenance: perf gates regressions only between captures
+        # naming the SAME host (absolute RSS/disk keys are incomparable
+        # across a hardware move); override for stable fleet identities
+        "host": os.environ.get("METISFL_BENCH_HOST")
+        or platform_mod.node(),
         "details": dict(details),
     }
     if "mfu" in details:
@@ -1445,7 +1539,8 @@ def _install_watchdog(num_learners: int, budget_secs: int) -> None:
 _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
                      "e2e": 600, "cohort": 1200, "health": 240,
-                     "serving": 300, "churn": 240, "obs": 240, "lora": 600}
+                     "serving": 300, "churn": 240, "obs": 240,
+                     "fabric": 240, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1493,7 +1588,7 @@ _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
 _HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
-                  "obs")
+                  "obs", "fabric")
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_partial.json")
 

@@ -122,3 +122,34 @@ class _NotFound(Exception):
     def __init__(self, service: str):
         super().__init__(f"unknown health service {service!r}")
         self.code = grpc.StatusCode.NOT_FOUND
+
+
+STATUS_NAMES = {UNKNOWN: "UNKNOWN", SERVING: "SERVING",
+                NOT_SERVING: "NOT_SERVING",
+                SERVICE_UNKNOWN: "SERVICE_UNKNOWN"}
+
+
+def probe_health(host: str, port: int, service: str = "", ssl=None,
+                 comm=None, timeout: float = 2.0) -> str:
+    """One ``grpc.health.v1.Health/Check`` against an endpoint, as a
+    status name ("SERVING" / "NOT_SERVING" / ... / "UNREACHABLE") —
+    the status CLI's ``--probe``/``--fleet`` peer-row probe and the
+    fleet collector's liveness column. Fail-fast (no wait-for-ready, no
+    retries) and never raises: a dead endpoint is an answer here, not
+    an error."""
+    from metisfl_tpu.comm.rpc import RpcClient
+
+    kwargs = {}
+    if comm is not None:
+        kwargs = {"default_deadline_s": comm.default_deadline_s}
+    client = RpcClient(host, port, HEALTH_SERVICE, retries=0, ssl=ssl,
+                       **kwargs)
+    try:
+        raw = client.call("Check", encode_request(service),
+                          timeout=timeout, wait_ready=False,
+                          idempotent=True)
+        return STATUS_NAMES.get(decode_response(raw), "UNKNOWN")
+    except Exception:  # noqa: BLE001 - unreachable IS the probe answer
+        return "UNREACHABLE"
+    finally:
+        client.close()

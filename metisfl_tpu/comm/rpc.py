@@ -160,6 +160,18 @@ class BytesService:
         self.role = role
         self.handlers = dict(handlers)
         self.handlers.setdefault("ListMethods", self._list_methods)
+        if role:
+            # fleet telemetry fabric (telemetry/fabric.py): every
+            # role-carrying endpoint answers cursor-based telemetry
+            # pulls next to ListMethods/GetMetrics. With
+            # telemetry.fabric.enabled=false the handler answers a
+            # one-attribute-check {"enabled": false} stub.
+            self.handlers.setdefault("CollectTelemetry",
+                                     self._collect_telemetry)
+
+    def _collect_telemetry(self, raw: bytes) -> bytes:
+        from metisfl_tpu.telemetry import fabric as _fabric
+        return _fabric.handle_collect(raw, self.service_name, self.role)
 
     def _list_methods(self, raw: bytes) -> bytes:
         methods = [

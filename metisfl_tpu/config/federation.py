@@ -223,6 +223,32 @@ class ProfileConfig:
 
 
 @dataclass
+class FabricConfig:
+    """Fleet telemetry fabric (telemetry/fabric.py): the
+    ``CollectTelemetry`` cursor-pull RPC every role-carrying endpoint
+    answers, and the driver-side :class:`FleetCollector` that polls the
+    fleet with jitter, corrects per-peer clock skew NTP-style, and
+    streams the merged span timeline into ``traces.jsonl`` live.
+    ``enabled=false`` leaves every server at one attribute check (the
+    handler answers a stub and the finished-span ring is disabled)."""
+
+    enabled: bool = True
+    # collector poll period (seconds) and its relative jitter in [0, 1)
+    # — jitter de-correlates N collectors against one fleet
+    poll_every_s: float = 2.0
+    jitter: float = 0.3
+    # clock-offset EWMA blend and the RTT gate: an offset sample is
+    # accepted only when its round trip stays within rtt_gate × the
+    # best RTT seen for that peer (a congested exchange can be off by
+    # rtt/2)
+    offset_alpha: float = 0.2
+    rtt_gate: float = 3.0
+    # per-process finished-span ring the cursor pulls read from
+    # (0 → the trace module's default, 4096)
+    span_ring: int = 0
+
+
+@dataclass
 class TelemetryConfig:
     """Federation-wide observability (metisfl_tpu/telemetry): trace spans
     + metrics registry + event journal. ``enabled=false`` opts the whole
@@ -259,6 +285,8 @@ class TelemetryConfig:
     health: HealthConfig = field(default_factory=HealthConfig)
     # performance observatory (telemetry/profile.py)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
+    # fleet telemetry fabric (telemetry/fabric.py)
+    fabric: FabricConfig = field(default_factory=FabricConfig)
     # flight-recorder bundle directory (telemetry/postmortem.py): crash /
     # chaos-kill / failover post-mortems land here. "" → recorder off;
     # the driver fills this in with <workdir>/postmortem.
@@ -584,6 +612,22 @@ class FederationConfig:
                 "telemetry.profile.trace_every_rounds must be >= 0")
         if self.telemetry.cardinality_budget < 0:
             raise ValueError("telemetry.cardinality_budget must be >= 0")
+        fab = self.telemetry.fabric
+        if fab.poll_every_s <= 0.0:
+            raise ValueError("telemetry.fabric.poll_every_s must be > 0")
+        if not 0.0 <= fab.jitter < 1.0:
+            raise ValueError("telemetry.fabric.jitter must be in [0, 1)")
+        if not 0.0 < fab.offset_alpha <= 1.0:
+            # same posture as the other EWMA blends: a typo'd weight
+            # would silently freeze or unsmooth every offset estimate
+            raise ValueError(
+                "telemetry.fabric.offset_alpha must be in (0, 1]")
+        if fab.rtt_gate < 1.0:
+            # a gate under 1 rejects even the best-RTT sample — the
+            # estimator would never converge
+            raise ValueError("telemetry.fabric.rtt_gate must be >= 1")
+        if fab.span_ring < 0:
+            raise ValueError("telemetry.fabric.span_ring must be >= 0")
         if self.telemetry.alerts_interval_s <= 0.0:
             raise ValueError("telemetry.alerts_interval_s must be > 0")
         if self.telemetry.alerts:

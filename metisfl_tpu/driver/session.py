@@ -200,6 +200,10 @@ class DriverSession:
         # chaos arms ORIGINAL incarnations only (see _chaos_env): learner
         # indices that already got their armed launch
         self._chaos_armed_learners: set = set()
+        # fleet telemetry fabric (telemetry/fabric.py): live cross-process
+        # collection during the run — constructed at initialize, None when
+        # telemetry.fabric is opted out
+        self._fleet = None
 
     # ------------------------------------------------------------------ #
     # bootstrap
@@ -377,7 +381,71 @@ class DriverSession:
             self.launch_learner(idx)
         if self.config.serving.enabled:
             self._launch_gateway()
+        self._start_fleet_collector()
         self._started_at = time.time()
+
+    # ------------------------------------------------------------------ #
+    # fleet telemetry fabric (telemetry/fabric.py)
+    # ------------------------------------------------------------------ #
+
+    def _fleet_peer_specs(self) -> List[dict]:
+        """Peer specs for the fleet collector's per-poll discovery:
+        controller + every registered learner + the serving gateway.
+        Learners that join mid-run appear on the next poll; departed
+        ones stay listed and go visibly stale."""
+        from metisfl_tpu.controller.service import (CONTROLLER_SERVICE,
+                                                    LEARNER_SERVICE)
+
+        ctrl_host = self.config.controller_host or "localhost"
+        specs = [{"name": "controller", "host": ctrl_host,
+                  "port": self.config.controller_port,
+                  "service_name": CONTROLLER_SERVICE,
+                  "role": "controller"}]
+        try:
+            endpoints = self._client.list_learners(timeout=5.0,
+                                                   wait_ready=False)
+            self._known_endpoints = endpoints
+        except Exception:  # noqa: BLE001 - keep the stale snapshot; the
+            # already-known peers keep getting polled either way
+            endpoints = list(self._known_endpoints)
+        for ep in endpoints:
+            if not ep.get("port"):
+                continue
+            specs.append({"name": ep.get("learner_id") or
+                          f"{ep['hostname']}:{ep['port']}",
+                          "host": ep["hostname"], "port": ep["port"],
+                          "service_name": LEARNER_SERVICE,
+                          "role": "learner"})
+        if self.config.serving.enabled and self.config.serving.port:
+            from metisfl_tpu.serving.service import SERVING_SERVICE
+            specs.append({"name": "serving", "host": ctrl_host,
+                          "port": self.config.serving.port,
+                          "service_name": SERVING_SERVICE,
+                          "role": "serving"})
+        return specs
+
+    def _start_fleet_collector(self) -> None:
+        tel = self.config.telemetry
+        if not (tel.enabled and tel.fabric.enabled):
+            return
+        from metisfl_tpu.telemetry.fabric import FleetCollector
+
+        self._fleet = FleetCollector(
+            poll_every_s=tel.fabric.poll_every_s,
+            jitter=tel.fabric.jitter,
+            offset_alpha=tel.fabric.offset_alpha,
+            rtt_gate=tel.fabric.rtt_gate,
+            # live, crash-durable span stream — the experiment dir's
+            # traces.jsonl exists (and grows) WHILE the run is alive
+            trace_out=os.path.join(self.workdir, "traces.jsonl"),
+            ssl=self.config.ssl, comm=self.config.comm,
+            discover_fn=self._fleet_peer_specs)
+        self._fleet.start()
+
+    def fleet_collector(self):
+        """The live :class:`~metisfl_tpu.telemetry.fabric.FleetCollector`
+        (None when ``telemetry.fabric`` is opted out)."""
+        return self._fleet
 
     def _chaos_env(self, process: str, idx: Optional[int] = None) -> Dict[str, str]:
         """METISFL_TPU_CHAOS env for one subprocess: the configured chaos
@@ -846,29 +914,102 @@ class DriverSession:
         return path
 
     def collect_traces(self, dest: Optional[str] = None) -> Optional[str]:
-        """Merge the per-process telemetry trace files (controller +
-        local learners append to ``<workdir>/telemetry/*.jsonl``) into
-        one ``traces.jsonl`` next to ``experiment.json``, so the
-        experiment directory is self-contained for
-        ``python -m metisfl_tpu.telemetry``. Returns the merged path, or
-        None when there is nothing to collect (telemetry off, or every
-        learner was remote and kept its sink on its own host)."""
-        tel_dir = self.config.telemetry.dir
-        if not (self.config.telemetry.enabled and tel_dir
-                and os.path.isdir(tel_dir)):
+        """Assemble the experiment's ``traces.jsonl``. With the fleet
+        fabric on, spans were streamed there live (skew-corrected,
+        straight off each peer's ``CollectTelemetry`` pull — remote
+        learners included) all run long; this final pass rebuilds the
+        file so every LOCAL process's sink file — which is complete,
+        unlike a cursor stream that can miss ring-evicted or
+        post-final-poll spans — replaces that process's streamed
+        records, while remote peers (no local file) keep their streamed,
+        skew-corrected records. It logs exactly which peers were
+        file-merged vs RPC-streamed vs unreachable, plus any reported
+        ring losses — no silent coverage caps. With the fabric off it
+        is the old shutdown-time file merge of ``<telemetry.dir>/
+        *.jsonl``. Returns the merged path, or None when there is
+        nothing to collect."""
+        if not self.config.telemetry.enabled:
             return None
         import glob as _glob
-        files = sorted(_glob.glob(os.path.join(tel_dir, "*.jsonl")))
-        if not files:
-            return None
+        import json as _json
+        tel_dir = self.config.telemetry.dir
+        files = (sorted(_glob.glob(os.path.join(tel_dir, "*.jsonl")))
+                 if tel_dir and os.path.isdir(tel_dir) else [])
         dest = dest or os.path.join(self.workdir, "traces.jsonl")
-        with open(dest, "w") as out:
+        if self._fleet is None:
+            if not files:
+                return None
+            with open(dest, "w") as out:
+                for name in files:
+                    try:
+                        with open(name) as f:
+                            out.write(f.read())
+                    except OSError:  # noqa: PERF203 - torn file skippable
+                        logger.warning("could not collect trace file %s",
+                                       name)
+            return dest
+        local_bases = {os.path.basename(name) for name in files}
+        rpc_streamed: List[str] = []
+        file_covered: List[str] = []
+        disabled: List[str] = []
+        unreachable: List[str] = []
+        lost_total = 0
+        for peer in self._fleet.peers():
+            lost_total += peer.spans_lost
+            sink_base = (f"{peer.trace_service}-{peer.pid}.jsonl"
+                         if peer.trace_service and peer.pid else "")
+            if peer.disabled:
+                disabled.append(peer.name)
+            elif peer.last_ok_ts and not peer.stale:
+                if sink_base and sink_base in local_bases:
+                    file_covered.append(peer.name)
+                else:
+                    rpc_streamed.append(peer.name)
+            else:
+                unreachable.append(peer.name)
+        # keep streamed records only for processes WITHOUT a local sink
+        # file (remote peers): local files are the complete record and
+        # win over the lossy cursor stream
+        kept_streamed: List[str] = []
+        try:
+            with open(dest) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = _json.loads(line)
+                    except _json.JSONDecodeError:
+                        continue  # torn live-stream tail line
+                    base = (f"{rec.get('service')}-{rec.get('pid')}.jsonl"
+                            if rec.get("service") and rec.get("pid")
+                            else "")
+                    if not base or base not in local_bases:
+                        kept_streamed.append(line)
+        except OSError:
+            pass
+        tmp = dest + ".tmp"
+        with open(tmp, "w") as out:
+            for line in kept_streamed:
+                out.write(line + "\n")
             for name in files:
                 try:
                     with open(name) as f:
                         out.write(f.read())
-                except OSError:  # noqa: PERF203 - a torn file is skippable
-                    logger.warning("could not collect trace file %s", name)
+                except OSError:  # noqa: PERF203 - torn file skippable
+                    logger.warning("could not collect trace file %s",
+                                   name)
+        os.replace(tmp, dest)
+        # no silent coverage caps: every peer's collection route is
+        # named (docs/OBSERVABILITY.md "Fleet fabric")
+        logger.info(
+            "trace collection: file-merged (local, complete) %s; "
+            "RPC-pulled (remote stream) %s; fabric-disabled %s; "
+            "unreachable %s%s",
+            sorted(file_covered) or "[]", sorted(rpc_streamed) or "[]",
+            sorted(disabled) or "[]", sorted(unreachable) or "[]",
+            f"; {lost_total} span(s) ring-evicted between pulls "
+            "(local files keep them)" if lost_total else "")
         return dest
 
     def collect_postmortems(self) -> List[str]:
@@ -912,6 +1053,13 @@ class DriverSession:
         # earlier aborts them mid-collective. An explicit timeout_s is
         # honored as given.
         self._shutting_down = True  # supervision must not resurrect it now
+        if self._fleet is not None:
+            # final tail pull while the fleet is still up, then stop the
+            # poll loop — shutdown must not race live collection
+            try:
+                self._fleet.stop(final_poll=True)
+            except Exception:  # noqa: BLE001 - collection never blocks
+                logger.exception("fleet collector stop failed")
         if timeout_s is None:
             multihost = any(int(getattr(ep, "world_size", 1)) > 1
                             for ep in self.config.learners)

@@ -22,6 +22,17 @@ The reading half of the performance observatory (telemetry/profile.py):
   ``METISFL_BENCH`` marker bench.py appends (and older full-JSON tail
   lines); unparseable ones are reported and skipped, never fatal.
 
+Host provenance: a capture may declare the machine it ran on (a
+``host`` string in the result / ``parsed`` payload; bench.py stamps it
+from ``METISFL_BENCH_HOST`` or ``platform.node()``). A pair is **gated**
+(regressions fail the build) only when both captures name the same
+host, or neither names one (the pre-provenance record): absolute
+host-sensitive keys — RSS accounting, disk latencies — are not
+comparable across a hardware move, so a cross-host pair renders its
+rows informationally and never exits 1 on them. A collapsed headline
+(``*_failed`` shape) still fails regardless — a bench that stopped
+producing results is broken on any host.
+
 Library-usable: :func:`load_profiles`, :func:`render_waterfall`,
 :func:`span_self_times`, :func:`load_bench_capture`,
 :func:`compare_captures`.
@@ -40,6 +51,10 @@ from typing import Any, Dict, List, Optional, Tuple
 # line with it — the trajectory parser's anchor on degraded runs whose
 # main JSON line was truncated by the capture harness
 BENCH_MARKER = "METISFL_BENCH "
+
+# flattened-capture key carrying the declared capture host (never judged
+# — metric_direction reports 0 for it; see "Host provenance" above)
+HOST_KEY = "_host"
 
 # default relative-change threshold for regression flags (20% — well
 # under the 30% regressions the acceptance gate injects, well over
@@ -284,6 +299,12 @@ def load_bench_capture(path: str) -> Dict[str, Any]:
     return {}
 
 
+def capture_host(flat: Dict[str, Any]) -> str:
+    """The capture's declared host identity ('' = pre-provenance
+    capture). Kept under a non-judgeable key by :func:`flatten_bench`."""
+    return str(flat.get(HOST_KEY, "") or "")
+
+
 def _parse_capture_tail(tail: str) -> Dict[str, Any]:
     """Recover a result from a captured stdout tail: the final
     ``METISFL_BENCH`` marker wins (it is small, so it survives
@@ -347,6 +368,8 @@ def flatten_bench(capture: Dict[str, Any]) -> Dict[str, Any]:
     if "details" not in capture:
         for key, value in capture.items():
             _take(key, value)
+    if capture.get("host"):
+        flat[HOST_KEY] = str(capture["host"])
     return flat
 
 
@@ -512,10 +535,19 @@ def _compare_main(path_a: str, path_b: str, threshold: float,
                             show_all=show_all))
     regressions = [r for r in rows if r["regressed"]]
     if capture_collapsed(a, b):
+        # gated regardless of host: a bench that stopped producing a
+        # headline is broken on any machine
         print(f"REGRESSED: {os.path.basename(path_b)} headline value "
               f"collapsed to {b.get('value', 'absent')} (failed/degraded "
               f"run)", file=sys.stderr)
         return 1
+    host_a, host_b = capture_host(a), capture_host(b)
+    if host_a != host_b:
+        print(f"\nhost changed ({host_a or 'undeclared'} -> "
+              f"{host_b or 'undeclared'}): absolute host-sensitive keys "
+              "are not comparable — rows above are informational, not "
+              "gated", file=sys.stderr)
+        return 0
     if regressions:
         print(f"\n{len(regressions)} regression(s) past "
               f"{threshold * 100:.0f}% threshold", file=sys.stderr)
@@ -540,12 +572,19 @@ def _trajectory_main(paths: List[str], threshold: float) -> int:
         rows = compare_captures(a, b, threshold=threshold)
         regressions = [r for r in rows if r["regressed"]]
         improvements = [r for r in rows if r["improved"]]
+        host_a, host_b = capture_host(a), capture_host(b)
+        cross_host = host_a != host_b
         print(f"{name_a} -> {name_b}: {len(regressions)} regression(s), "
               f"{len(improvements)} improvement(s) over "
-              f"{len(rows)} judged key(s)")
+              f"{len(rows)} judged key(s)"
+              + (f"  [host changed: {host_a or 'undeclared'} -> "
+                 f"{host_b or 'undeclared'}; informational, not gated]"
+                 if cross_host else ""))
         for row in regressions:
             print(f"  REGRESSED {row['key']}: {row['a']:.4g} -> "
                   f"{row['b']:.4g} ({row['rel'] * 100:+.1f}%)")
+        if cross_host:
+            regressions = []  # collapse check below still gates
         if capture_collapsed(a, b):
             print(f"  REGRESSED {name_b}: headline value collapsed to "
                   f"{b.get('value', 'absent')} (failed/degraded run)")

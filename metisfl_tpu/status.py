@@ -241,6 +241,120 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
     return "\n".join(lines)
 
 
+def render_fleet(snap: Dict[str, Any], span_tail: int = 25) -> str:
+    """One :meth:`FleetCollector.snapshot` as the ``--fleet`` screen:
+    per-peer liveness/health/offset rows, the merged metric-family
+    summary, and the unified skew-corrected span waterfall."""
+    lines: List[str] = []
+    peers = snap.get("peers", [])
+    lines.append(f"fleet: {snap.get('live', 0)}/{len(peers)} peers live  "
+                 f"polls={snap.get('polls', 0)}")
+    if peers:
+        lines.append(f"{'peer':<28} {'role':<10} {'target':<22} "
+                     f"{'health':<12} {'state':<8} {'offset':>9} "
+                     f"{'rtt':>8} {'spans':>6} {'events':>6}")
+        for p in sorted(peers, key=lambda p: (p.get("role", ""),
+                                              p.get("peer", ""))):
+            state = ("DISABLED" if p.get("disabled")
+                     else "STALE" if p.get("stale")
+                     else "live" if p.get("live") else "pending")
+            lines.append(
+                f"{p.get('peer', '?'):<28} {p.get('role', '?'):<10} "
+                f"{p.get('target', '?'):<22} "
+                f"{p.get('health') or '-':<12} {state:<8} "
+                f"{p.get('offset_ms', 0.0):>+8.1f}ms "
+                f"{p.get('rtt_ms', 0.0):>6.1f}ms "
+                f"{p.get('spans', 0):>6} {p.get('events', 0):>6}")
+    families = snap.get("families") or {}
+    if families:
+        shown = []
+        for name in ("rounds_total", "controller_active_learners",
+                     "learner_tasks_total", "rpc_client_errors_total",
+                     "serving_requests_total", "alerts_fired_total"):
+            entry = families.get(name)
+            if entry and entry.get("total") is not None:
+                shown.append(f"{name}={entry['total']:g}")
+        total_series = sum(int(f.get("series", 0))
+                           for f in families.values())
+        lines.append(
+            f"merged metrics: {len(families)} families / "
+            f"{total_series} series"
+            + (f"  ({'  '.join(shown)})" if shown else ""))
+    spans = snap.get("spans") or []
+    if spans:
+        tail = spans[-span_tail:]
+        t0 = float(tail[0].get("start", 0.0))
+        by_id = {s.get("span"): s for s in tail if s.get("span")}
+        lines.append("")
+        lines.append(f"span waterfall (last {len(tail)}, one corrected "
+                     "clock; +s since first shown):")
+        for s in tail:
+            depth = 0
+            parent = s.get("parent", "")
+            seen = set()
+            while parent and parent in by_id and parent not in seen:
+                seen.add(parent)
+                depth += 1
+                parent = by_id[parent].get("parent", "")
+            dur = float(s.get("dur_ms", 0.0))
+            dur_cell = (f"{dur / 1e3:.2f}s" if dur >= 1e3
+                        else f"{dur:.1f}ms")
+            lines.append(
+                f"  +{max(0.0, float(s.get('start', 0.0)) - t0):8.3f}s "
+                f"{'  ' * depth}{s.get('name', '?')} ({dur_cell}) "
+                f"[{s.get('service', '?')}"
+                + (f"@{s['peer']}" if s.get("peer") else "") + "]")
+    tail = snap.get("events") or []
+    if tail:
+        from metisfl_tpu.telemetry import events as _events
+        lines.append("")
+        lines.append(f"fleet events (last {len(tail)}):")
+        t0 = float(tail[0].get("ts", 0.0)) if tail else None
+        for record in tail:
+            lines.append("  " + _events.format_record(record, t0=t0))
+    return "\n".join(lines)
+
+
+def _fleet_collector(args, ssl=None):
+    """A FleetCollector dialing the controller + everything
+    DescribeFederation knows about (the status CLI's --fleet source)."""
+    from metisfl_tpu.controller.service import (CONTROLLER_SERVICE,
+                                                LEARNER_SERVICE,
+                                                ControllerClient)
+    from metisfl_tpu.telemetry.fabric import FleetCollector
+
+    client = ControllerClient(args.host, args.port, ssl=ssl)
+
+    def _discover():
+        specs = [{"name": "controller", "host": args.host,
+                  "port": args.port, "service_name": CONTROLLER_SERVICE,
+                  "role": "controller"}]
+        try:
+            snap = client.describe_federation(event_tail=0, timeout=5.0,
+                                              wait_ready=False)
+        except Exception:  # noqa: BLE001 - known peers keep polling
+            return specs
+        for l in snap.get("learners", []):
+            if not l.get("port"):
+                continue
+            specs.append({"name": l.get("learner_id")
+                          or f"{l.get('hostname')}:{l.get('port')}",
+                          "host": l.get("hostname", "localhost"),
+                          "port": l["port"],
+                          "service_name": LEARNER_SERVICE,
+                          "role": "learner"})
+        if getattr(args, "serving_port", 0):
+            from metisfl_tpu.serving.service import SERVING_SERVICE
+            specs.append({"name": "serving", "host": args.host,
+                          "port": args.serving_port,
+                          "service_name": SERVING_SERVICE,
+                          "role": "serving"})
+        return specs
+
+    collector = FleetCollector(ssl=ssl, discover_fn=_discover)
+    return collector, client
+
+
 def render_probe(reflection: Dict[str, Any]) -> str:
     methods = reflection.get("methods", [])
     # endpoint role (ListMethods reflection): a serving gateway's surface
@@ -266,6 +380,8 @@ def _probe_learners(snap: Dict[str, Any], ssl=None) -> List[str]:
     from metisfl_tpu.comm.rpc import RpcClient
     from metisfl_tpu.controller.service import LEARNER_SERVICE
 
+    from metisfl_tpu.comm.health import probe_health
+
     out: List[str] = []
     for l in snap.get("learners", []):
         host, port = l.get("hostname", "?"), int(l.get("port", 0) or 0)
@@ -273,14 +389,17 @@ def _probe_learners(snap: Dict[str, Any], ssl=None) -> List[str]:
         if not port:
             out.append(f"{label}: no registered port")
             continue
+        # standard grpc.health.v1 probe first: a NOT_SERVING endpoint
+        # (shutting down) is a different answer than an unreachable one
+        health = probe_health(host, port, ssl=ssl)
         client = RpcClient(host, port, LEARNER_SERVICE, retries=0, ssl=ssl)
         try:
             raw = client.call("ListMethods", b"", timeout=5.0,
                               wait_ready=False)
-            out.append(f"{label}:")
+            out.append(f"{label} [health={health}]:")
             out.append(render_probe(_json.loads(raw.decode("utf-8"))))
         except Exception as exc:  # noqa: BLE001 - probe is best-effort
-            out.append(f"{label}: unreachable ({exc})")
+            out.append(f"{label} [health={health}]: unreachable ({exc})")
         finally:
             client.close()
     return out
@@ -301,7 +420,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="event-journal tail lines to show (0 = none)")
     parser.add_argument("--probe", action="store_true",
                         help="reflect every endpoint's RPC surface via "
-                             "ListMethods")
+                             "ListMethods (+ grpc.health.v1 probes)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="merged fleet view over the telemetry fabric "
+                             "(CollectTelemetry pulls against controller + "
+                             "learners + gateway): per-peer liveness and "
+                             "clock offset, merged metric families, one "
+                             "skew-corrected span waterfall")
+    parser.add_argument("--serving-port", type=int, default=0,
+                        help="--fleet: also pull the serving gateway on "
+                             "this port")
     parser.add_argument("--ssl-cert", default="",
                         help="federation TLS cert (a TLS-enabled run — the "
                              "driver's auto-generated pair lives in "
@@ -317,6 +445,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         ssl = SSLConfig(enabled=True, cert_path=args.ssl_cert,
                         key_path=args.ssl_key)
     target = f"{args.host}:{args.port}"
+    if args.fleet:
+        collector, client = _fleet_collector(args, ssl=ssl)
+        try:
+            while True:
+                collector.poll_once(timeout=10.0)
+                if args.once:
+                    # a second poll refines the first's offset estimate
+                    # before the one-shot render
+                    collector.poll_once(timeout=10.0)
+                    print(render_fleet(collector.snapshot()))
+                    return 0
+                sys.stdout.write("\x1b[2J\x1b[H"
+                                 + render_fleet(collector.snapshot())
+                                 + "\n")
+                sys.stdout.flush()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            collector.stop(final_poll=False)
+            client.close()
     client = ControllerClient(args.host, args.port, ssl=ssl)
     try:
         while True:
@@ -333,11 +482,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 continue
             screen = render_snapshot(snap, target=target, events=args.events)
             if args.probe:
+                from metisfl_tpu.comm.health import probe_health
+                health = probe_health(args.host, args.port, ssl=ssl)
                 try:
-                    screen += "\n\ncontroller " + render_probe(
-                        client.list_methods())
+                    screen += (f"\n\ncontroller [health={health}] "
+                               + render_probe(client.list_methods()))
                 except Exception as exc:  # noqa: BLE001
-                    screen += f"\n\ncontroller ListMethods failed: {exc}"
+                    screen += (f"\n\ncontroller [health={health}] "
+                               f"ListMethods failed: {exc}")
                 probe = _probe_learners(snap, ssl=ssl)
                 if probe:
                     screen += "\n" + "\n".join(probe)

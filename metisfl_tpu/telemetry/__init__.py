@@ -145,6 +145,10 @@ M_METRICS_SERIES_OVERFLOW_TOTAL = metrics.SERIES_OVERFLOW_TOTAL
 M_METRICS_FAMILY_SERIES = metrics.FAMILY_SERIES
 M_ALERTS_ACTIVE = alerts.ALERTS_ACTIVE
 M_ALERTS_FIRED_TOTAL = alerts.ALERTS_FIRED_TOTAL
+# fleet telemetry fabric (telemetry/fabric.py FleetCollector)
+M_FABRIC_COLLECTIONS_TOTAL = "fabric_collections_total"
+M_FABRIC_PEER_OFFSET_MS = "fabric_peer_clock_offset_ms"
+M_FABRIC_COLLECT_SECONDS = "fabric_collect_duration_seconds"
 # serving gateway (serving/gateway.py)
 M_SERVING_REQUESTS_TOTAL = "serving_requests_total"
 M_SERVING_REQUEST_LATENCY_SECONDS = "serving_request_latency_seconds"
@@ -230,11 +234,20 @@ def apply_config(telemetry_config, service: str = "",
     if enabled and pm_dir:
         postmortem.configure(pm_dir, service=service,
                              config_hash=config_hash)
+    # fleet telemetry fabric (telemetry/fabric.py): arm the process
+    # exporter + the finished-span ring, and mint a fresh epoch so
+    # collectors treat this configuration as a new incarnation
+    fab_cfg = getattr(telemetry_config, "fabric", None)
+    fabric.configure(
+        enabled=enabled and bool(getattr(fab_cfg, "enabled", True)),
+        span_ring=int(getattr(fab_cfg, "span_ring", 0) or 0))
 
 
 # Imported at the BOTTOM so profile.py (which reads the M_* constants at
 # its own import time) sees a fully-initialized package — the other
-# submodules import nothing back from this package.
-from metisfl_tpu.telemetry import profile  # noqa: E402
+# submodules import nothing back from this package. fabric imports only
+# sibling submodules at module level (its RPC client is lazy), so the
+# same late import keeps the comm <-> telemetry layering acyclic.
+from metisfl_tpu.telemetry import fabric, profile  # noqa: E402
 
-__all__.append("profile")
+__all__ += ["profile", "fabric"]

@@ -214,6 +214,12 @@ def _postmortem_main(argv: List[str]) -> int:
 def main(argv: List[str]) -> int:
     if "--postmortem" in argv:
         return _postmortem_main([a for a in argv if a != "--postmortem"])
+    if "--fabric-smoke" in argv:
+        # the fleet-fabric CI gate (scripts/chaos_smoke.sh); lives here
+        # so runpy never re-executes an already-imported submodule
+        from metisfl_tpu.telemetry import fabric as _fabric
+        return _fabric.main(
+            ["--smoke"] + [a for a in argv if a != "--fabric-smoke"])
     show_attrs = "--attrs" in argv
     argv = [a for a in argv if a != "--attrs"]
     want_trace = want_round = None

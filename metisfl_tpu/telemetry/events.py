@@ -258,6 +258,29 @@ class AlertResolved:
     active_s: float = 0.0
 
 
+@dataclass(frozen=True)
+class FabricPeerStale:
+    """The fleet collector's consecutive pulls from a telemetry peer
+    failed past the staleness threshold (telemetry/fabric.py
+    FleetCollector) — the peer stays in the fleet view, marked stale,
+    and collection continues for everyone else."""
+
+    kind: ClassVar[str] = "fabric_peer_stale"
+    peer: str
+    failures: int = 0
+
+
+@dataclass(frozen=True)
+class FabricPeerRecovered:
+    """A stale telemetry peer answered a fleet pull again; its cursors
+    resumed (or reset, when the peer restarted with a new epoch)."""
+
+    kind: ClassVar[str] = "fabric_peer_recovered"
+    peer: str
+    stale_s: float = 0.0
+    epoch_changed: bool = False
+
+
 EVENT_TYPES: Dict[str, type] = {
     cls.kind: cls
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
@@ -266,7 +289,7 @@ EVENT_TYPES: Dict[str, type] = {
                 RoundHealth, LearnerQuarantined, DispatchRetried,
                 RoundHalted, VersionRegistered, VersionPromoted,
                 VersionRolledBack, ServingSwapped, AlertFiring,
-                AlertResolved)
+                AlertResolved, FabricPeerStale, FabricPeerRecovered)
 }
 
 
@@ -363,6 +386,15 @@ class Journal:
             records = list(self._ring)
         return records[-n:] if n > 0 else records
 
+    def tail_since(self, seq: int, limit: int = 0) -> List[dict]:
+        """Records with ``seq > cursor`` (oldest first) — the fleet
+        fabric's cursor pull (telemetry/fabric.py). A cursor older than
+        the ring tail silently skips the evicted records; the JSONL sink
+        keeps the full history."""
+        with self._lock:
+            records = [r for r in self._ring if r.get("seq", 0) > seq]
+        return records[:limit] if limit > 0 else records
+
     def flush(self) -> None:
         with self._lock:
             if self._fh is not None:
@@ -406,6 +438,10 @@ def emit(event_cls: Type, **fields) -> Optional[dict]:
 
 def tail(n: int = 0) -> List[dict]:
     return _JOURNAL.tail(n)
+
+
+def tail_since(seq: int, limit: int = 0) -> List[dict]:
+    return _JOURNAL.tail_since(seq, limit=limit)
 
 
 def flush() -> None:

@@ -370,6 +370,30 @@ class _Metric:
             for key in [k for k in self._values if k[idx] == value]:
                 self._values.pop(key, None)
 
+    def collect_state(self) -> Dict[str, object]:
+        """Serializable family state for the fleet fabric's
+        ``CollectTelemetry`` pull (telemetry/fabric.py): identity
+        (name/kind/help/labels) plus either exact series values or, for
+        a budget-collapsed family, the mergeable sketch dict. O(series)
+        below budget, O(budget) past it — exactly the exposition's
+        size posture."""
+        state: Dict[str, object] = {
+            "name": self.name, "kind": self.kind, "help": self.help,
+            "labels": list(self.labelnames),
+            "budget_label": self.budget_label,
+        }
+        with self._lock:
+            if getattr(self, "_sketch", None) is not None:
+                state["sketch"] = self._sketch.to_dict()
+            elif self.kind == "histogram":
+                state["buckets"] = list(self.buckets)
+                state["cells"] = [[list(k), list(v)]
+                                  for k, v in sorted(self._values.items())]
+            else:
+                state["series"] = [[list(k), float(v)]
+                                   for k, v in sorted(self._values.items())]
+        return state
+
     def budget_state(self):
         with self._lock:
             return (self._sketch.to_dict()
@@ -550,6 +574,26 @@ class Histogram(_Metric):
             out.append(f"{self.name}_sum{base} {_format_value(cells[-1])}")
             out.append(f"{self.name}_count{base} {_format_value(cells[-2])}")
 
+    def add_cells(self, key: Sequence[str], cells: Sequence[float]) -> None:
+        """Element-wise fold of one series' raw bucket cells (fleet
+        fabric merge, telemetry/fabric.py): histogram cells are counts +
+        a sum, so cross-process merge is plain addition."""
+        key = tuple(str(v) for v in key)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label "
+                f"values, got {len(key)}")
+        want = len(self.buckets) + 2
+        if len(cells) != want:
+            raise ValueError(
+                f"{self.name}: expected {want} cells, got {len(cells)}")
+        with self._lock:
+            mine = self._values.get(key)
+            if mine is None:
+                mine = self._values[key] = [0.0] * want
+            for i, v in enumerate(cells):
+                mine[i] += float(v)
+
     def _series_with(self, key: Tuple[str, ...],
                      extra: Tuple[str, str]) -> str:
         pairs = [f'{k}="{_escape(v)}"' for k, v in zip(self.labelnames, key)]
@@ -655,6 +699,21 @@ class Registry:
         across all budgeted families — the one call leave() needs."""
         for family in self.budget_families():
             family.prune_label_value(value)
+
+    def collect_state(self) -> List[Dict[str, object]]:
+        """Every family's :meth:`_Metric.collect_state`, name-sorted —
+        the metrics section of a ``CollectTelemetry`` reply. Families
+        with no series yet are skipped (the exposition skips them too,
+        keeping the single-peer fleet merge bit-identical)."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: List[Dict[str, object]] = []
+        for metric in metrics:
+            state = metric.collect_state()
+            if state.get("series") or state.get("cells") \
+                    or state.get("sketch"):
+                out.append(state)
+        return out
 
     def budget_state(self) -> Dict[str, Dict]:
         """Serialized sketches of every collapsed family (checkpoint

@@ -23,6 +23,7 @@ interleave writes). ``python -m metisfl_tpu.telemetry`` renders the tree.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
 import json
@@ -30,9 +31,15 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Iterable, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 METADATA_KEY = "metisfl-trace-ctx"
+
+# Finished-span ring capacity (fleet-fabric cursor pulls,
+# telemetry/fabric.py): bounded per process; 0 disables the ring (the
+# ``telemetry.fabric.enabled=false`` opt-out path — span recording then
+# costs one attribute check over today's sink-only behavior).
+DEFAULT_SPAN_RING = 4096
 
 _CURRENT: "contextvars.ContextVar[Optional[SpanContext]]" = \
     contextvars.ContextVar("metisfl_tpu_trace_ctx", default=None)
@@ -182,6 +189,14 @@ class _Tracer:
         # still be collected: the flight recorder's "what was open when
         # the process died" snapshot (telemetry/postmortem.py)
         self._open: "Dict[int, Any]" = {}
+        # finished-span ring with a process-monotonic seq per record:
+        # the fleet fabric's cursor-pull source (telemetry/fabric.py).
+        # None (the default) = ring disabled — processes that never arm
+        # the fabric (apply_config / fabric.configure, or lazily on the
+        # first CollectTelemetry pull) keep the pre-fabric record cost:
+        # one attribute check when there is no sink either.
+        self._ring: Optional["collections.deque"] = None
+        self._ring_seq = 0
 
     def _opened(self, span: "Span") -> None:
         import weakref
@@ -242,6 +257,11 @@ class _Tracer:
             self.dir = dir
             self._path = ""
             self._open.clear()  # a reconfigure starts a fresh lifetime
+            if self._ring is not None:
+                # fresh lifetime for the fabric ring too: the seq counter
+                # keeps running (cursors held by collectors stay
+                # monotone within this process incarnation)
+                self._ring.clear()
             if enabled and dir:
                 try:
                     os.makedirs(dir, exist_ok=True)
@@ -258,7 +278,8 @@ class _Tracer:
                     dir, f"{self.service}-{os.getpid()}.jsonl")
 
     def _record(self, span: Span) -> None:
-        if not self._path:
+        ring = self._ring
+        if not self._path and ring is None:
             return
         record = {
             "trace": span.trace_id,
@@ -271,7 +292,13 @@ class _Tracer:
             "dur_ms": round(span._duration_ms or 0.0, 3),
         }
         if span.attrs:
-            record["attrs"] = span.attrs
+            record["attrs"] = dict(span.attrs)
+        if ring is not None:
+            with self._lock:
+                self._ring_seq += 1
+                ring.append({**record, "seq": self._ring_seq})
+        if not self._path:
+            return
         line = json.dumps(record, default=str) + "\n"
         with self._lock:
             try:
@@ -285,6 +312,38 @@ class _Tracer:
                 # traced code path down with it — stop persisting
                 self._path = ""
                 self._fh = None
+
+    def configure_ring(self, size: int) -> None:
+        """(Re)size the finished-span ring; 0 disables it (and with it
+        fabric span pulls from this process). Existing records are kept
+        on a resize, dropped on disable."""
+        with self._lock:
+            if size <= 0:
+                self._ring = None
+            elif self._ring is None or self._ring.maxlen != size:
+                self._ring = collections.deque(self._ring or (),
+                                               maxlen=int(size))
+
+    def spans_since(self, cursor: int, limit: int = 0
+                    ) -> Tuple[List[dict], int, int]:
+        """``(records, new_cursor, lost)``: finished-span records with
+        ``seq > cursor`` (oldest first), the new cursor, and how many
+        records between the cursor and the ring tail were already
+        EVICTED (bounded memory wins over total recall — but the loss is
+        reported, never silent; the JSONL sink keeps the full history)."""
+        with self._lock:
+            if self._ring is None:
+                return [], cursor, 0
+            records = [r for r in self._ring if r["seq"] > cursor]
+            new_cursor = self._ring_seq
+            oldest = self._ring[0]["seq"] if self._ring else \
+                self._ring_seq + 1
+        lost = max(0, oldest - 1 - cursor) if cursor < oldest - 1 else 0
+        if limit > 0:
+            records = records[:limit]
+            if records:
+                new_cursor = records[-1]["seq"]
+        return records, max(new_cursor, cursor), lost
 
     def flush(self) -> None:
         with self._lock:
@@ -322,6 +381,19 @@ def open_spans() -> list:
     """Live (un-ended) spans as records — the flight recorder's
     "what was in flight" snapshot (telemetry/postmortem.py)."""
     return _TRACER.open_spans()
+
+
+def configure_ring(size: int) -> None:
+    """Size the finished-span ring backing fabric cursor pulls
+    (0 disables; telemetry/fabric.py)."""
+    _TRACER.configure_ring(size)
+
+
+def spans_since(cursor: int, limit: int = 0) -> Tuple[List[dict], int, int]:
+    """``(records, new_cursor, lost)`` — finished spans newer than
+    ``cursor``, the new cursor, and the evicted-record count (the
+    ``CollectTelemetry`` span source, telemetry/fabric.py)."""
+    return _TRACER.spans_since(cursor, limit=limit)
 
 
 def span(name: str, parent: Any = _USE_CURRENT,

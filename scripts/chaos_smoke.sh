@@ -49,4 +49,24 @@ case "$rc" in
   *) echo "chaos_smoke: FAIL — harness crashed or timed out (rc=$rc)" >&2
      rc=2 ;;
 esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
+# ISSUE 11 fleet-tail gate (docs/OBSERVABILITY.md "Fleet fabric"): a
+# three-peer real-gRPC fleet with one flapping learner — the collector
+# must keep assembling the merged view while the peer is down (stale
+# marked, collection never raises, the peer recovers on relaunch) and
+# the mean incremental poll must stay under the pinned 400 ms bound.
+JAX_PLATFORMS=cpu timeout -k 10 60 "$PYTHON" -m metisfl_tpu.telemetry \
+  --fabric-smoke --budget-ms 400
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: fleet-tail PASS (stale marked + recovered under" \
+          "flap, merged view never dropped, poll overhead within bound)" ;;
+  1) echo "chaos_smoke: fleet-tail FAIL — the collector dropped the" \
+          "merged view under flap or blew the poll budget (see JSON" \
+          "above)" >&2 ;;
+  *) echo "chaos_smoke: fleet-tail FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
 exit "$rc"
