@@ -1238,6 +1238,106 @@ def bench_fabric(peer_counts=(2, 8, 32), spans=1500, events=400,
     return out
 
 
+def bench_tree_dist(branches=(2, 8), client_counts=(1000, 10000),
+                    rehome_slices=3, rehome_clients=1000, dim=256):
+    """Distributed slice-aggregation section (ISSUE 12;
+    docs/RESILIENCE.md "Distributed slice aggregators"): rounds/s of the
+    slice tier — submit every simulated client's uplink over real gRPC
+    to its slice aggregator, then fan in O(branch) FoldPartial replies —
+    vs branch ∈ {2, 8} at 1k/10k simulated clients, plus the mid-round
+    re-homing pause (reduce with one aggregator freshly dead, spool
+    recovery included, minus the clean reduce). In-process
+    :class:`SliceServer` endpoints (real gRPC loopback, the fabric
+    section's posture). Keys are direction-classified for
+    ``python -m metisfl_tpu.perf --trajectory`` (round_ms/pause_ms
+    lower-better, per_sec higher-better)."""
+    import shutil
+    import tempfile
+
+    from metisfl_tpu.aggregation.distributed import DistributedSliceReducer
+    from metisfl_tpu.aggregation.slice import SliceServer
+
+    rng = np.random.default_rng(23)
+    model = {"w": rng.standard_normal((dim,)).astype(np.float32)}
+
+    def build(n_slices, tmp):
+        servers, specs = [], []
+        for i in range(n_slices):
+            spool = os.path.join(tmp, f"slice_{i}")
+            server = SliceServer(spool_dir=spool, name=f"slice_{i}",
+                                 host="127.0.0.1", port=0)
+            port = server.start()
+            servers.append(server)
+            specs.append({"name": f"slice_{i}", "host": "127.0.0.1",
+                          "port": port, "spool_dir": spool})
+
+        class _Cfg:
+            slices = specs
+            rehome_retries = 2
+            rehome_backoff_s = 0.02
+
+        return servers, DistributedSliceReducer(_Cfg())
+
+    out = {"tree_dist_model_bytes": int(model["w"].nbytes)}
+    labels = {1000: "1k", 10000: "10k"}
+    for branch in branches:
+        for clients in client_counts:
+            tag = f"b{branch}_c{labels.get(clients, clients)}"
+            tmp = tempfile.mkdtemp(prefix="bench_tree_dist_")
+            servers, red = build(branch, tmp)
+            try:
+                ids = [f"L{i:05d}" for i in range(clients)]
+                scales = {lid: 1.0 / clients for lid in ids}
+                red.assign(ids)
+                t0 = time.perf_counter()
+                for lid in ids:
+                    red.submit(lid, model, 0)
+                submit_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                reduced = red.reduce(ids, scales, stride=0, round_id=0)
+                round_s = time.perf_counter() - t0
+                assert reduced is not None
+                red.round_complete()
+                out[f"tree_dist_{tag}_submit_per_sec"] = int(
+                    clients / max(submit_s, 1e-9))
+                out[f"tree_dist_{tag}_round_ms"] = round(round_s * 1e3, 2)
+                out[f"tree_dist_{tag}_rounds_per_sec"] = round(
+                    1.0 / max(submit_s + round_s, 1e-9), 2)
+            finally:
+                red.shutdown()
+                for server in servers:
+                    server.stop()
+                shutil.rmtree(tmp, ignore_errors=True)
+    # re-homing pause: one aggregator freshly dead at reduce time — the
+    # pause covers death detection (probe), spool recovery, and the
+    # re-folded group, measured against the same fleet's clean reduce
+    tmp = tempfile.mkdtemp(prefix="bench_tree_dist_")
+    servers, red = build(rehome_slices, tmp)
+    try:
+        ids = [f"L{i:05d}" for i in range(rehome_clients)]
+        scales = {lid: 1.0 / rehome_clients for lid in ids}
+        red.assign(ids)
+        for lid in ids:
+            red.submit(lid, model, 0)
+        t0 = time.perf_counter()
+        red.reduce(ids, scales, stride=0, round_id=0)
+        clean_s = time.perf_counter() - t0
+        servers[0].stop()
+        t0 = time.perf_counter()
+        reduced = red.reduce(ids, scales, stride=0, round_id=1)
+        rehome_s = time.perf_counter() - t0
+        assert reduced is not None and red.rehomed_total == 1
+        out["tree_dist_rehome_round_ms"] = round(rehome_s * 1e3, 2)
+        out["tree_dist_rehome_pause_ms"] = round(
+            max(0.0, rehome_s - clean_s) * 1e3, 2)
+    finally:
+        red.shutdown()
+        for server in servers:
+            server.stop()  # idempotent: covers the deliberately-killed one
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_lora(require_tpu: bool = True):
     """Single-chip LoRA execution proof (VERDICT r4 #7): a ~1.2B-param
     frozen bf16 LlamaLite base + rank-16 adapters on q/v, real optimizer
@@ -1314,6 +1414,7 @@ _SECTIONS = {
     "churn": lambda a: bench_churn(),
     "obs": lambda a: bench_obs(),
     "fabric": lambda a: bench_fabric(),
+    "tree_dist": lambda a: bench_tree_dist(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1540,7 +1641,7 @@ _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
                      "e2e": 600, "cohort": 1200, "health": 240,
                      "serving": 300, "churn": 240, "obs": 240,
-                     "fabric": 240, "lora": 600}
+                     "fabric": 240, "tree_dist": 300, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1588,7 +1689,7 @@ _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
 _HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
-                  "obs", "fabric")
+                  "obs", "fabric", "tree_dist")
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_partial.json")
 

@@ -1,0 +1,440 @@
+"""Slice aggregator: a driver-booted, chaos-killable aggregation process.
+
+PR 7's tree tier made controller fan-in O(branch), but the "branches"
+were worker threads inside the controller process — no aggregation
+component could fail independently. This module promotes a tree slice to
+a real BytesService role (next to controller/learner/serving): a *slice
+aggregator* process owns one contiguous cohort slice, receives its
+learners' uplinks over gRPC, folds them with the exact kernels the
+in-process tier uses (:meth:`TreeReducer._fold_slice` →
+``np_stacked_scaled_add``), and answers one ``FoldPartial`` per round —
+the controller fans in O(branch) partials and never holds the slice's
+models (``aggregation/distributed.py`` is the controller side).
+
+Durability contract (what makes mid-round re-homing possible,
+docs/RESILIENCE.md): every accepted uplink is spooled to
+``<spool_dir>/<learner_id>.bin`` via atomic rename BEFORE the submit is
+acked, so an acked uplink survives the process. When the aggregator dies
+mid-round, the controller re-reads the spool directory (driver-booted
+slices share the workdir filesystem) and re-homes the slice — surviving
+uplinks re-submit to a replacement aggregator or fold directly at the
+root, and the round completes (``SliceRehomed``).
+
+Memory model: one fold-ready model tree per owned learner, latest wins —
+exactly the ``required_lineage == 1`` semantics of the weighted-sum
+rules the tier applies to (fedavg / scaffold / fedstride). ``Forget``
+prunes departed learners (the controller's ``leave()`` path).
+
+Entry point::
+
+    python -m metisfl_tpu.aggregation.slice --port 50070 \
+        --spool-dir /tmp/slices/slice_0 --name slice_0
+    # or, driver-booted: --config federation_config.bin --index 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from metisfl_tpu import telemetry as _tel
+from metisfl_tpu.aggregation.tree import _DEFAULT_SUBBLOCK, TreeReducer
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry.sketch import QuantileDigest, SpaceSaving
+from metisfl_tpu.tensor.pytree import ModelBlob
+
+logger = logging.getLogger("metisfl_tpu.aggregation.slice")
+
+SLICE_SERVICE = "metisfl_tpu.SliceAggregator"
+
+_REG = _tmetrics.registry()
+_M_UPLINKS = _REG.counter(
+    _tel.M_SLICE_UPLINKS_TOTAL,
+    "Uplinks accepted (spooled + held) by this slice aggregator")
+_M_HELD = _REG.gauge(
+    _tel.M_SLICE_HELD_MODELS,
+    "Learner models currently held fold-ready by this slice aggregator")
+
+
+def spool_path(spool_dir: str, learner_id: str) -> str:
+    """The learner's spool file. Learner ids are ``L<idx>_<host>_<port>``
+    — path-safe by construction; anything else is sanitized, with a
+    short digest suffix so two DISTINCT hostile ids can never collide
+    onto one file (a collision would let the second acked uplink
+    silently overwrite the first's durability record). The exact id
+    rides inside the record either way."""
+    safe = "".join(c if (c.isalnum() or c in "._-") else "_"
+                   for c in learner_id)
+    if safe != learner_id:
+        import hashlib
+        safe += "-" + hashlib.sha1(
+            learner_id.encode("utf-8", "surrogatepass")).hexdigest()[:8]
+    return os.path.join(spool_dir, f"{safe}.bin")
+
+
+def read_spool(spool_dir: str) -> Dict[str, bytes]:
+    """Recover a (possibly dead) aggregator's spooled uplinks:
+    ``{learner_id: model blob bytes}``. Records are codec envelopes
+    carrying the EXACT learner id (filenames are sanitized, so an id
+    with filesystem-hostile characters would not round-trip through
+    them). Torn or unreadable files are skipped with a warning — the
+    blob integrity framing downstream rejects garbage anyway, and
+    re-homing must recover what it can, not abort on what it cannot."""
+    out: Dict[str, bytes] = {}
+    if not os.path.isdir(spool_dir):
+        return out
+    for name in sorted(os.listdir(spool_dir)):
+        if not name.endswith(".bin"):
+            continue
+        path = os.path.join(spool_dir, name)
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+            record = loads(raw)
+            blob = record["model"]
+            ModelBlob.from_bytes(blob)  # integrity check before recovery
+            out[str(record["learner_id"])] = blob
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            logger.warning("spool file %s unreadable (%s); skipped",
+                           path, exc)
+    return out
+
+
+class SliceAggregator:
+    """The slice aggregator's state machine (transport-free; the server
+    below mounts it behind a :class:`BytesService`, tests drive it
+    directly). Thread-safe: uplinks arrive on RPC threads while the
+    controller's fold request runs on another."""
+
+    def __init__(self, spool_dir: str = "", name: str = "slice"):
+        self.name = name
+        self.spool_dir = spool_dir
+        if spool_dir:
+            os.makedirs(spool_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        # learner_id -> (round, fold-ready model tree) — latest wins,
+        # the required_lineage == 1 store semantics
+        self._models: Dict[str, tuple] = {}
+        if spool_dir:
+            # the durability contract both ways: a RELAUNCHED aggregator
+            # reloads its spool, so acked uplinks survive the process —
+            # not just for the controller's re-home path but for the
+            # driver's supervised relaunch too (a learner that skips the
+            # next round keeps its lineage, exactly like the store path)
+            for lid, blob in read_spool(spool_dir).items():
+                try:
+                    self._models[lid] = (
+                        0, dict(ModelBlob.from_bytes(blob).tensors))
+                except ValueError:  # pragma: no cover - checked on read
+                    continue
+            if self._models:
+                logger.info("slice %s reloaded %d spooled model(s)",
+                            name, len(self._models))
+                _M_HELD.set(len(self._models))
+        # per-client stats sharded down from the controller: the slice
+        # owns its learners' uplink accounting and ships O(1) mergeable
+        # sketches to the root (PR 9's rollup format) instead of the
+        # root keeping O(fleet) per-learner series
+        self._bytes_digest = QuantileDigest()
+        self._top_bytes = SpaceSaving(capacity=32)
+        self._uplinks = 0
+
+    # -- uplink path (RPC threads) ----------------------------------------
+    def submit(self, learner_id: str, round_id: int, blob: bytes) -> int:
+        """Accept one uplink: spool first (atomic — an acked uplink
+        survives this process), then hold the decoded tree fold-ready.
+        Returns the held-model count."""
+        model = dict(ModelBlob.from_bytes(blob).tensors)
+        if not model:
+            raise ValueError("uplink carries no tensors")
+        if self.spool_dir:
+            path = spool_path(self.spool_dir, learner_id)
+            # codec envelope: the EXACT learner id rides inside the
+            # record (the sanitized filename alone would not round-trip
+            # a filesystem-hostile id through recovery)
+            record = dumps({"learner_id": learner_id,
+                            "round": int(round_id), "model": blob})
+            fd, tmp = tempfile.mkstemp(dir=self.spool_dir, prefix=".up_",
+                                       suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(record)
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        with self._lock:
+            self._models[learner_id] = (int(round_id), model)
+            held = len(self._models)
+            self._uplinks += 1
+            self._bytes_digest.add(float(len(blob)))
+            self._top_bytes.update(learner_id, float(len(blob)))
+        _M_UPLINKS.inc()
+        _M_HELD.set(held)
+        return held
+
+    def forget(self, learner_ids) -> int:
+        """Prune departed learners (controller ``leave()``): drop the
+        held model and the spool file. Returns how many were held."""
+        dropped = 0
+        with self._lock:
+            for lid in learner_ids:
+                if self._models.pop(lid, None) is not None:
+                    dropped += 1
+                self._top_bytes.drop(lid)
+            held = len(self._models)
+        _M_HELD.set(held)
+        if self.spool_dir:
+            for lid in learner_ids:
+                try:
+                    os.unlink(spool_path(self.spool_dir, lid))
+                except OSError:
+                    pass
+        return dropped
+
+    # -- fold path (controller's FoldPartial) ------------------------------
+    def fold(self, ids, scales: Dict[str, float],
+             stride: int = 0) -> Dict[str, Any]:
+        """Fold the held models for ``ids`` (in the given order, with the
+        in-process tier's sub-block blocking — same kernels, same
+        accumulator dtype, so the partial is bit-identical to what a
+        :class:`TreeReducer` worker would have produced from the same
+        models). Returns the wire-ready partial dict."""
+        with self._lock:
+            snapshot = {lid: self._models[lid][1] for lid in ids
+                        if lid in self._models}
+
+        def fetch(block):
+            return {lid: [snapshot[lid]] for lid in block
+                    if lid in snapshot}
+
+        subblock = int(stride) or _DEFAULT_SUBBLOCK
+        partial = TreeReducer._fold_slice(list(ids), scales, fetch, subblock)
+        reply: Dict[str, Any] = {
+            "ok": True,
+            "count": partial.count,
+            "z": float(partial.z),
+            "duration_ms": round(partial.duration_ms, 3),
+            "dtypes": list(partial.dtypes or ()),
+            "present": [lid for lid in ids if lid in snapshot],
+            "acc": b"",
+            "stats": self.stats(),
+        }
+        if partial.acc is not None:
+            reply["acc"] = ModelBlob(
+                tensors=[(name, np.asarray(arr))
+                         for name, arr in sorted(partial.acc.items())]
+            ).to_bytes()
+        return reply
+
+    def stats(self) -> Dict[str, Any]:
+        """The slice's per-client rollup as mergeable sketches (PR 9's
+        slice→root format): uplink-bytes quantile digest + top offenders
+        by bytes. O(compression), however many learners the slice owns."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "held": len(self._models),
+                "uplinks": self._uplinks,
+                "bytes_digest": self._bytes_digest.to_dict(),
+                "top_bytes": self._top_bytes.to_dict(),
+            }
+
+
+class SliceServer:
+    """Host a :class:`SliceAggregator` behind gRPC: the BytesService role
+    (ListMethods / GetMetrics / CollectTelemetry mounted like every other
+    role) plus grpc.health.v1 — the controller's slice supervision probes
+    it with :func:`metisfl_tpu.comm.health.probe_health`."""
+
+    def __init__(self, spool_dir: str = "", name: str = "slice",
+                 host: str = "0.0.0.0", port: int = 0, ssl=None):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+        from metisfl_tpu.comm.rpc import BytesService, RpcServer
+
+        self.aggregator = SliceAggregator(spool_dir=spool_dir, name=name)
+        self._server = RpcServer(host, port, ssl=ssl)
+        self._health = HealthServicer()
+        self._health.set_status(SLICE_SERVICE, SERVING)
+        self._server.add_service(self._health.service())
+        self._server.add_service(BytesService(SLICE_SERVICE, {
+            "SubmitUplink": self._submit,
+            "FoldPartial": self._fold,
+            "Forget": self._forget,
+            "DescribeSlice": self._describe,
+            "GetHealthStatus": self._health_rpc,
+            "GetMetrics": self._get_metrics,
+            "ShutDown": self._shutdown_rpc,
+        }, role="slice"))
+        self._shutdown_event = threading.Event()
+        self.port: Optional[int] = None
+
+    # -- handlers (RPC threads) -------------------------------------------
+    def _submit(self, raw: bytes) -> bytes:
+        req = loads(raw)
+        held = self.aggregator.submit(str(req["learner_id"]),
+                                      int(req.get("round", 0)),
+                                      req["model"])
+        return dumps({"ok": True, "held": held})
+
+    def _fold(self, raw: bytes) -> bytes:
+        req = loads(raw)
+        return dumps(self.aggregator.fold(
+            [str(lid) for lid in req.get("ids", [])],
+            {str(k): float(v) for k, v in (req.get("scales") or {}).items()},
+            stride=int(req.get("stride", 0))))
+
+    def _forget(self, raw: bytes) -> bytes:
+        req = loads(raw)
+        dropped = self.aggregator.forget(
+            [str(lid) for lid in req.get("learner_ids", [])])
+        return dumps({"ok": True, "dropped": dropped})
+
+    def _describe(self, raw: bytes) -> bytes:
+        return dumps(self.aggregator.stats())
+
+    def _health_rpc(self, raw: bytes) -> bytes:
+        return dumps({"status": "SERVING", "name": self.aggregator.name})
+
+    def _get_metrics(self, raw: bytes) -> bytes:
+        return _tel.render_metrics().encode("utf-8")
+
+    def _shutdown_rpc(self, raw: bytes) -> bytes:
+        threading.Thread(target=self.stop, daemon=True).start()
+        return dumps({"ok": True})
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        self.port = self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._shutdown_event.is_set():
+            return
+        from metisfl_tpu.comm.health import NOT_SERVING
+
+        self._health.set_all(NOT_SERVING)
+        self._shutdown_event.set()
+        self._server.stop()
+
+    def wait_for_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown_event.wait(timeout)
+
+
+class SliceClient:
+    """Controller → slice aggregator transport. No transparent retries —
+    the distributed tier owns the retry/backoff/re-home policy, so a dead
+    endpoint must surface immediately (``retries=0``, no wait-for-ready:
+    liveness counts in seconds, not channel backoff)."""
+
+    def __init__(self, host: str, port: int, ssl=None, comm=None,
+                 timeout_s: float = 30.0):
+        from metisfl_tpu.comm.rpc import RpcClient
+
+        kwargs = {}
+        if comm is not None:
+            kwargs["default_deadline_s"] = comm.default_deadline_s
+        self.target = f"{host}:{port}"
+        self.timeout_s = timeout_s
+        self._client = RpcClient(host, port, SLICE_SERVICE, retries=0,
+                                 ssl=ssl, **kwargs)
+
+    def submit(self, learner_id: str, round_id: int, blob: bytes) -> dict:
+        return loads(self._client.call(
+            "SubmitUplink",
+            dumps({"learner_id": learner_id, "round": int(round_id),
+                   "model": blob}),
+            timeout=self.timeout_s, wait_ready=False))
+
+    def fold(self, ids, scales, stride: int = 0,
+             timeout: Optional[float] = None) -> dict:
+        return loads(self._client.call(
+            "FoldPartial",
+            dumps({"ids": list(ids), "scales": dict(scales),
+                   "stride": int(stride)}),
+            timeout=timeout or max(self.timeout_s, 120.0),
+            wait_ready=False))
+
+    def forget(self, learner_ids) -> dict:
+        return loads(self._client.call(
+            "Forget", dumps({"learner_ids": list(learner_ids)}),
+            timeout=self.timeout_s, wait_ready=False))
+
+    def describe(self) -> dict:
+        return loads(self._client.call("DescribeSlice", b"",
+                                       timeout=self.timeout_s,
+                                       wait_ready=False, idempotent=True))
+
+    def shutdown_remote(self) -> None:
+        self._client.call("ShutDown", b"", timeout=5.0, wait_ready=False)
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "metisfl_tpu.aggregation.slice",
+        description="slice aggregator process (BytesService role 'slice')")
+    parser.add_argument("--config", default="",
+                        help="federation config file (wire or YAML); the "
+                             "endpoint comes from aggregation.tree."
+                             "slices[--index]")
+    parser.add_argument("--index", type=int, default=0,
+                        help="this aggregator's entry in aggregation."
+                             "tree.slices (with --config)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--spool-dir", default="")
+    parser.add_argument("--name", default="")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    host, port = args.host, args.port
+    spool_dir, name = args.spool_dir, args.name
+    ssl = None
+    if args.config:
+        from metisfl_tpu.config import FederationConfig, load_config
+        if args.config.endswith((".yaml", ".yml")):
+            config = load_config(args.config)
+        else:
+            with open(args.config, "rb") as fh:
+                config = FederationConfig.from_wire(fh.read())
+        slices = config.aggregation.tree.slices
+        if not 0 <= args.index < len(slices):
+            parser.error(f"--index {args.index} out of range for "
+                         f"{len(slices)} configured slice(s)")
+        spec = slices[args.index]
+        port = port or int(spec.get("port", 0))
+        spool_dir = spool_dir or str(spec.get("spool_dir", ""))
+        name = name or str(spec.get("name", ""))
+        ssl = config.ssl
+        _tel.apply_config(config.telemetry,
+                          service=name or f"slice_{args.index}")
+    name = name or f"slice_{os.getpid()}"
+    server = SliceServer(spool_dir=spool_dir, name=name, host=host,
+                         port=port, ssl=ssl)
+    bound = server.start()
+    logger.info("slice aggregator %s listening on %s:%d (spool %s)",
+                name, host, bound, spool_dir or "<off>")
+    try:
+        server.wait_for_shutdown()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

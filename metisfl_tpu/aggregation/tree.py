@@ -76,9 +76,14 @@ class TreeReducer:
         return self._pool
 
     def shutdown(self) -> None:
+        """Idempotent: a second shutdown (or close) is a no-op, and a
+        reducer can be reused after it — the pool re-creates lazily."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+
+    # the controller and tests use the close() spelling interchangeably
+    close = shutdown
 
     # -- slice fold (worker thread) ----------------------------------------
     @staticmethod
@@ -131,7 +136,20 @@ class TreeReducer:
             futures = [self._executor().submit(
                 self._fold_slice, s, scales, fetch, subblock)
                 for s in slices]
-            partials = [f.result() for f in futures]
+            # settle EVERY future before raising: a worker raising
+            # mid-fold (a store select error, a malformed lineage) must
+            # propagate to the caller's aggregation-failure retry, but
+            # abandoning the sibling workers mid-flight would leave them
+            # racing the retry's folds through the same (reused) pool
+            partials, first_error = [], None
+            for f in futures:
+                try:
+                    partials.append(f.result())
+                except Exception as exc:  # noqa: BLE001 - re-raised below
+                    if first_error is None:
+                        first_error = exc
+            if first_error is not None:
+                raise first_error
         live = [p for p in partials if p.acc is not None]
         if not live:
             return None

@@ -97,14 +97,25 @@ class FaultInjected(Exception):
 class FaultRule:
     """One fault site. Empty ``side``/``service``/``method`` match any;
     ``process`` is driver-side routing only (which subprocess gets the
-    rule) and is ignored by the injector itself."""
+    rule) and is ignored by the injector itself.
+
+    Kill-at-slice: slice aggregators (``aggregation/slice.py``) route
+    like every other role — ``process="slice"`` arms every aggregator,
+    ``"slice_<idx>"`` exactly one, and a rule like ``{"fault": "kill",
+    "side": "server", "method": "SubmitUplink", "after_calls": 2,
+    "max_fires": 1}`` kills the aggregator mid-round, which is the
+    trigger the re-homing acceptance gate (tests/test_slice.py,
+    scripts/chaos_smoke.sh) is built on. Supervised relaunches run clean
+    (driver arms original incarnations only), so re-homing + re-adoption
+    can be proven to converge."""
 
     fault: str                    # drop | delay | hang | corrupt | kill |
                                   # flap | slow | partition
     side: str = ""                # client | server | "" (both)
     service: str = ""
     method: str = ""
-    process: str = ""             # controller | learner | learner_<idx>
+    process: str = ""             # controller | learner | learner_<idx> |
+                                  # serving | slice | slice_<idx>
     prob: float = 1.0             # firing probability per eligible call
     after_calls: int = 0          # skip the first N matching calls
     max_fires: int = 0            # 0 = unlimited

@@ -88,6 +88,26 @@ class TreeAggregationConfig:
     enabled: bool = False
     branch: int = 8
     workers: int = 0                         # 0 → min(branch, cpu_count)
+    # distributed tier (aggregation/slice.py + distributed.py,
+    # docs/RESILIENCE.md "Distributed slice aggregators"): promote the
+    # branches to driver-booted slice aggregator PROCESSES — each owns a
+    # contiguous cohort slice, receives its learners' uplinks over gRPC,
+    # and ships one partial fold; the controller fans in O(branch)
+    # partials and re-homes a dead aggregator's slice mid-round. false
+    # (default) keeps the in-process tier and the one-attribute-check
+    # hot path.
+    distributed: bool = False
+    # slice endpoints [{name, host, port, spool_dir}]; the driver fills
+    # one per branch when left empty (operators running their own
+    # aggregator fleet list them explicitly)
+    slices: List[Dict[str, Any]] = field(default_factory=list)
+    # spool root for the driver-booted aggregators ("" → <workdir>/slices);
+    # the per-slice spool is what mid-round re-homing recovers from
+    spool_dir: str = ""
+    # bounded submit retry before an unreachable aggregator is declared
+    # dead and its slice re-homes (doubling backoff, PR 8's posture)
+    rehome_retries: int = 3
+    rehome_backoff_s: float = 0.2
 
 
 @dataclass
@@ -649,6 +669,46 @@ class FederationConfig:
             raise ValueError("aggregation.tree.branch must be >= 2")
         if self.aggregation.tree.workers < 0:
             raise ValueError("aggregation.tree.workers must be >= 0")
+        tree = self.aggregation.tree
+        if tree.distributed:
+            if not tree.enabled:
+                # the distributed tier IS the tree tier's topology — a
+                # silently ignored knob would "validate" a fleet that was
+                # never booted (the overprovision/quorum posture)
+                raise ValueError(
+                    "aggregation.tree.distributed requires "
+                    "aggregation.tree.enabled")
+            if self.secure.enabled:
+                raise ValueError(
+                    "aggregation.tree.distributed is incompatible with "
+                    "secure aggregation (slice aggregators fold plaintext "
+                    "trees; masked/HE payloads need the one-combine path)")
+            if self.aggregation.streaming:
+                raise ValueError(
+                    "aggregation.tree.distributed is incompatible with "
+                    "aggregation.streaming (uplinks fold at their slice "
+                    "aggregator, not in the controller's stream)")
+            if self.model_store.ingest_workers > 0:
+                raise ValueError(
+                    "aggregation.tree.distributed is incompatible with "
+                    "model_store.ingest_workers (uplinks bypass the root "
+                    "store entirely; there is nothing to ingest)")
+            if self.aggregation.rule.lower() not in ("fedavg", "scaffold",
+                                                     "fedstride"):
+                # same silently-ignored-knob posture as the checks above:
+                # a rule that cannot slice-fold would boot (and pay for)
+                # a whole aggregator fleet that never receives a byte
+                raise ValueError(
+                    f"aggregation.tree.distributed requires a weighted-"
+                    f"sum rule (fedavg/scaffold/fedstride), not "
+                    f"{self.aggregation.rule!r}")
+            if tree.rehome_retries < 0:
+                raise ValueError(
+                    "aggregation.tree.rehome_retries must be >= 0")
+            if tree.rehome_retries > 0 and tree.rehome_backoff_s <= 0.0:
+                raise ValueError(
+                    "aggregation.tree.rehome_backoff_s must be > 0 when "
+                    "rehome_retries is armed")
         if self.aggregation.streaming and self.secure.enabled:
             # streaming folds plaintext trees on arrival; secure payloads
             # are opaque ciphertext that only the full-cohort combine can

@@ -385,6 +385,29 @@ class Controller:
             from metisfl_tpu.aggregation.tree import TreeReducer
             self._tree = TreeReducer(branch=tree_cfg.branch,
                                      workers=tree_cfg.workers)
+        # (d) distributed slice-aggregation tier (aggregation/
+        # distributed.py): the tree's branches as driver-booted slice
+        # aggregator PROCESSES — uplinks forward to their slice over
+        # gRPC, the root fans in O(branch) partials, and a dead
+        # aggregator's slice re-homes mid-round. None when opted out (or
+        # when the rule cannot slice-fold) — every hot path is then one
+        # attribute check; with it armed the in-process tree above stays
+        # constructed as the fully-degraded fallback.
+        self._slices = None
+        if (tree_cfg is not None and getattr(tree_cfg, "distributed", False)
+                and getattr(tree_cfg, "slices", None)):
+            if (self._aggregator.name in ("fedavg", "scaffold", "fedstride")
+                    and not config.secure.enabled):
+                from metisfl_tpu.aggregation.distributed import (
+                    DistributedSliceReducer,
+                )
+                self._slices = DistributedSliceReducer(
+                    tree_cfg, ssl=config.ssl, comm=config.comm)
+            else:
+                logger.info(
+                    "aggregation.tree.distributed requested but rule=%s "
+                    "cannot slice-fold; using the in-process path",
+                    self._aggregator.name)
 
         # community model state
         self._community_flat: Optional[Dict[str, np.ndarray]] = None
@@ -563,6 +586,10 @@ class Controller:
         self._store.shutdown()
         if self._tree is not None:
             self._tree.shutdown()
+        if self._slices is not None:
+            # clients close; the processes themselves are driver-owned
+            # (the driver ShutDowns + reaps them like learners)
+            self._slices.shutdown()
         if self._registry is not None:
             self._registry.shutdown()
         # Deregister the process-global collector handle if it is still
@@ -721,6 +748,11 @@ class Controller:
                              "its queued writes will be gate-dropped",
                              learner_id)
         self._store.erase([learner_id])
+        if self._slices is not None:
+            # prune the departed learner's held model from its slice
+            # owner + the root residual (best-effort: a dead owner's copy
+            # dies with it, and the fold path skips departed ids anyway)
+            self._slices.forget(learner_id)
         if self._streaming is not None and not self._shutdown.is_set():
             # subtract the departed learner's streamed contribution on
             # the scheduling executor (fold state is single-threaded)
@@ -1017,6 +1049,21 @@ class Controller:
             # exactly like a malformed payload on the store path.
             if not self._stream_fold(result, model, stale):
                 model = None
+        elif model is not None and self._slices is not None:
+            # distributed slice tier (aggregation/distributed.py): the
+            # accepted uplink forwards to its slice aggregator over gRPC
+            # — the root never stores it, so controller memory and store
+            # traffic stay O(branch). submit() never raises and never
+            # drops an accepted uplink: an unreachable owner re-homes
+            # (bounded retry/backoff) and the fold-of-last-resort is the
+            # root's residual buffer.
+            fwd_sp = _ttrace.span("round.slice_submit",
+                                  parent=self._round_span,
+                                  attrs={"learner": result.learner_id})
+            with fwd_sp:
+                self._slices.submit(result.learner_id, model,
+                                    result.round_id)
+            _M_PHASE.observe(fwd_sp.duration_ms / 1e3, phase="slice_submit")
         elif model is not None:
             if self._ingest is not None:
                 # parallel ingest: enqueue and return — the writer pool
@@ -1501,6 +1548,12 @@ class Controller:
             return
         self._agg_failures = 0
         self._empty_deadlines = 0
+        if self._slices is not None:
+            # drop the root's residual fold buffer — its uplinks were
+            # just folded (or superseded); the slice processes keep their
+            # latest-per-learner models exactly like the store keeps
+            # lineage across rounds
+            self._slices.round_complete()
         if self._profile is not None:
             self._profile.note_mark("aggregate_end")
         with self._lock:
@@ -1808,6 +1861,35 @@ class Controller:
                     advisory_scores=self._health.scores())
             else:
                 community = self._aggregator.aggregate(pairs)
+        elif self._slices is not None:
+            # Distributed slice tier (aggregation/distributed.py): fan in
+            # O(branch) FoldPartial replies; a slice aggregator dying
+            # between submit and fold re-homes inside reduce() and the
+            # round completes from its recovered spool. The rule gate ran
+            # at construction (fedavg/scaffold/fedstride only).
+            if self._aggregator.name == "fedstride":
+                self._aggregator.reset()  # round-scoped state unused here
+            slice_sp = _ttrace.span(
+                "round.slice_reduce", parent=agg_sp,
+                attrs={"cohort": len(ids)})
+            with slice_sp:
+                reduced = self._slices.reduce(
+                    ids, scales,
+                    stride=self.config.aggregation.stride_length,
+                    round_id=self.global_iteration)
+            if reduced is None:
+                logger.warning("no held slice models for cohort %s",
+                               list(selected))
+                return
+            community, partials, slice_errors = reduced
+            for partial in partials:
+                meta_blocks.append(partial.count)
+                meta_durations.append(round(partial.duration_ms, 3))
+                _M_PHASE.observe(partial.duration_ms / 1e3,
+                                 phase="aggregate_block")
+            if slice_errors:
+                with self._lock:
+                    self._current_meta.errors.extend(slice_errors)
         elif (self._tree is not None
               and self._aggregator.name in ("fedavg", "scaffold",
                                             "fedstride")):
@@ -2097,6 +2179,13 @@ class Controller:
                 # even with round_deadline_secs=0 (no deadline to arm).
                 self._dispatch_retries_used = 0
                 self._round_serial += 1
+            if self._slices is not None:
+                # distributed slice tier: partition the fresh round's
+                # cohort into contiguous slices over the live aggregators
+                # (and revive any the driver has relaunched). Rejoin /
+                # replacement single-learner dispatches keep the round's
+                # map — their uplinks route by it (unknowns go to root).
+                self._slices.assign(list(learner_ids))
         # The dispatched set is the synchronous round barrier (participation
         # sampling means it can be a strict subset of the active learners).
         self._scheduler.notify_dispatched(list(learner_ids))
@@ -2969,6 +3058,10 @@ class Controller:
             snapshot["ingest"] = {"workers": self._ingest.workers,
                                   "queue_depth": self._ingest.queue_depth(),
                                   "errors": errors}
+        if self._slices is not None:
+            # distributed slice tier: per-aggregator liveness/re-home
+            # state + the O(branch) merged uplink-byte rollup
+            snapshot["slices"] = self._slices.describe()
         if self._streaming is not None:
             snapshot["streaming"] = self._streaming.stats()
         if self._health is not None:

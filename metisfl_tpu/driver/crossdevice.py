@@ -123,6 +123,18 @@ class ChurnScenario:
     # land while its round is still open, not race a 50 ms quorum
     # release. Applied in churn AND control (same wall-clock shape).
     alert_round1_delay_s: float = 0.15
+    # distributed slice aggregators (aggregation/slice.py): >0 boots this
+    # many REAL slice aggregator subprocesses over gRPC and runs the
+    # federation with aggregation.tree.distributed — the slice-kill
+    # chaos gate (scripts/chaos_smoke.sh) runs 3 of them
+    slices: int = 0
+    # SIGKILL one aggregator mid-round (while round `slice_kill_round+1`
+    # is waiting on uplinks): the round must complete via re-homing and
+    # the community model must match the same-seed no-kill control
+    # bit-for-bit (sorted-id fold order makes the bits a pure function
+    # of the contributor set; aggregation/distributed.py)
+    slice_kill: bool = False
+    slice_kill_round: int = 1
     # simulation plumbing
     workers: int = 8
     timeout_s: float = 120.0
@@ -207,13 +219,21 @@ class CrossDeviceHarness:
                 "for_s": 0.0,
                 "severity": "warning",
             }]
+        self._slice_procs: List[Any] = []
+        self._slice_tmp = ""
+        self._slice_killed = False
+        tree_cfg = None
+        if s.slices > 0:
+            tree_cfg = self._boot_slices()
+        agg_kwargs = {"tree": tree_cfg} if tree_cfg is not None else {}
         self.config = FederationConfig(
             protocol=protocol,
             scheduling=sched,
             round_deadline_secs=s.round_deadline_secs,
             aggregation=AggregationConfig(
                 rule="fedavg", scaler="participants",
-                staleness_decay=0.5 if s.buffer_size > 0 else 0.0),
+                staleness_decay=0.5 if s.buffer_size > 0 else 0.0,
+                **agg_kwargs),
             eval=EvalConfig(every_n_rounds=0),
             # the harness measures scheduling, not observability: the
             # health/profile planes stay off so a 1024-client round costs
@@ -245,6 +265,83 @@ class CrossDeviceHarness:
         self._truth = np.random.default_rng(s.seed).standard_normal(
             (s.dim, s.classes)).astype(np.float32)
         self.faults = {"dropped": 0, "flapped": 0, "partitioned": 0}
+
+    # -- distributed slice aggregators (aggregation/slice.py) -------------
+
+    def _boot_slices(self):
+        """Boot ``scenario.slices`` REAL aggregator subprocesses (their
+        own interpreters, real gRPC, SIGKILL-able) and return the
+        ``aggregation.tree`` config pointing the controller at them."""
+        import os
+        import socket
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        from metisfl_tpu.aggregation.slice import SLICE_SERVICE
+        from metisfl_tpu.comm.health import probe_health
+        from metisfl_tpu.config import TreeAggregationConfig
+
+        s = self.scenario
+        self._slice_tmp = tempfile.mkdtemp(prefix="metisfl_slices_")
+        specs = []
+        try:
+            for i in range(s.slices):
+                with socket.socket() as sock:
+                    sock.bind(("127.0.0.1", 0))
+                    port = sock.getsockname()[1]
+                spool = os.path.join(self._slice_tmp, f"slice_{i}")
+                specs.append({"name": f"slice_{i}", "host": "127.0.0.1",
+                              "port": port, "spool_dir": spool})
+                self._slice_procs.append(subprocess.Popen(
+                    [_sys.executable, "-m",
+                     "metisfl_tpu.aggregation.slice",
+                     "--host", "127.0.0.1", "--port", str(port),
+                     "--spool-dir", spool, "--name", f"slice_{i}"],
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            deadline = time.time() + 60.0
+            pending = list(specs)
+            while pending and time.time() < deadline:
+                pending = [spec for spec in pending
+                           if probe_health(spec["host"], spec["port"],
+                                           SLICE_SERVICE) != "SERVING"]
+                if pending:
+                    time.sleep(0.2)
+            if pending:
+                raise RuntimeError(f"slice aggregators never came up: "
+                                   f"{[p['name'] for p in pending]}")
+        except BaseException:
+            # a failed boot must not orphan the processes that DID start
+            # (run()'s cleanup only covers a constructed harness)
+            self._stop_slices()
+            raise
+        return TreeAggregationConfig(
+            enabled=True, branch=s.slices, distributed=True, slices=specs,
+            rehome_retries=2, rehome_backoff_s=0.05)
+
+    def _maybe_kill_slice(self) -> None:
+        """The chaos trigger: SIGKILL aggregator 0 while the target round
+        is mid-flight (uplinks in the air, barrier open)."""
+        s = self.scenario
+        if (not s.slice_kill or self._slice_killed or not self._slice_procs
+                or self.controller.global_iteration < s.slice_kill_round
+                or self.controller._phase != "wait_uplinks"):
+            return
+        self._slice_killed = True
+        self._slice_procs[0].kill()
+        logger.warning("chaos: SIGKILLed slice aggregator 0 mid-round %d",
+                       s.slice_kill_round)
+
+    def _stop_slices(self) -> None:
+        for proc in self._slice_procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self._slice_procs:
+            try:
+                proc.wait(timeout=5.0)
+            except Exception:  # noqa: BLE001 - unkillable: leave to reaper
+                proc.kill()
 
     # -- data ------------------------------------------------------------
 
@@ -350,6 +447,13 @@ class CrossDeviceHarness:
                 # hold round 1 open past the retry backoff (see
                 # alert_round1_delay_s) — identical in churn + control
                 time.sleep(s.alert_round1_delay_s)
+            if s.slices > 0 and task.round_id == s.slice_kill_round:
+                # slice-kill determinism: hold the target round's barrier
+                # open long enough that the SIGKILL provably lands
+                # MID-round (uplinks still in the air). Applied in the
+                # kill AND control runs — identical wall-clock shape,
+                # and wall timing cannot move the bits (sorted-id folds)
+                time.sleep(0.02)
             self.controller.task_completed(TaskResult(
                 task_id=task.task_id, learner_id=learner_id,
                 auth_token=token, round_id=task.round_id,
@@ -459,6 +563,7 @@ class CrossDeviceHarness:
                     if self.controller._phase == "halted":
                         halted = True
                         break
+                    self._maybe_kill_slice()
                     time.sleep(0.01)
                 if halted or self.controller.global_iteration < target:
                     break
@@ -469,7 +574,20 @@ class CrossDeviceHarness:
             acc = self.accuracy()
             alerts_out = self._settle_alerts()
             telemetry_out = self._telemetry_stats()
+            slices_out = None
+            if self.scenario.slices > 0:
+                import hashlib
+                raw = self.controller.community_model_bytes() or b""
+                tier = self.controller._slices
+                slices_out = {
+                    "slices": self.scenario.slices,
+                    "killed": self._slice_killed,
+                    "rehomed_total": tier.rehomed_total if tier else 0,
+                    "describe": tier.describe() if tier else {},
+                    "model_sha256": hashlib.sha256(raw).hexdigest(),
+                }
             self.controller.shutdown()
+            self._stop_slices()
             self._pool.shutdown(wait=True)
         rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         reporters = [len(m.get("train_received_at", {})) for m in metas]
@@ -477,6 +595,7 @@ class CrossDeviceHarness:
             **({"alerts": alerts_out} if alerts_out is not None else {}),
             **({"telemetry": telemetry_out}
                if telemetry_out is not None else {}),
+            **({"slices": slices_out} if slices_out is not None else {}),
             "clients": s.clients,
             "protocol": self.config.protocol,
             "quorum": 0 if s.buffer_size else s.quorum,
@@ -501,6 +620,37 @@ class CrossDeviceHarness:
 
 def run_scenario(scenario: ChurnScenario) -> Dict[str, Any]:
     return CrossDeviceHarness(scenario).run()
+
+
+def run_slice_smoke(clients: int = 24, rounds: int = 3, slices: int = 3,
+                    seed: int = 7, timeout_s: float = 120.0
+                    ) -> Dict[str, Any]:
+    """The slice-kill chaos gate (ISSUE 12; scripts/chaos_smoke.sh):
+    ``slices`` real aggregator subprocesses over gRPC, full-barrier
+    rounds with zero churn faults, one aggregator SIGKILLed mid-round —
+    versus the same-seed undisturbed control. Passes iff the kill run
+    completes every round without operator action, ``slice_rehomed``
+    fired exactly as designed (>=1 in the kill run, 0 in the control),
+    and the two community models are BIT-IDENTICAL (the distributed
+    tier's sorted-id fold order makes the bits a pure function of the
+    contributor set, which the spool recovery preserves)."""
+    base = ChurnScenario(
+        seed=seed, clients=clients, rounds=rounds, slices=slices,
+        quorum=0, overprovision=0.0, dropout=0.0, flappers=0,
+        partitioned=0, dispatch_retries=0, quarantine_score=0.0,
+        round_deadline_secs=30.0, timeout_s=timeout_s)
+    kill = run_scenario(dataclasses.replace(base, slice_kill=True))
+    control = run_scenario(base)
+    ks, cs = kill.get("slices") or {}, control.get("slices") or {}
+    bit_identical = (bool(ks.get("model_sha256"))
+                     and ks.get("model_sha256") == cs.get("model_sha256"))
+    ok = (kill["ok"] and control["ok"]
+          and bool(ks.get("killed"))
+          and int(ks.get("rehomed_total", 0)) >= 1
+          and int(cs.get("rehomed_total", 0)) == 0
+          and bit_identical)
+    return {"kill": kill, "control": control,
+            "bit_identical": bit_identical, "ok": ok}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -530,7 +680,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="arm the dispatch-retry rate alert and FAIL "
                              "unless it fires and resolves under churn "
                              "while staying silent in the control run")
+    parser.add_argument("--slice-smoke", action="store_true",
+                        help="run the slice-kill chaos gate instead: real "
+                             "slice aggregator subprocesses, one SIGKILLed "
+                             "mid-round; FAIL unless the round completes "
+                             "via re-homing and the community model is "
+                             "bit-identical to the no-kill control")
+    parser.add_argument("--slices", type=int, default=3,
+                        help="aggregator subprocess count for --slice-smoke")
     args = parser.parse_args(argv)
+
+    if args.slice_smoke:
+        out = run_slice_smoke(clients=min(args.clients, 24),
+                              rounds=min(args.rounds, 3),
+                              slices=args.slices, seed=args.seed,
+                              timeout_s=args.timeout)
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
 
     scenario = ChurnScenario(
         seed=args.seed, clients=args.clients, rounds=args.rounds,

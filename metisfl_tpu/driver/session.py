@@ -200,6 +200,14 @@ class DriverSession:
         # chaos arms ORIGINAL incarnations only (see _chaos_env): learner
         # indices that already got their armed launch
         self._chaos_armed_learners: set = set()
+        self._chaos_armed_slices: set = set()
+        # slice-aggregator supervision (stateless-ish relaunch: the spool
+        # persists on disk and the controller re-adopts a relaunched
+        # aggregator at its next round's assign). PER-SLICE counters and
+        # backoff windows — one crash-looping aggregator must not delay
+        # another's relaunch
+        self._slice_restarts: Dict[int, int] = {}
+        self._slice_restart_after: Dict[int, float] = {}
         # fleet telemetry fabric (telemetry/fabric.py): live cross-process
         # collection during the run — constructed at initialize, None when
         # telemetry.fabric is opted out
@@ -358,10 +366,50 @@ class DriverSession:
                 s.bind(("127.0.0.1", 0))
                 self.config.serving.port = s.getsockname()[1]
 
+        # distributed slice aggregators (aggregation/slice.py): pin their
+        # endpoints + spool dirs BEFORE the config write — the config
+        # file ships to the slice processes AND tells the controller
+        # where to dial, so nothing here may stay ephemeral
+        tree = self.config.aggregation.tree
+        if tree.enabled and tree.distributed and not tree.slices:
+            if (self.config.controller_host or
+                    "localhost") not in self._LOCAL_HOSTS:
+                # same guard as serving/coordinator ports: a port probed
+                # on the driver machine says nothing about a remote host
+                # — remote aggregator fleets list tree.slices explicitly
+                raise ValueError(
+                    "aggregation.tree.distributed on remote host "
+                    f"{self.config.controller_host!r} requires explicit "
+                    "aggregation.tree.slices endpoints")
+            import socket as _socket
+            spool_root = tree.spool_dir or os.path.join(self.workdir,
+                                                        "slices")
+            tree.spool_dir = spool_root
+            for idx in range(tree.branch):
+                with _socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    port = s.getsockname()[1]
+                tree.slices.append({
+                    "name": f"slice_{idx}", "host": "localhost",
+                    "port": port,
+                    "spool_dir": os.path.join(spool_root, f"slice_{idx}")})
+        if tree.enabled and tree.distributed:
+            for spec in tree.slices:
+                if spec.get("spool_dir"):
+                    os.makedirs(spec["spool_dir"], exist_ok=True)
+
         config_path = os.path.join(self.workdir, "federation_config.bin")
         with open(config_path, "wb") as f:
             f.write(self.config.to_wire())
         self._config_path = config_path
+
+        if tree.enabled and tree.distributed:
+            # the aggregator fleet boots before the controller so round
+            # 1's first uplink never races a half-up slice (a dead slice
+            # would still re-home, but the clean path should be clean)
+            for idx in range(len(tree.slices)):
+                self._launch_slice(idx)
+            self._wait_slices_healthy()
 
         ctrl_host = self.config.controller_host or "localhost"
         self._launch_controller(resume=self.resume)
@@ -422,6 +470,17 @@ class DriverSession:
                           "port": self.config.serving.port,
                           "service_name": SERVING_SERVICE,
                           "role": "serving"})
+        tree = self.config.aggregation.tree
+        if tree.enabled and tree.distributed:
+            from metisfl_tpu.aggregation.slice import SLICE_SERVICE
+            for spec in tree.slices:
+                if spec.get("port"):
+                    specs.append({"name": spec.get("name") or
+                                  f"{spec['host']}:{spec['port']}",
+                                  "host": spec.get("host", "localhost"),
+                                  "port": spec["port"],
+                                  "service_name": SLICE_SERVICE,
+                                  "role": "slice"})
         return specs
 
     def _start_fleet_collector(self) -> None:
@@ -585,6 +644,79 @@ class DriverSession:
         proc = launcher.launch("serving", argv, env=env)
         self._procs.append(proc)
         return proc
+
+    def _launch_slice(self, idx: int) -> _Proc:
+        """(Re)launch slice aggregator ``idx`` (aggregation/slice.py). It
+        needs no state handoff: its spool directory persists on disk and
+        the controller re-adopts a relaunched aggregator at the next
+        round's slice assignment (health-probe revival)."""
+        launcher = self._launcher_for(self.config.controller_host or
+                                      "localhost")
+        name = f"slice_{idx}"
+        argv = [getattr(launcher, "python", sys.executable),
+                "-m", "metisfl_tpu.aggregation.slice",
+                "--config", self._config_path,
+                "--index", str(idx)]
+        if isinstance(launcher, SSHLauncher):
+            launcher.ship([self._config_path] + self._ssl_files())
+        env = dict(self._base_env())
+        if idx not in self._chaos_armed_slices:
+            # original incarnation only: kill-at-slice rules
+            # (process="slice" / "slice_<idx>") must not re-fire on the
+            # supervised relaunch, or re-homing could never converge
+            self._chaos_armed_slices.add(idx)
+            env.update(self._chaos_env("slice", idx))
+        self._procs = [p for p in self._procs if p.name != name]
+        proc = launcher.launch(name, argv, env=env)
+        self._procs.append(proc)
+        return proc
+
+    def _wait_slices_healthy(self, retries: int = 30,
+                             sleep_s: float = 0.5) -> None:
+        from metisfl_tpu.aggregation.slice import SLICE_SERVICE
+        from metisfl_tpu.comm.health import probe_health
+
+        pending = list(self.config.aggregation.tree.slices)
+        for _ in range(retries):
+            pending = [
+                spec for spec in pending
+                if probe_health(spec["host"], spec["port"], SLICE_SERVICE,
+                                ssl=self.config.ssl) != "SERVING"]
+            if not pending:
+                return
+            self._check_procs_alive()
+            time.sleep(sleep_s)
+        raise RuntimeError(
+            f"slice aggregator(s) never became healthy: "
+            f"{[s.get('name') for s in pending]}")
+
+    def _supervise_slices(self) -> bool:
+        """Slice-aggregator crash failover: a dead aggregator process is
+        relaunched (backoff-bounded like the gateway). The federation
+        does NOT wait for it — the controller already re-homed its slice
+        mid-round; the relaunch rejoins the tier at a later round's
+        assignment. Returns True when a relaunch happened this call."""
+        tree = self.config.aggregation.tree
+        if not (tree.enabled and tree.distributed) or self._shutting_down:
+            return False
+        restarted = False
+        for idx in range(len(tree.slices)):
+            proc = next((p for p in self._procs
+                         if p.name == f"slice_{idx}"), None)
+            if proc is None or proc.process.poll() is None:
+                continue
+            if time.time() < self._slice_restart_after.get(idx, 0.0):
+                continue  # this slice's backoff window: relaunch later
+            code = proc.process.poll()
+            restarts = self._slice_restarts.get(idx, 0) + 1
+            self._slice_restarts[idx] = restarts
+            self._slice_restart_after[idx] = time.time() + min(
+                30.0, 0.5 * (2 ** (restarts - 1)))
+            logger.warning("slice aggregator %d died (exit %s); "
+                           "supervised relaunch %d", idx, code, restarts)
+            self._launch_slice(idx)
+            restarted = True
+        return restarted
 
     def _supervise_gateway(self) -> bool:
         """Serving-gateway crash failover: a dead gateway is relaunched
@@ -752,11 +884,19 @@ class DriverSession:
             # an instant abort that bypasses the restart budget.
             self._supervise_controller()
             self._supervise_gateway()
+            self._supervise_slices()
             skip = (("controller",)
                     if self.config.failover.supervise_controller else ())
             if self.config.serving.enabled:
                 # the gateway is always supervised (stateless relaunch)
                 skip = tuple(skip) + ("serving",)
+            tree = self.config.aggregation.tree
+            if tree.enabled and tree.distributed:
+                # slice aggregators are chaos-killable BY DESIGN: a death
+                # re-homes mid-round and the supervisor relaunches — it
+                # must never fail the run
+                skip = tuple(skip) + tuple(
+                    f"slice_{i}" for i in range(len(tree.slices)))
             self._check_procs_alive(skip=skip)
             # poll the tail-bounded lineage RPCs — a long-running federation
             # must not ship its full history every 2 s (the unbounded
@@ -1091,6 +1231,22 @@ class DriverSession:
                 client.close()
             except Exception:  # noqa: BLE001 - learner may already be gone
                 pass
+        tree = self.config.aggregation.tree
+        if tree.enabled and tree.distributed:
+            # slice aggregators get the same fail-fast ShutDown as
+            # learners (a chaos-killed one is simply already gone)
+            from metisfl_tpu.aggregation.slice import SLICE_SERVICE
+            for spec in tree.slices:
+                if not spec.get("port"):
+                    continue
+                try:
+                    sc = RpcClient(spec.get("host", "localhost"),
+                                   spec["port"], SLICE_SERVICE,
+                                   retries=0, ssl=self.config.ssl)
+                    sc.call("ShutDown", b"", timeout=5.0, wait_ready=False)
+                    sc.close()
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
         if self.config.serving.enabled and self.config.serving.port:
             # fail-fast like the learner loop above: a dead gateway must
             # not park shutdown in the transport's default deadline

@@ -109,6 +109,25 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
         if quarantined:
             cells.append(f"QUARANTINED={','.join(quarantined)}")
         lines.append("scheduling: " + "  ".join(cells))
+    slices = snap.get("slices") or {}
+    if slices.get("enabled"):
+        # distributed slice-aggregation tier (aggregation/distributed.py);
+        # controllers without it ship no "slices" key and render as before
+        cells = []
+        for row in slices.get("slices", []):
+            state = ("DEAD→" + row["rehomed_to"] if row.get("rehomed_to")
+                     else ("DEAD" if row.get("dead") else "up"))
+            cells.append(f"{row.get('name', '?')}={state}"
+                         f"({row.get('held', 0)})")
+        rollup = slices.get("uplink_bytes") or {}
+        rollup_cell = (f"  uplink_p50={rollup.get('p50', 0):g}B"
+                       f" p99={rollup.get('p99', 0):g}B" if rollup else "")
+        lines.append(
+            f"slices: {slices.get('alive', 0)}/"
+            f"{len(slices.get('slices', []))} up  "
+            f"rehomed={slices.get('rehomed_total', 0)}  "
+            f"root_residual={slices.get('root_residual', 0)}  "
+            + "  ".join(cells) + rollup_cell)
     alerts = snap.get("alerts") or {}
     if alerts.get("enabled"):
         # SLO alerting plane (telemetry/alerts.py); controllers without

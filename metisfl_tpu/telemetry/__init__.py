@@ -149,6 +149,11 @@ M_ALERTS_FIRED_TOTAL = alerts.ALERTS_FIRED_TOTAL
 M_FABRIC_COLLECTIONS_TOTAL = "fabric_collections_total"
 M_FABRIC_PEER_OFFSET_MS = "fabric_peer_clock_offset_ms"
 M_FABRIC_COLLECT_SECONDS = "fabric_collect_duration_seconds"
+# distributed slice aggregators (aggregation/slice.py + distributed.py)
+M_SLICE_UPLINKS_TOTAL = "slice_uplinks_total"
+M_SLICE_HELD_MODELS = "slice_held_models"
+M_SLICE_FAILURES_TOTAL = "slice_failures_total"
+M_SLICE_REHOMING_SECONDS = "slice_rehoming_seconds"
 # serving gateway (serving/gateway.py)
 M_SERVING_REQUESTS_TOTAL = "serving_requests_total"
 M_SERVING_REQUEST_LATENCY_SECONDS = "serving_request_latency_seconds"

@@ -281,6 +281,33 @@ class FabricPeerRecovered:
     epoch_changed: bool = False
 
 
+@dataclass(frozen=True)
+class SliceAggregatorLost:
+    """A slice aggregator process stopped answering (consecutive RPC
+    failures confirmed by a grpc.health.v1 probe); its cohort slice is
+    about to re-home (aggregation/distributed.py)."""
+
+    kind: ClassVar[str] = "slice_aggregator_lost"
+    slice: str
+    failures: int = 0
+
+
+@dataclass(frozen=True)
+class SliceRehomed:
+    """A dead slice aggregator's cohort slice re-homed mid-round: its
+    spooled uplinks were recovered and its learners re-pointed at a
+    surviving aggregator (``target=<slice>``) or folded directly at the
+    root (``target="root"``) — the round completes without it."""
+
+    kind: ClassVar[str] = "slice_rehomed"
+    slice: str
+    target: str
+    round: int = 0
+    recovered: int = 0
+    lost: int = 0
+    reason: str = ""
+
+
 EVENT_TYPES: Dict[str, type] = {
     cls.kind: cls
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
@@ -289,7 +316,8 @@ EVENT_TYPES: Dict[str, type] = {
                 RoundHealth, LearnerQuarantined, DispatchRetried,
                 RoundHalted, VersionRegistered, VersionPromoted,
                 VersionRolledBack, ServingSwapped, AlertFiring,
-                AlertResolved, FabricPeerStale, FabricPeerRecovered)
+                AlertResolved, FabricPeerStale, FabricPeerRecovered,
+                SliceAggregatorLost, SliceRehomed)
 }
 
 

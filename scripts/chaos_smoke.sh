@@ -51,6 +51,28 @@ case "$rc" in
 esac
 [ "$rc" -eq 0 ] || exit "$rc"
 
+# ISSUE 12 slice-kill gate (docs/RESILIENCE.md "Distributed slice
+# aggregators"): three real slice-aggregator subprocesses over gRPC, one
+# SIGKILLed mid-round. The build fails unless the round completes
+# without operator action, slice_rehomed fires (and stays silent in the
+# control), and the community model is BIT-IDENTICAL to the same-seed
+# undisturbed run.
+JAX_PLATFORMS=cpu timeout -k 10 180 "$PYTHON" -m metisfl_tpu.driver.crossdevice \
+  --slice-smoke --slices 3 --seed 7
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: slice-kill PASS (aggregator killed mid-round," \
+          "slice re-homed, round completed, community model bit-identical" \
+          "to the no-kill control)" ;;
+  1) echo "chaos_smoke: slice-kill FAIL — re-homing did not complete the" \
+          "round or the community model diverged from the control (see" \
+          "JSON above)" >&2 ;;
+  *) echo "chaos_smoke: slice-kill FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
 # ISSUE 11 fleet-tail gate (docs/OBSERVABILITY.md "Fleet fabric"): a
 # three-peer real-gRPC fleet with one flapping learner — the collector
 # must keep assembling the merged view while the peer is down (stale
