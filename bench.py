@@ -20,9 +20,13 @@ accelerator between sections across the WHOLE bench window (round-4 change:
 round 3's wedged-at-start tunnel turned a recoverable outage into a CPU-only
 run). Sections run headline-first (aggregation @64, LM MFU before anything
 that could wedge), each in a killable child streaming partial JSON; the
-parent additionally persists cumulative partials to ``bench_partial.json``
-after every section, so even a SIGKILL preserves on-chip numbers. Every
-section failure lands in ``details.errors`` instead of killing the run.
+parent additionally persists cumulative partials to
+``bench_results/bench_partial.json`` after every section, so even a
+SIGKILL preserves on-chip numbers. Every section failure lands in
+``details.errors`` instead of killing the run. Host sections whose ms
+keys land under the repeat threshold are re-measured median-of-K
+(``METISFL_BENCH_REPEATS`` / ``METISFL_BENCH_REPEAT_MS``) so the 20%
+regression gate judges medians, not single shots, on noisy hosts.
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ import json
 import os
 import platform as platform_mod
 import resource
+import statistics
 import subprocess
 import sys
 import time
@@ -1400,6 +1405,67 @@ def bench_lora(require_tpu: bool = True):
 # process with a kill-on-timeout: the parent never touches the device, stays
 # interruptible, and always emits the JSON line.
 
+def bench_prof(trials=5, acquire_iters=200_000, sample_iters=300):
+    """Continuous-profiling section (ISSUE 13; docs/OBSERVABILITY.md
+    "Continuous profiling"): the profiler's own cost, measured — the
+    bench round loop (stride-blocked stacked scaled adds under a lock)
+    with the sampler + instrumented locks ON vs OFF (interleaved
+    median-of-``trials``), the per-tick stack-fold cost, and the
+    uncontended acquire cost of a raw vs instrumented lock. Host-side
+    and self-contained. ``prof_overhead_pct`` is informational (a ratio
+    of two noisy medians; the chaos_smoke prof gate bounds it
+    absolutely); the ms/ns keys are direction-classified for
+    ``python -m metisfl_tpu.perf --trajectory``."""
+    import threading as _threading
+
+    from metisfl_tpu.telemetry import prof as tprof
+
+    tprof.reset()
+    tprof._smoke_round_loop(_threading.Lock())  # warm-up (allocator, jit-free)
+    off_s, on_s = [], []
+    for _ in range(trials):
+        tprof.configure(enabled=False)
+        off_s.append(tprof._smoke_round_loop(tprof.lock("bench.prof")))
+        tprof.configure(enabled=True)  # default 67 Hz / 512 budget
+        on_s.append(tprof._smoke_round_loop(tprof.lock("bench.prof")))
+    state = tprof.collect_state()
+    # per-tick fold cost (all threads walked + folded, synchronously)
+    t0 = time.perf_counter()
+    for _ in range(sample_iters):
+        tprof.sample_once()
+    sample_ms = (time.perf_counter() - t0) / sample_iters * 1e3
+    tprof.configure(enabled=False)
+
+    def _acquire_ns(lk):
+        t0 = time.perf_counter()
+        for _ in range(acquire_iters):
+            lk.acquire()
+            lk.release()
+        return (time.perf_counter() - t0) / acquire_iters * 1e9
+
+    plain_ns = _acquire_ns(_threading.Lock())
+    tprof.configure(enabled=True)
+    timed = tprof.lock("bench.prof.acquire")
+    tprof.configure(enabled=False)
+    timed_ns = _acquire_ns(timed)
+    tprof.reset()
+    tprof.configure(enabled=False)
+    off_ms = statistics.median(off_s) * 1e3
+    on_ms = statistics.median(on_s) * 1e3
+    return {
+        "prof_round_ms_off": round(off_ms, 2),
+        "prof_round_ms_on": round(on_ms, 2),
+        "prof_overhead_pct": round(
+            100.0 * (on_ms - off_ms) / off_ms, 2) if off_ms else 0.0,
+        "prof_sample_ms": round(sample_ms, 4),
+        "prof_acquire_ns_plain": round(plain_ns, 1),
+        "prof_acquire_ns_timed": round(timed_ns, 1),
+        "prof_samples": int(state.get("samples", 0)),
+        "prof_stacks_tracked": len(tprof.folded_counts(state)),
+        "prof_hz": state.get("hz", 0.0),
+    }
+
+
 _SECTIONS = {
     "train": lambda a: bench_train_step(),
     "ckks": lambda a: bench_secure_ckks(),
@@ -1414,6 +1480,7 @@ _SECTIONS = {
     "churn": lambda a: bench_churn(),
     "obs": lambda a: bench_obs(),
     "fabric": lambda a: bench_fabric(),
+    "prof": lambda a: bench_prof(),
     "tree_dist": lambda a: bench_tree_dist(),
     "lora": lambda a: bench_lora(),
 }
@@ -1641,7 +1708,8 @@ _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "mfu": 1500, "flash": 900, "decode": 600,
                      "e2e": 600, "cohort": 1200, "health": 240,
                      "serving": 300, "churn": 240, "obs": 240,
-                     "fabric": 240, "tree_dist": 300, "lora": 600}
+                     "fabric": 240, "prof": 240, "tree_dist": 300,
+                     "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1689,15 +1757,30 @@ _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
 _HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
-                  "obs", "fabric", "tree_dist")
-_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_partial.json")
+                  "obs", "fabric", "prof", "tree_dist")
+def _default_partial_path() -> str:
+    """Where the crash-durable partials land by default:
+    ``bench_results/`` — NOT the repo root. Three separate rounds shipped
+    with a stray ``bench_partial.json`` at the root because every direct
+    ``python bench.py`` run (the BENCH_r* captures) wrote its partials
+    next to this file; only scripts/tpu_watch.py redirected the path.
+    The writer now stays out of the root at the SOURCE, and the
+    gitignore patterns remain as belt-and-braces (the regression test in
+    tests/test_slice.py executes this exact writer path)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_results", "bench_partial.json")
+
+
+_PARTIAL_PATH = _default_partial_path()
 
 
 def _persist_partials(details: dict, errors: dict) -> None:
     """Cumulative on-disk snapshot after every section: even a SIGKILL of
     this parent (nothing catchable) leaves everything measured so far."""
     try:
+        parent = os.path.dirname(_PARTIAL_PATH)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         tmp = _PARTIAL_PATH + ".tmp"
         with open(tmp, "w") as fh:
             json.dump({"details": details, "errors": errors,
@@ -1705,6 +1788,64 @@ def _persist_partials(details: dict, errors: dict) -> None:
         os.replace(tmp, _PARTIAL_PATH)
     except OSError:
         pass
+
+
+# Bench noise floor (ISSUE 13 satellite): ms-scale keys on gVisor-class
+# hosts exceed the 20% regression gate run-to-run (the r06→r07
+# obs_expose_ms_10k_exact flag was pure noise). Host sections whose keys
+# land under the threshold are re-run K-1 more times and those keys
+# report the per-key MEDIAN; the capture records {key: K} in
+# details["repeats"] so `perf --compare` can mark gated medians (xK).
+_REPEAT_DEFAULT_K = 3
+_REPEAT_MS_THRESHOLD = 50.0
+
+
+def _repeat_config():
+    try:
+        k = int(os.environ.get("METISFL_BENCH_REPEATS", "")
+                or _REPEAT_DEFAULT_K)
+    except ValueError:
+        k = _REPEAT_DEFAULT_K
+    try:
+        thr = float(os.environ.get("METISFL_BENCH_REPEAT_MS", "")
+                    or _REPEAT_MS_THRESHOLD)
+    except ValueError:
+        thr = _REPEAT_MS_THRESHOLD
+    return max(1, k), thr
+
+
+def _repeat_noisy_keys(name: str, first: dict, quick: bool, details: dict,
+                       info: dict) -> None:
+    """Median-of-K for a host section's sub-threshold ms keys: re-run the
+    section's child up to K-1 more times and replace each noisy key with
+    the median of its samples. A failing repeat run only costs its own
+    samples (its errors are discarded — the first, recorded pass stands);
+    device sections never repeat (chip time is the scarce resource)."""
+    k, thr = _repeat_config()
+    if k < 2:
+        return
+    keys = [key for key, value in first.items()
+            if isinstance(value, (int, float))
+            and not isinstance(value, bool)
+            and "_ms" in key and 0.0 < float(value) <= thr]
+    if not keys:
+        return
+    samples = {key: [float(first[key])] for key in keys}
+    for _ in range(k - 1):
+        rerun_errors: dict = {}
+        out = _run_section(name, quick, _SECTION_TIMEOUTS[name],
+                           rerun_errors, info)
+        for key in keys:
+            value = out.get(key)
+            if isinstance(value, (int, float)) \
+                    and not isinstance(value, bool):
+                samples[key].append(float(value))
+    repeats = details.setdefault("repeats", {})
+    for key in keys:
+        if len(samples[key]) < 2:
+            continue  # repeats failed to re-measure it: single shot stands
+        details[key] = round(statistics.median(samples[key]), 4)
+        repeats[key] = len(samples[key])
 
 
 def _run_and_record(name: str, quick: bool, details: dict, errors: dict,
@@ -1733,6 +1874,9 @@ def _run_and_record(name: str, quick: bool, details: dict, errors: dict,
             # sections ran on CPU and later ones on chip
             details[f"{name}_backend"] = out["backend"]
         details.update(out)
+        if name in _HOST_SECTIONS and name not in errors:
+            # noise floor: sub-threshold ms keys re-measure median-of-K
+            _repeat_noisy_keys(name, out, quick, details, info)
     _persist_partials(details, errors)
 
 

@@ -71,6 +71,7 @@ from metisfl_tpu.aggregation.tree import (
 from metisfl_tpu.aggregation.base import np_finalize
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import prof as _prof
 from metisfl_tpu.telemetry.sketch import QuantileDigest, SpaceSaving
 from metisfl_tpu.tensor.pytree import ModelBlob
 
@@ -128,7 +129,9 @@ class DistributedSliceReducer:
             raise ValueError(
                 "aggregation.tree.distributed requires configured slice "
                 "endpoints (the driver fills aggregation.tree.slices)")
-        self._lock = threading.Lock()
+        # instrumented (telemetry/prof.py): uplink forwarding and
+        # re-home bookkeeping serialize here
+        self._lock = _prof.lock("aggregation.slice_reducer")
         # learner_id -> owner index (ROOT = fold at the root)
         self._owner: Dict[str, int] = {}
         # root residual buffer: {learner_id: (round, model tree)} — the
@@ -138,7 +141,7 @@ class DistributedSliceReducer:
         # serializes re-homes AND lets a submit that lost its retry race
         # wait for an in-flight re-home before parking at the root (the
         # redirect usually lands while the spool recovery runs)
-        self._rehome_lock = threading.Lock()
+        self._rehome_lock = _prof.lock("aggregation.rehome")
         self._shutdown = False
         self.rehomed_total = 0
 

@@ -49,6 +49,7 @@ from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.aggregation.tree import _DEFAULT_SUBBLOCK, TreeReducer
 from metisfl_tpu.comm.codec import dumps, loads
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import prof as _prof
 from metisfl_tpu.telemetry.sketch import QuantileDigest, SpaceSaving
 from metisfl_tpu.tensor.pytree import ModelBlob
 
@@ -120,7 +121,9 @@ class SliceAggregator:
         self.spool_dir = spool_dir
         if spool_dir:
             os.makedirs(spool_dir, exist_ok=True)
-        self._lock = threading.Lock()
+        # instrumented (telemetry/prof.py): uplink RPC threads contend
+        # with the controller's fold request here
+        self._lock = _prof.lock("aggregation.slice")
         # learner_id -> (round, fold-ready model tree) — latest wins,
         # the required_lineage == 1 store semantics
         self._models: Dict[str, tuple] = {}

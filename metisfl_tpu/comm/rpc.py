@@ -163,9 +163,13 @@ class BytesService:
         if role:
             # fleet telemetry fabric (telemetry/fabric.py): every
             # role-carrying endpoint answers cursor-based telemetry
-            # pulls next to ListMethods/GetMetrics. With
-            # telemetry.fabric.enabled=false the handler answers a
-            # one-attribute-check {"enabled": false} stub.
+            # pulls next to ListMethods/GetMetrics — event tail,
+            # finished-span ring, metrics state, and the continuous-
+            # profiling section (telemetry/prof.py folded stacks + lock
+            # contention). With telemetry.fabric.enabled=false the
+            # handler answers a one-attribute-check {"enabled": false}
+            # stub (telemetry.prof.enabled=false stubs just its
+            # section).
             self.handlers.setdefault("CollectTelemetry",
                                      self._collect_telemetry)
 

@@ -243,6 +243,30 @@ class ProfileConfig:
 
 
 @dataclass
+class ProfConfig:
+    """Continuous profiling plane (telemetry/prof.py): an always-on
+    stack sampler per process (daemon thread over
+    ``sys._current_frames()``) folding into a bounded mergeable
+    folded-stack table, plus instrumented wrappers on the hot locks
+    (controller registry, store lineage/LRU, ingest, slice reducer,
+    serving queue, fleet collector) recording wait-time histograms and
+    per-site contention counters. Profiles ride ``CollectTelemetry``
+    and each RoundProfile carries the per-round folded-stack delta;
+    ``python -m metisfl_tpu.perf --flame`` / ``--flame-diff`` render
+    them. ``enabled=false``: no sampler thread, and the lock factories
+    hand back raw ``threading`` locks — zero wrapper cost."""
+
+    enabled: bool = True
+    # sampling frequency; 67 Hz is deliberately off-harmonic with the
+    # 1/10/100 ms periods federation work is built from (GWP posture)
+    hz: float = 67.0
+    # folded-stack table budget: top-`budget` stacks keep exact labels,
+    # the crowd collapses into the SpaceSaving eviction floor — fleet
+    # profiles stay O(budget) however long the process runs
+    budget: int = 512
+
+
+@dataclass
 class FabricConfig:
     """Fleet telemetry fabric (telemetry/fabric.py): the
     ``CollectTelemetry`` cursor-pull RPC every role-carrying endpoint
@@ -307,6 +331,8 @@ class TelemetryConfig:
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     # fleet telemetry fabric (telemetry/fabric.py)
     fabric: FabricConfig = field(default_factory=FabricConfig)
+    # continuous profiling plane (telemetry/prof.py)
+    prof: ProfConfig = field(default_factory=ProfConfig)
     # flight-recorder bundle directory (telemetry/postmortem.py): crash /
     # chaos-kill / failover post-mortems land here. "" → recorder off;
     # the driver fills this in with <workdir>/postmortem.
@@ -648,6 +674,18 @@ class FederationConfig:
             raise ValueError("telemetry.fabric.rtt_gate must be >= 1")
         if fab.span_ring < 0:
             raise ValueError("telemetry.fabric.span_ring must be >= 0")
+        pr = self.telemetry.prof
+        if pr.enabled:
+            if not 0.0 < pr.hz <= 1000.0:
+                # 0 would park the sampler thread in a busy loop's
+                # degenerate cousin (wait(inf)); past 1 kHz the sampler
+                # IS the workload it claims to measure
+                raise ValueError(
+                    "telemetry.prof.hz must be in (0, 1000]")
+            if pr.budget < 16:
+                # a tiny table thrashes the SpaceSaving floor and every
+                # profile becomes eviction noise
+                raise ValueError("telemetry.prof.budget must be >= 16")
         if self.telemetry.alerts_interval_s <= 0.0:
             raise ValueError("telemetry.alerts_interval_s must be > 0")
         if self.telemetry.alerts:

@@ -59,6 +59,7 @@ from metisfl_tpu.store import EvictionPolicy, make_store
 from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import prof as _tprof
 from metisfl_tpu.telemetry import profile as _tprofile
 from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.telemetry.health import HealthMonitor, finite_metrics
@@ -261,7 +262,11 @@ class Controller:
                  secure_backend=None):
         self.config = config
         self._proxy_factory = proxy_factory
-        self._lock = threading.RLock()
+        # the registry lock — every uplink, join/leave, and round close
+        # serializes here, which makes it THE contention site to watch:
+        # instrumented by the continuous profiler (telemetry/prof.py;
+        # with telemetry.prof.enabled=false this is a raw RLock)
+        self._lock = _tprof.rlock("controller.registry")
         self._learners: Dict[str, LearnerRecord] = {}
         self._tokens: Dict[str, str] = {}
         # Controller incarnation id, minted fresh per process (never

@@ -1200,6 +1200,18 @@ class DriverSession:
                 self._fleet.stop(final_poll=True)
             except Exception:  # noqa: BLE001 - collection never blocks
                 logger.exception("fleet collector stop failed")
+            # persist the fleet's continuous profiles (telemetry/prof.py)
+            # next to traces.jsonl: per-peer folded-stack tables + the
+            # peer-prefixed merge, the artifact `python -m
+            # metisfl_tpu.perf --flame <workdir>/prof-fleet.json` renders
+            try:
+                if self._fleet.dump_prof(
+                        os.path.join(self.workdir, "prof-fleet.json")):
+                    logger.info("fleet profile written: %s",
+                                os.path.join(self.workdir,
+                                             "prof-fleet.json"))
+            except Exception:  # noqa: BLE001 - profiling never blocks
+                logger.exception("fleet profile dump failed")
         if timeout_s is None:
             multihost = any(int(getattr(ep, "world_size", 1)) > 1
                             for ep in self.config.learners)

@@ -22,6 +22,30 @@ The reading half of the performance observatory (telemetry/profile.py):
   ``METISFL_BENCH`` marker bench.py appends (and older full-JSON tail
   lines); unparseable ones are reported and skipped, never fatal.
 
+- **--flame <source>** — render a continuous-profiling capture
+  (telemetry/prof.py) as collapsed folded stacks on stdout (the format
+  speedscope and FlameGraph's ``flamegraph.pl`` ingest directly) plus a
+  terminal top-table (per-frame self/total %) on stderr. Sources: a
+  fleet profile dump (``FleetCollector.dump_prof`` / the driver's
+  ``prof-fleet.json``), a raw ``prof.collect_state()`` JSON, a
+  post-mortem bundle, or a run dir / ``profiles-*.jsonl`` whose
+  RoundProfiles carry per-round stack deltas (``--round N`` or a
+  ``path@N`` suffix picks one round; otherwise rounds sum)::
+
+      python -m metisfl_tpu.perf --flame <workdir>/prof-fleet.json
+      python -m metisfl_tpu.perf --flame <workdir> --round 6
+
+- **--flame-diff A B** — differential profile between two captures or
+  rounds (``run@6 run@7`` diffs round profiles from one run): per-frame
+  self-time growth, the table that answers "which frames grew when
+  rounds/s dropped".
+
+Bench noise floor: captures may carry a ``details.repeats`` map
+(``{key: K}`` — bench.py re-measured ms-scale keys median-of-K on hosts
+whose run-to-run spread exceeds the gate). The comparison rows carry
+the per-key ``repeats`` field and the renderer marks them ``xK`` so a
+gated median is distinguishable from a single shot.
+
 Host provenance: a capture may declare the machine it ran on (a
 ``host`` string in the result / ``parsed`` payload; bench.py stamps it
 from ``METISFL_BENCH_HOST`` or ``platform.node()``). A pair is **gated**
@@ -55,6 +79,10 @@ BENCH_MARKER = "METISFL_BENCH "
 # flattened-capture key carrying the declared capture host (never judged
 # — metric_direction reports 0 for it; see "Host provenance" above)
 HOST_KEY = "_host"
+
+# flattened-capture key carrying the per-key repeat counts (a dict, so
+# the numeric _take filter skips it; comparison rows re-attach it)
+REPEATS_KEY = "_repeats"
 
 # default relative-change threshold for regression flags (20% — well
 # under the 30% regressions the acceptance gate injects, well over
@@ -370,6 +398,10 @@ def flatten_bench(capture: Dict[str, Any]) -> Dict[str, Any]:
             _take(key, value)
     if capture.get("host"):
         flat[HOST_KEY] = str(capture["host"])
+    repeats = (capture.get("details") or {}).get("repeats")
+    if isinstance(repeats, dict) and repeats:
+        flat[REPEATS_KEY] = {str(k): int(v) for k, v in repeats.items()
+                             if isinstance(v, (int, float))}
     return flat
 
 
@@ -387,7 +419,12 @@ _LOWER_BETTER = ("_ms", "ms_per", "_secs", "seconds", "_bytes", "_mb",
                  "_kb", "rss", "wall", "latency", "pause",
                  # obs section: sketch-vs-exact quantile error — a
                  # growing error means the digest got worse, a regression
-                 "relerr")
+                 "relerr",
+                 # prof section: nanosecond-scale per-acquire lock costs
+                 # (the overhead *percentage* is deliberately unjudged —
+                 # a ratio of two noisy medians would flag pure noise;
+                 # the chaos_smoke prof gate bounds it absolutely)
+                 "_ns")
 
 
 def metric_direction(key: str) -> int:
@@ -414,6 +451,8 @@ def compare_captures(a: Dict[str, Any], b: Dict[str, Any],
     shared judgeable key, ``regressed=True`` where B is worse than A by
     more than ``threshold`` (relative, direction-aware)."""
     rows: List[Dict[str, Any]] = []
+    rep_a = a.get(REPEATS_KEY) or {}
+    rep_b = b.get(REPEATS_KEY) or {}
     for key in sorted(set(a) & set(b)):
         direction = metric_direction(key)
         if direction == 0:
@@ -435,7 +474,14 @@ def compare_captures(a: Dict[str, Any], b: Dict[str, Any],
                     else rel > threshold)
         rows.append({"key": key, "a": va, "b": vb, "rel": rel,
                      "direction": direction, "regressed": regressed,
-                     "improved": improved})
+                     "improved": improved,
+                     # bench noise floor: how many measurements back each
+                     # side (1 = single shot; >1 = median-of-K, bench.py
+                     # re-measured a ms-scale key under the repeat
+                     # threshold) — carried so the gate's verdict is
+                     # auditable as a median, not a lucky shot
+                     "repeats": max(int(rep_a.get(key, 1)),
+                                    int(rep_b.get(key, 1)))})
     return rows
 
 
@@ -461,11 +507,195 @@ def render_comparison(rows: List[Dict[str, Any]],
             continue
         tag = ("  REGRESSED" if row["regressed"]
                else "  improved" if row["improved"] else "")
+        if int(row.get("repeats", 1)) > 1:
+            tag += f"  x{int(row['repeats'])}"
         lines.append(f"{row['key']:<36} {row['a']:>12.4g} "
                      f"{row['b']:>12.4g} {row['rel'] * 100:>+8.1f}%{tag}")
     if len(lines) == 1:
         lines.append("(no judgeable shared keys moved past the threshold)")
     return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- #
+# continuous-profiling renderers (--flame / --flame-diff)
+# --------------------------------------------------------------------- #
+
+def _split_round_suffix(path: str) -> Tuple[str, Optional[int]]:
+    """``run@6`` → (``run``, 6): the round-selector suffix the
+    --flame-diff mode uses to diff two rounds of ONE run."""
+    base, sep, tail = path.rpartition("@")
+    if sep and base and tail.isdigit() and not os.path.exists(path):
+        return base, int(tail)
+    return path, None
+
+
+def load_folded(path: str, want_round: Optional[int] = None
+                ) -> Dict[str, float]:
+    """A ``{folded_stack: samples}`` map from any profiling artifact
+    this repo writes:
+
+    - a fleet profile dump (``{"kind": "prof", "peers"/"stacks"}``) —
+      peer-prefixed merged stacks;
+    - a raw ``prof.collect_state()`` JSON (``{"stacks": {...}}``);
+    - a post-mortem bundle (its ``prof`` section has no raw stacks —
+      only the top table — so the TABLE's self counts render);
+    - a run dir / ``profiles-*.jsonl`` / ``experiment.json`` whose
+      RoundProfiles carry per-round ``prof`` stack deltas (``want_round``
+      picks one round, otherwise rounds sum).
+
+    Returns ``{}`` when nothing profiling-shaped is found."""
+    from metisfl_tpu.telemetry import prof as _prof
+
+    path, at_round = _split_round_suffix(path)
+    if at_round is not None and want_round is None:
+        want_round = at_round
+    if os.path.isdir(path) or path.endswith(".jsonl") \
+            or os.path.basename(path) == "experiment.json":
+        folded: Dict[str, float] = {}
+        for profile in load_profiles(path):
+            if want_round is not None \
+                    and int(profile.get("round", -1)) != want_round:
+                continue
+            section = profile.get("prof") or {}
+            for stack, count in section.get("stacks") or []:
+                folded[str(stack)] = (folded.get(str(stack), 0.0)
+                                      + float(count))
+        return folded
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"cannot read a profile from {path}: {exc}",
+              file=sys.stderr)
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    if "peers" in data and "stacks" in data:   # fleet dump: merged map
+        return {str(k): float(v)
+                for k, v in (data.get("stacks") or {}).items()}
+    if "stacks" in data:                        # raw collect_state
+        return _prof.folded_counts(data)
+    if "prof" in data:                          # post-mortem bundle
+        section = data["prof"] or {}
+        if "stacks" in section:
+            return _prof.folded_counts(section)
+        return {str(row.get("frame", "?")): float(row.get("self", 0.0))
+                for row in section.get("top") or []
+                if float(row.get("self", 0.0)) > 0.0}
+    return {}
+
+
+def render_collapsed(folded: Dict[str, float]) -> str:
+    """Collapsed-stack export: ``root;...;leaf <count>`` lines, the
+    exact format ``flamegraph.pl`` and speedscope ingest."""
+    return "\n".join(
+        f"{stack} {int(round(count))}"
+        for stack, count in sorted(folded.items(),
+                                   key=lambda kv: (-kv[1], kv[0]))
+        if int(round(count)) > 0)
+
+
+def render_frame_table(folded: Dict[str, float], top: int = 15) -> str:
+    """The terminal top-table: per-frame self/total samples + percents."""
+    from metisfl_tpu.telemetry import prof as _prof
+
+    rows = _prof.frame_table(folded)
+    total = sum(folded.values())
+    lines = [f"{'frame':<52} {'self':>8} {'self%':>7} "
+             f"{'total':>8} {'total%':>7}"]
+    for row in rows[:top]:
+        lines.append(f"{row['frame'][:52]:<52} {row['self']:>8.0f} "
+                     f"{row['self_pct']:>6.1f}% {row['total']:>8.0f} "
+                     f"{row['total_pct']:>6.1f}%")
+    lines.append(f"({len(folded)} folded stacks, "
+                 f"{total:.0f} samples)")
+    return "\n".join(lines)
+
+
+def diff_frame_tables(a: Dict[str, float], b: Dict[str, float]
+                      ) -> List[Dict[str, Any]]:
+    """Per-frame differential profile: self/total sample deltas (B − A),
+    biggest absolute self growth first — the table that explains an
+    unattributed slowdown between two rounds or two captures."""
+    from metisfl_tpu.telemetry import prof as _prof
+
+    rows_a = {r["frame"]: r for r in _prof.frame_table(a)}
+    rows_b = {r["frame"]: r for r in _prof.frame_table(b)}
+    out: List[Dict[str, Any]] = []
+    for frame in set(rows_a) | set(rows_b):
+        ra, rb = rows_a.get(frame), rows_b.get(frame)
+        d_self = ((rb["self"] if rb else 0.0)
+                  - (ra["self"] if ra else 0.0))
+        d_total = ((rb["total"] if rb else 0.0)
+                   - (ra["total"] if ra else 0.0))
+        if d_self == 0.0 and d_total == 0.0:
+            continue
+        out.append({"frame": frame, "d_self": d_self, "d_total": d_total,
+                    "self_a": ra["self"] if ra else 0.0,
+                    "self_b": rb["self"] if rb else 0.0})
+    out.sort(key=lambda r: (-abs(r["d_self"]), -abs(r["d_total"]),
+                            r["frame"]))
+    return out
+
+
+def render_flame_diff(rows: List[Dict[str, Any]],
+                      label_a: str = "A", label_b: str = "B",
+                      top: int = 15) -> str:
+    lines = [f"{'frame':<52} {label_a[:10]:>10} {label_b[:10]:>10} "
+             f"{'Δself':>9} {'Δtotal':>9}"]
+    for row in rows[:top]:
+        lines.append(f"{row['frame'][:52]:<52} {row['self_a']:>10.0f} "
+                     f"{row['self_b']:>10.0f} {row['d_self']:>+9.0f} "
+                     f"{row['d_total']:>+9.0f}")
+    if len(lines) == 1:
+        lines.append("(no per-frame difference between the profiles)")
+    return "\n".join(lines)
+
+
+def _flame_main(path: str, want_round: Optional[int], top: int,
+                out_path: str = "") -> int:
+    folded = load_folded(path, want_round=want_round)
+    if not folded:
+        print(f"no profiling data found in {path} (is telemetry.prof "
+              "enabled and the source a prof dump / bundle / run dir?)",
+              file=sys.stderr)
+        return 2
+    collapsed = render_collapsed(folded)
+    if out_path:
+        try:
+            with open(out_path, "w") as fh:
+                fh.write(collapsed + "\n")
+        except OSError as exc:
+            print(f"cannot write {out_path}: {exc}", file=sys.stderr)
+            return 2
+        print(render_frame_table(folded, top=top))
+    else:
+        # collapsed stacks on stdout (pipe straight into flamegraph.pl /
+        # speedscope), human table on stderr
+        print(collapsed)
+        print(render_frame_table(folded, top=top), file=sys.stderr)
+    return 0
+
+
+def _flame_diff_main(path_a: str, path_b: str,
+                     want_round: Optional[int], top: int) -> int:
+    a = load_folded(path_a, want_round=want_round)
+    b = load_folded(path_b, want_round=want_round)
+    for path, folded in ((path_a, a), (path_b, b)):
+        if not folded:
+            print(f"no profiling data found in {path}", file=sys.stderr)
+            return 2
+    rows = diff_frame_tables(a, b)
+    print(render_flame_diff(
+        rows, label_a=os.path.basename(_split_round_suffix(path_a)[0]),
+        label_b=os.path.basename(_split_round_suffix(path_b)[0]),
+        top=top))
+    grew = [r for r in rows if r["d_self"] > 0]
+    print(f"\n{len(grew)} frame(s) grew, "
+          f"{sum(r['d_self'] for r in grew):.0f} self-samples of growth "
+          f"({sum(a.values()):.0f} -> {sum(b.values()):.0f} total)",
+          file=sys.stderr)
+    return 0
 
 
 def _trajectory_paths(args: List[str]) -> List[str]:
@@ -497,6 +727,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="diff a series of bench captures pairwise "
                              "(files and/or dirs of .json); exit 1 on "
                              "regression")
+    parser.add_argument("--flame", metavar="SOURCE",
+                        help="render a continuous-profiling capture as "
+                             "collapsed folded stacks (stdout; speedscope/"
+                             "FlameGraph format) + a self/total top-table")
+    parser.add_argument("--flame-diff", nargs=2, metavar=("A", "B"),
+                        help="differential profile between two captures "
+                             "or rounds (path@N selects a round)")
+    parser.add_argument("--out", default="",
+                        help="--flame: write the collapsed stacks to this "
+                             "file and print the table to stdout")
     parser.add_argument("--threshold", type=float,
                         default=DEFAULT_THRESHOLD,
                         help="relative regression threshold "
@@ -509,6 +749,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comparison: show unchanged keys too")
     args = parser.parse_args(argv)
 
+    if args.flame:
+        return _flame_main(args.flame, args.round, args.top,
+                           out_path=args.out)
+    if args.flame_diff:
+        return _flame_diff_main(args.flame_diff[0], args.flame_diff[1],
+                                args.round, args.top)
     if args.compare:
         return _compare_main(args.compare[0], args.compare[1],
                              args.threshold, args.all)
@@ -616,4 +862,11 @@ def _waterfall_main(paths: List[str], want_round: Optional[int],
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout piped into head / flamegraph.pl that exited first — the
+        # normal life of collapsed-stack output, not an error. Point the
+        # fd at devnull so interpreter shutdown doesn't re-raise.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

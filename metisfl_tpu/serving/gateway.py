@@ -36,6 +36,7 @@ from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.registry import CHANNEL_CANDIDATE, CHANNEL_STABLE
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import prof as _prof
 from metisfl_tpu.telemetry import profile as _tprofile
 from metisfl_tpu.tensor.pytree import (
     ModelBlob,
@@ -110,7 +111,10 @@ class MicroBatcher:
         self.max_batch = max(1, int(max_batch))
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self._queue: List[_Pending] = []
-        self._cv = threading.Condition()
+        # condition over an instrumented lock (telemetry/prof.py):
+        # submit-vs-drain contention on the micro-batch queue is
+        # measured; the worker's wait() park re-acquires untimed
+        self._cv = threading.Condition(_prof.lock("serving.queue"))
         self._closed = False
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name=f"serving-{name}")
@@ -265,7 +269,7 @@ class ServingGateway:
         self.model_ops = model_ops
         self.config = config
         self._ship_regex = ship_tensor_regex
-        self._lock = threading.Lock()
+        self._lock = _prof.lock("serving.gateway")
         # channel -> (version id, variables pytree)
         self._models: Dict[str, Tuple[int, Any]] = {}
         self._treedef_like = model_ops.get_variables()

@@ -284,6 +284,25 @@ def render_fleet(snap: Dict[str, Any], span_tail: int = 25) -> str:
                 f"{p.get('offset_ms', 0.0):>+8.1f}ms "
                 f"{p.get('rtt_ms', 0.0):>6.1f}ms "
                 f"{p.get('spans', 0):>6} {p.get('events', 0):>6}")
+    prof = snap.get("prof") or {}
+    if prof:
+        # continuous-profiling line(s) (telemetry/prof.py over the
+        # CollectTelemetry prof section): each peer's hottest frame by
+        # self time and its most contended lock site
+        cells = []
+        for name in sorted(prof):
+            row = prof[name] or {}
+            if not row.get("samples"):
+                continue
+            cell = (f"{name}: {row.get('top_frame', '?')} "
+                    f"{row.get('top_frame_pct', 0.0):g}%")
+            if row.get("top_lock"):
+                cell += (f" lock={row['top_lock']} "
+                         f"{row.get('top_lock_wait_ms', 0.0):g}ms/"
+                         f"{row.get('contentions', 0)}w")
+            cells.append(cell)
+        if cells:
+            lines.append("prof: " + "  |  ".join(cells))
     families = snap.get("families") or {}
     if families:
         shown = []

@@ -45,6 +45,8 @@ from __future__ import annotations
 import contextlib
 import enum
 import threading
+
+from metisfl_tpu.telemetry import prof as _prof
 from typing import Any, Dict, List, Optional, Sequence
 
 
@@ -71,8 +73,10 @@ class ModelStore:
         self.policy = policy
         self.lineage_length = lineage_length
         # registry lock: guards ONLY the per-learner lock table (and
-        # subclass-global bookkeeping) — never held across I/O
-        self._lock = threading.Lock()
+        # subclass-global bookkeeping) — never held across I/O.
+        # Instrumented (telemetry/prof.py): contention here means the
+        # whole store serializes on bookkeeping, not I/O
+        self._lock = _prof.lock("store.registry")
         # learner_id -> [lock, refcount]; the refcount makes pruning safe:
         # erase may drop an entry only when no other thread has fetched
         # it, otherwise two lock objects could coexist for one learner
@@ -86,7 +90,8 @@ class ModelStore:
         with self._lock:
             entry = self._learner_locks.get(learner_id)
             if entry is None:
-                entry = self._learner_locks[learner_id] = [threading.Lock(), 0]
+                entry = self._learner_locks[learner_id] = [
+                    _prof.lock("store.lineage"), 0]
             entry[1] += 1
         try:
             with entry[0]:

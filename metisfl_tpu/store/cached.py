@@ -27,6 +27,7 @@ from metisfl_tpu.store.base import EvictionPolicy
 from metisfl_tpu.store.disk import _MISS, DiskModelStore
 from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import prof as _prof
 
 _REG = _tmetrics.registry()
 _M_CACHE_HITS = _REG.counter(
@@ -69,7 +70,9 @@ class CachedDiskStore(DiskModelStore):
         # Guarded by _cache_lock (the LRU spans learners, so the
         # per-learner lineage locks cannot protect it).
         self._cache: "OrderedDict[Tuple[str, int], Tuple[int, Any]]" = OrderedDict()
-        self._cache_lock = threading.Lock()
+        # instrumented (telemetry/prof.py): the LRU spans learners, so
+        # every select/insert contends here under parallel ingest
+        self._cache_lock = _prof.lock("store.cache_lru")
         self._cached_total = 0
         self.cache_hits = 0
         self.cache_misses = 0

@@ -46,6 +46,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from metisfl_tpu.store.base import ModelStore
+from metisfl_tpu.telemetry import prof as _prof
 
 logger = logging.getLogger("metisfl_tpu.store.ingest")
 
@@ -70,7 +71,10 @@ class IngestPipeline:
                                         thread_name_prefix="store-ingest")
         self.workers = workers
         self.max_pending = int(max_pending) or max(8 * workers, 16)
-        self._cond = threading.Condition()
+        # condition over an instrumented lock (telemetry/prof.py):
+        # submit-vs-worker contention is measured; the wait()/notify
+        # park-time itself re-acquires through the untimed path
+        self._cond = threading.Condition(_prof.lock("store.ingest"))
         # learner_id -> queued-or-writing count (under _cond)
         self._pending: Dict[str, int] = {}
         self._pending_total = 0

@@ -145,6 +145,10 @@ M_METRICS_SERIES_OVERFLOW_TOTAL = metrics.SERIES_OVERFLOW_TOTAL
 M_METRICS_FAMILY_SERIES = metrics.FAMILY_SERIES
 M_ALERTS_ACTIVE = alerts.ALERTS_ACTIVE
 M_ALERTS_FIRED_TOTAL = alerts.ALERTS_FIRED_TOTAL
+# continuous profiling plane (telemetry/prof.py sampler + lock wrappers)
+M_PROF_SAMPLES_TOTAL = "prof_samples_total"
+M_LOCK_WAIT_SECONDS = "lock_wait_seconds"
+M_LOCK_CONTENTION_TOTAL = "lock_contention_total"
 # fleet telemetry fabric (telemetry/fabric.py FleetCollector)
 M_FABRIC_COLLECTIONS_TOTAL = "fabric_collections_total"
 M_FABRIC_PEER_OFFSET_MS = "fabric_peer_clock_offset_ms"
@@ -246,13 +250,23 @@ def apply_config(telemetry_config, service: str = "",
     fabric.configure(
         enabled=enabled and bool(getattr(fab_cfg, "enabled", True)),
         span_ring=int(getattr(fab_cfg, "span_ring", 0) or 0))
+    # continuous profiling plane (telemetry/prof.py): arm (or stop) the
+    # stack sampler and flip the instrumented-lock factories — hot locks
+    # constructed after this call adopt the configured mode
+    prof_cfg = getattr(telemetry_config, "prof", None)
+    prof.configure(
+        enabled=enabled and bool(getattr(prof_cfg, "enabled", True)),
+        hz=float(getattr(prof_cfg, "hz", 0.0) or 0.0),
+        budget=int(getattr(prof_cfg, "budget", 0) or 0))
 
 
 # Imported at the BOTTOM so profile.py (which reads the M_* constants at
 # its own import time) sees a fully-initialized package — the other
 # submodules import nothing back from this package. fabric imports only
 # sibling submodules at module level (its RPC client is lazy), so the
-# same late import keeps the comm <-> telemetry layering acyclic.
+# same late import keeps the comm <-> telemetry layering acyclic. prof
+# loads FIRST: fabric and profile both reference it.
+from metisfl_tpu.telemetry import prof  # noqa: E402
 from metisfl_tpu.telemetry import fabric, profile  # noqa: E402
 
-__all__ += ["profile", "fabric"]
+__all__ += ["profile", "fabric", "prof"]

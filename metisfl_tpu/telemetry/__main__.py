@@ -159,6 +159,30 @@ def render_postmortem(bundle: dict, show_metrics: bool = False) -> str:
                 f"top={top} {_fmt_dur(float(phases.get(top, 0.0)))} "
                 f"uplink {int(totals.get('uplink_bytes', 0))}B "
                 f"downlink {int(totals.get('downlink_bytes', 0))}B")
+    prof = bundle.get("prof") or {}
+    if prof:
+        top = prof.get("top") or []
+        lines.append(
+            f"  profiler at death ({prof.get('samples', 0)} stacks @ "
+            f"{prof.get('hz', 0.0):g}Hz, top {len(top)} frames):")
+        for row in top[:5]:
+            lines.append(
+                f"    {row.get('frame', '?'):<44} "
+                f"self {row.get('self_pct', 0.0):5.1f}%  "
+                f"total {row.get('total_pct', 0.0):5.1f}%")
+        locks = prof.get("locks") or {}
+        contended = [(site, row) for site, row in locks.items()
+                     if row.get("contentions")]
+        contended.sort(key=lambda kv: -kv[1].get("wait_s_total", 0.0))
+        if contended:
+            lines.append(f"  lock contention at death "
+                         f"({len(contended)} site(s)):")
+            for site, row in contended[:5]:
+                lines.append(
+                    f"    {site:<28} waits={row.get('contentions', 0)} "
+                    f"total={row.get('wait_s_total', 0.0) * 1e3:.1f}ms "
+                    f"max={row.get('wait_s_max', 0.0) * 1e3:.1f}ms "
+                    f"acquires={row.get('acquisitions', 0)}")
     alerts = bundle.get("alerts") or {}
     if alerts:
         active = alerts.get("active") or []
@@ -220,6 +244,11 @@ def main(argv: List[str]) -> int:
         from metisfl_tpu.telemetry import fabric as _fabric
         return _fabric.main(
             ["--smoke"] + [a for a in argv if a != "--fabric-smoke"])
+    if "--prof-smoke" in argv:
+        # the continuous-profiling overhead gate (scripts/chaos_smoke.sh)
+        from metisfl_tpu.telemetry import prof as _prof
+        return _prof.main(
+            ["--smoke"] + [a for a in argv if a != "--prof-smoke"])
     show_attrs = "--attrs" in argv
     argv = [a for a in argv if a != "--attrs"]
     want_trace = want_round = None

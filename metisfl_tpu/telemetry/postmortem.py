@@ -142,6 +142,18 @@ class _Recorder:
         except Exception:  # noqa: BLE001 - best-effort by contract
             pass
         try:
+            # profiler at death (telemetry/prof.py): the top frames and
+            # lock-contention rollup of the process's last moments —
+            # what it was BURNING time on, next to the open spans that
+            # say what it was waiting for
+            from metisfl_tpu.telemetry import prof as _prof
+
+            prof_snapshot = _prof.postmortem_snapshot()
+            if prof_snapshot is not None:
+                bundle["prof"] = prof_snapshot
+        except Exception:  # noqa: BLE001 - best-effort by contract
+            pass
+        try:
             # alerts at death (telemetry/alerts.py): the firing page
             # nobody got — which rules were active, for how long
             from metisfl_tpu.telemetry import alerts as _alerts

@@ -1,0 +1,674 @@
+"""Continuous profiling plane: fleet-wide stack sampling + lock telemetry.
+
+PR 6's round profiles tile wall-clock into *phases* and the fleet fabric
+assembles *spans* across processes — but neither can say which frames,
+locks, or queues the milliseconds inside a phase actually go to.
+Warehouse-scale practice (Google-Wide Profiling, Ren et al., IEEE Micro
+2010; Kanev et al., ISCA 2015) shows that an always-on, low-overhead
+sampling layer across the fleet is what turns perf work from guessing
+into diffing. This module is that layer, native to the existing planes:
+
+- **Sampling profiler** — a daemon thread walks ``sys._current_frames()``
+  at ``telemetry.prof.hz`` (default 67 Hz, deliberately off-harmonic so
+  periodic workloads cannot hide between ticks) and folds every thread's
+  stack into a bounded, mergeable folded-stack table: a
+  :class:`~metisfl_tpu.telemetry.sketch.SpaceSaving` tracker over
+  ``root;frame;...;leaf`` strings (top-``budget`` stacks keep exact
+  labels, the crowd collapses into the eviction floor — PR 9's posture,
+  so fleet profiles stay O(budget) like everything else).
+
+- **Lock-contention telemetry** — :func:`lock`/:func:`rlock` return
+  instrumented wrappers adopted by the hot locks that already exist
+  (controller registry, store lineage/LRU, ingest pipeline, slice
+  reducer, serving micro-batch queue, fleet collector): every contended
+  acquire records its wait into the ``lock_wait_seconds{site}``
+  histogram and ``lock_contention_total{site}``, plus a per-site
+  acquisitions/wait rollup served with the profile. Uncontended acquires
+  pay one non-blocking try; ``threading.Condition`` over a wrapped lock
+  re-acquires through the untimed path (a batcher idling on ``wait()``
+  is queue time, not lock contention).
+
+- **Fleet transport** — the profile rides the existing
+  ``CollectTelemetry`` reply as a ``prof`` section (epoch-consistent
+  with the fabric cursors), so the :class:`FleetCollector` holds a
+  per-peer folded profile and ``status --fleet`` can print each peer's
+  top frame and hottest lock. Each :class:`RoundProfile` additionally
+  carries the per-round folded-stack *delta*, making "which frames grew
+  when rounds/s dropped" answerable per round.
+
+Rendering lives in ``python -m metisfl_tpu.perf``: ``--flame`` exports
+collapsed stacks (speedscope / FlameGraph compatible) plus a terminal
+self/total table, and ``--flame-diff A B`` diffs two captures or rounds.
+
+Opt-out ``telemetry.prof.enabled=false``: the sampler never starts, the
+lock factories return raw ``threading.Lock``/``RLock`` objects (the hot
+paths carry zero wrapper cost), and the ``CollectTelemetry`` section is
+an ``{"enabled": false}`` stub. The profiler's own overhead is gated in
+CI (``python -m metisfl_tpu.telemetry --prof-smoke``, wired into
+scripts/chaos_smoke.sh): the bench round loop with profiling on must
+stay within the pinned bound of the profiling-off run.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from metisfl_tpu.telemetry import metrics as _metrics
+from metisfl_tpu.telemetry.sketch import SpaceSaving
+
+logger = logging.getLogger("metisfl_tpu.telemetry.prof")
+
+# defaults (config/federation.py ProfConfig mirrors them, test-pinned).
+# 67 Hz is off-harmonic with the common 1/10/100 ms periods a federation
+# round is built from, so periodic work cannot systematically dodge (or
+# monopolize) the sampling ticks — the GWP posture.
+DEFAULT_HZ = 67.0
+DEFAULT_BUDGET = 512
+# folded stacks keep at most this many frames (leaf-most survive; a
+# deeper stack gets a "_deep" root marker) so one recursive workload
+# cannot blow the table's per-key size
+MAX_STACK_DEPTH = 64
+
+# metric families (telemetry/__init__.py re-exports them as M_*
+# constants; catalog rows in docs/OBSERVABILITY.md)
+SAMPLES_TOTAL = "prof_samples_total"
+LOCK_WAIT_SECONDS = "lock_wait_seconds"
+LOCK_CONTENTION_TOTAL = "lock_contention_total"
+
+_REG = _metrics.registry()
+_M_SAMPLES = _REG.counter(
+    SAMPLES_TOTAL,
+    "Thread stacks folded by the sampling profiler (one per live "
+    "thread per tick)")
+_M_LOCK_WAIT = _REG.histogram(
+    LOCK_WAIT_SECONDS,
+    "Wait time of CONTENDED acquires on instrumented locks, by site "
+    "(uncontended acquires are counted locally, never observed here)",
+    ("site",))
+_M_LOCK_CONTENTION = _REG.counter(
+    LOCK_CONTENTION_TOTAL,
+    "Contended acquires on instrumented locks, by site", ("site",))
+
+_PREFIX = "metisfl_tpu."
+
+
+def _frame_name(frame) -> str:
+    mod = frame.f_globals.get("__name__", "?") or "?"
+    if mod.startswith(_PREFIX):
+        mod = mod[len(_PREFIX):]
+    return f"{mod}.{frame.f_code.co_name}"
+
+
+def fold_frame(frame, max_depth: int = MAX_STACK_DEPTH) -> str:
+    """One thread's stack as a ``root;...;leaf`` folded string (the
+    collapsed-stack format speedscope/FlameGraph ingest)."""
+    parts: List[str] = []
+    while frame is not None and len(parts) < max_depth:
+        parts.append(_frame_name(frame))
+        frame = frame.f_back
+    if frame is not None:
+        parts.append("_deep")
+    parts.reverse()
+    return ";".join(parts)
+
+
+# --------------------------------------------------------------------- #
+# lock-contention telemetry
+# --------------------------------------------------------------------- #
+
+class _SiteStats:
+    """Per-site rollup. Plain (racy) increments by design: these are
+    statistics, and a CAS loop on every hot-lock acquire would be the
+    overhead this plane exists to measure."""
+
+    __slots__ = ("site", "acquisitions", "contentions", "wait_s_total",
+                 "wait_s_max")
+
+    def __init__(self, site: str):
+        self.site = site
+        self.acquisitions = 0
+        self.contentions = 0
+        self.wait_s_total = 0.0
+        self.wait_s_max = 0.0
+
+    def row(self) -> Dict[str, Any]:
+        return {"acquisitions": int(self.acquisitions),
+                "contentions": int(self.contentions),
+                "wait_s_total": round(self.wait_s_total, 6),
+                "wait_s_max": round(self.wait_s_max, 6)}
+
+
+_SITES_LOCK = threading.Lock()
+_SITES: Dict[str, _SiteStats] = {}
+# site -> weakref to the most recently constructed wrapper (a TEST HOOK:
+# the acceptance tests inject a lock-hold by fetching and holding the
+# real object; production code never reads this)
+_SITE_LOCKS: Dict[str, Any] = {}
+
+
+def _site_stats(site: str) -> _SiteStats:
+    with _SITES_LOCK:
+        stats = _SITES.get(site)
+        if stats is None:
+            stats = _SITES[site] = _SiteStats(site)
+        return stats
+
+
+class _TimedLockBase:
+    """Shared acquire instrumentation. The fast path is one non-blocking
+    try; only a *contended* acquire pays for timestamps and the metric
+    observation (so the uncontended hot path stays within the CI-gated
+    overhead bound)."""
+
+    __slots__ = ("_lock", "site", "_stats", "__weakref__")
+
+    def __init__(self, lock, site: str):
+        self._lock = lock
+        self.site = site
+        self._stats = _site_stats(site)
+        with _SITES_LOCK:
+            _SITE_LOCKS[site] = weakref.ref(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = self._stats
+        if self._lock.acquire(False):
+            st.acquisitions += 1
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        ok = self._lock.acquire(True, timeout)
+        wait = time.perf_counter() - t0
+        st.contentions += 1
+        st.wait_s_total += wait
+        if wait > st.wait_s_max:
+            st.wait_s_max = wait
+        if ok:
+            st.acquisitions += 1
+        _M_LOCK_WAIT.observe(wait, site=self.site)
+        _M_LOCK_CONTENTION.inc(site=self.site)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    # threading.Condition protocol: wait()'s release/re-acquire cycle
+    # runs UNTIMED — the time a consumer spends parked on a condition is
+    # queue occupancy, not lock contention, and folding it in would
+    # drown the real contention signal for every condition-backed queue
+    def _release_save(self):
+        self._lock.release()
+
+    def _acquire_restore(self, state) -> None:
+        self._lock.acquire()
+
+    def _is_owned(self) -> bool:
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+
+class _TimedLock(_TimedLockBase):
+    __slots__ = ()
+
+    def __init__(self, site: str):
+        super().__init__(threading.Lock(), site)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+
+class _TimedRLock(_TimedLockBase):
+    __slots__ = ()
+
+    def __init__(self, site: str):
+        super().__init__(threading.RLock(), site)
+
+    # reentrant acquires by the owner succeed on the non-blocking try,
+    # so they never count as contention — exactly right
+
+    def _is_owned(self) -> bool:
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        return self._lock._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._lock._acquire_restore(state)
+
+
+def lock(site: str):
+    """An instrumented ``threading.Lock`` for a named site — or, with
+    profiling disabled, a raw ``threading.Lock`` (the opt-out leaves
+    every hot path at zero wrapper cost; one attribute check here at
+    construction is all that remains)."""
+    if not _STATE.enabled:
+        return threading.Lock()
+    return _TimedLock(site)
+
+
+def rlock(site: str):
+    """Reentrant variant of :func:`lock` (the controller registry)."""
+    if not _STATE.enabled:
+        return threading.RLock()
+    return _TimedRLock(site)
+
+
+def lock_sites() -> Dict[str, Dict[str, Any]]:
+    """Per-site contention rollup, acquisition-ordered by wait time."""
+    with _SITES_LOCK:
+        stats = list(_SITES.values())
+    return {st.site: st.row()
+            for st in sorted(stats, key=lambda s: -s.wait_s_total)}
+
+
+def lock_object(site: str):
+    """The most recently constructed wrapper for a site (None when the
+    site never minted one or it was collected) — the lock-hold TEST HOOK
+    the acceptance criteria name; never used by production code."""
+    with _SITES_LOCK:
+        ref = _SITE_LOCKS.get(site)
+    return ref() if ref is not None else None
+
+
+# --------------------------------------------------------------------- #
+# the sampler
+# --------------------------------------------------------------------- #
+
+class _Sampler:
+    def __init__(self):
+        self.hz = DEFAULT_HZ
+        self.budget = DEFAULT_BUDGET
+        self._table = SpaceSaving(capacity=DEFAULT_BUDGET)
+        self._lock = threading.Lock()   # raw: the sampler must never
+        #                                 recurse into its own telemetry
+        self.samples = 0
+        self.ticks = 0
+        self.started_ts = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # lifecycle lock: ensure_started() races between parallel
+        # CollectTelemetry handlers (two collectors' first pulls land on
+        # the RPC pool concurrently) — without it both spawn a sampler
+        # and every count doubles
+        self._lifecycle = threading.Lock()
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        with self._lifecycle:
+            if self.running():
+                return
+            self._stop.clear()
+            self.started_ts = time.time()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="prof-sampler")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lifecycle:
+            self._stop.set()
+            thread = self._thread
+            if thread is not None:
+                thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        period = 1.0 / max(self.hz, 0.1)
+        while not self._stop.wait(period):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 - a profiler that can
+                # crash the process is worse than none
+                logger.exception("stack sample failed; sampler continues")
+
+    def sample_once(self) -> int:
+        """One sampling tick: fold every live thread's stack (except our
+        own) into the table. Returns the number of stacks folded."""
+        me = threading.get_ident()
+        folded = [fold_frame(frame)
+                  for tid, frame in sys._current_frames().items()
+                  if tid != me]
+        with self._lock:
+            for stack in folded:
+                self._table.offer(stack, 1.0)
+            self.samples += len(folded)
+            self.ticks += 1
+        _M_SAMPLES.inc(len(folded))
+        return len(folded)
+
+    def counts(self) -> Dict[str, float]:
+        with self._lock:
+            return {key: count for key, count, _e, _l in self._table.top(0)}
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            table = self._table.to_dict()
+            samples, ticks = self.samples, self.ticks
+        return {"enabled": True, "hz": self.hz, "budget": self.budget,
+                "samples": samples, "ticks": ticks,
+                "started": round(self.started_ts, 3),
+                "running": self.running(),
+                "stacks": table, "locks": lock_sites()}
+
+    def reset(self) -> None:
+        self.stop()
+        with self._lock:
+            self._table = SpaceSaving(capacity=self.budget)
+            self.samples = 0
+            self.ticks = 0
+            self.started_ts = 0.0
+
+
+class _State:
+    def __init__(self):
+        self.enabled = True   # always-on posture; apply_config re-arms
+
+
+_STATE = _State()
+_SAMPLER = _Sampler()
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def sampling() -> bool:
+    """True while the sampler thread is live (the per-round delta hook
+    gates on this so an unarmed process pays one call)."""
+    return _SAMPLER.running()
+
+
+def configure(enabled: bool = True, hz: float = 0.0,
+              budget: int = 0) -> None:
+    """(Re)arm the process profiler from ``telemetry.prof``: flips the
+    lock factories, sizes the folded-stack table, and starts (or stops)
+    the sampling thread. ``hz``/``budget`` of 0 keep the defaults."""
+    _STATE.enabled = bool(enabled)
+    if not enabled:
+        _SAMPLER.stop()
+        return
+    hz = float(hz or 0.0) or DEFAULT_HZ
+    budget = int(budget or 0) or DEFAULT_BUDGET
+    restart = (_SAMPLER.running()
+               and (hz != _SAMPLER.hz or budget != _SAMPLER.budget))
+    if restart:
+        _SAMPLER.stop()
+    _SAMPLER.hz = hz
+    if budget != _SAMPLER.budget:
+        _SAMPLER.budget = budget
+        with _SAMPLER._lock:
+            fresh = SpaceSaving(capacity=budget)
+            fresh.merge(_SAMPLER._table)
+            _SAMPLER._table = fresh
+    _SAMPLER.start()
+
+
+def ensure_started() -> None:
+    """Lazy arming (the span-ring posture): a process nobody configured
+    starts sampling only once a collector actually pulls it."""
+    if _STATE.enabled and not _SAMPLER.running():
+        _SAMPLER.start()
+
+
+def sample_once() -> int:
+    """One synchronous sampling tick (tests and the smoke gate)."""
+    return _SAMPLER.sample_once()
+
+
+def reset() -> None:
+    """Tests: stop the sampler, clear the table and every site rollup,
+    restore defaults (enabled, not running)."""
+    _SAMPLER.reset()
+    _SAMPLER.hz = DEFAULT_HZ
+    _SAMPLER.budget = DEFAULT_BUDGET
+    with _SAMPLER._lock:
+        _SAMPLER._table = SpaceSaving(capacity=DEFAULT_BUDGET)
+    with _SITES_LOCK:
+        _SITES.clear()
+        _SITE_LOCKS.clear()
+    _STATE.enabled = True
+
+
+def collect_state() -> Dict[str, Any]:
+    """The ``prof`` section of a ``CollectTelemetry`` reply: the
+    cumulative folded-stack table (O(budget)), sampler counters, and the
+    lock-site rollup. ``{"enabled": false}`` stub when opted out."""
+    if not _STATE.enabled:
+        return {"enabled": False}
+    return _SAMPLER.state()
+
+
+def counts_snapshot() -> Dict[str, float]:
+    """Tracked stack counts right now (the per-round delta baseline)."""
+    return _SAMPLER.counts()
+
+
+def delta(prev: Dict[str, float], now: Optional[Dict[str, float]] = None,
+          top: int = 10) -> Dict[str, Any]:
+    """Folded-stack growth between two :func:`counts_snapshot` maps —
+    the RoundProfile's per-round profile. Eviction can shrink a tracked
+    count; negative deltas clamp to 0 (a stack cannot un-run)."""
+    if now is None:
+        now = counts_snapshot()
+    grown = [[stack, count - prev.get(stack, 0.0)]
+             for stack, count in now.items()
+             if count - prev.get(stack, 0.0) > 0.0]
+    grown.sort(key=lambda row: (-row[1], row[0]))
+    return {"samples": round(sum(d for _s, d in grown), 1),
+            "stacks": [[stack, round(d, 1)] for stack, d in grown[:top]]}
+
+
+# --------------------------------------------------------------------- #
+# folded-table analytics (perf --flame / status --fleet share these)
+# --------------------------------------------------------------------- #
+
+def folded_counts(state: Dict[str, Any]) -> Dict[str, float]:
+    """``{folded_stack: count}`` from a ``collect_state()`` dict."""
+    stacks = state.get("stacks") or {}
+    if isinstance(stacks, dict) and "rows" in stacks:
+        return {str(key): float(count)
+                for key, count, _e, _l in SpaceSaving.from_dict(
+                    stacks).top(0)}
+    # already-flat map (per-round deltas, merged fleet dumps)
+    return {str(k): float(v) for k, v in dict(stacks).items()}
+
+
+def frame_table(folded: Dict[str, float]) -> List[Dict[str, Any]]:
+    """Per-frame self/total sample rows from a folded-stack map (self =
+    samples where the frame is the leaf; total = samples in any stack
+    containing it), self-descending — the terminal top-table."""
+    self_n: Dict[str, float] = {}
+    total_n: Dict[str, float] = {}
+    grand = 0.0
+    for stack, count in folded.items():
+        frames = [f for f in stack.split(";") if f]
+        if not frames:
+            continue
+        grand += count
+        self_n[frames[-1]] = self_n.get(frames[-1], 0.0) + count
+        for frame in set(frames):
+            total_n[frame] = total_n.get(frame, 0.0) + count
+    rows = [{"frame": frame,
+             "self": self_n.get(frame, 0.0),
+             "total": total,
+             "self_pct": (100.0 * self_n.get(frame, 0.0) / grand
+                          if grand else 0.0),
+             "total_pct": 100.0 * total / grand if grand else 0.0}
+            for frame, total in total_n.items()]
+    rows.sort(key=lambda r: (-r["self"], -r["total"], r["frame"]))
+    return rows
+
+
+def summarize_state(state: Dict[str, Any]) -> Dict[str, Any]:
+    """One-line summary of a peer's profile for ``status --fleet``: the
+    hottest frame by self time and the most contended lock site."""
+    out: Dict[str, Any] = {
+        "enabled": bool(state.get("enabled", False)),
+        "samples": int(state.get("samples", 0) or 0),
+        "hz": float(state.get("hz", 0.0) or 0.0),
+    }
+    rows = frame_table(folded_counts(state))
+    if rows:
+        out["top_frame"] = rows[0]["frame"]
+        out["top_frame_pct"] = round(rows[0]["self_pct"], 1)
+    locks = state.get("locks") or {}
+    if locks:
+        site = max(locks, key=lambda s: locks[s].get("wait_s_total", 0.0))
+        row = locks[site]
+        if row.get("contentions"):
+            out["top_lock"] = site
+            out["top_lock_wait_ms"] = round(
+                1e3 * float(row.get("wait_s_total", 0.0)), 3)
+            out["contentions"] = int(row.get("contentions", 0))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# post-mortem snapshot (telemetry/postmortem.py bundles this)
+# --------------------------------------------------------------------- #
+
+def postmortem_snapshot(top: int = 10) -> Optional[Dict[str, Any]]:
+    """The profiler's view at death: top-table rows + the lock-site
+    rollup (None when disabled or nothing was ever sampled AND no lock
+    ever contended — a silent bundle key beats an empty section)."""
+    if not _STATE.enabled:
+        return None
+    state = _SAMPLER.state()
+    locks = state["locks"]
+    if not state["samples"] and not any(
+            row.get("acquisitions") for row in locks.values()):
+        return None
+    rows = frame_table(folded_counts(state))[:top]
+    return {"samples": state["samples"], "ticks": state["ticks"],
+            "hz": state["hz"],
+            "top": [{k: (round(v, 2) if isinstance(v, float) else v)
+                     for k, v in row.items()} for row in rows],
+            "locks": locks}
+
+
+# --------------------------------------------------------------------- #
+# CI overhead gate (scripts/chaos_smoke.sh --prof-smoke stanza)
+# --------------------------------------------------------------------- #
+
+def _smoke_round_loop(nlock, blocks: int = 1000) -> float:
+    """One bench-shaped aggregation round: stride-blocked stacked scaled
+    adds over synthetic models, each block under a (possibly
+    instrumented) lock — the controller fold loop's shape. Sized to run
+    a few hundred ms, long enough that the 67 Hz sampler ticks dozens of
+    times inside one trial. Returns the wall seconds."""
+    import numpy as np
+
+    from metisfl_tpu.aggregation.base import np_stacked_scaled_add
+
+    rng = np.random.default_rng(5)
+    model = {"w": rng.standard_normal((2048, 1024)).astype(np.float32),
+             "b": rng.standard_normal((1024,)).astype(np.float32)}
+    block = [model, model, model, model]
+    scales = [0.25, 0.25, 0.25, 0.25]
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(blocks):
+        with nlock:
+            acc = np_stacked_scaled_add(acc, block, scales)
+    return time.perf_counter() - t0
+
+
+def _smoke(bound_pct: float = 3.0, trials: int = 7) -> int:
+    """The CI overhead gate: the bench round loop with profiling ON
+    (sampler at the default 67 Hz + an instrumented lock on the fold
+    path) vs OFF, ``trials`` interleaved runs each, MINIMA judged.
+    Fails (exit 1) when the ON minimum exceeds the OFF minimum by more
+    than ``bound_pct`` percent, when the sampler collected nothing, or
+    when the fold kernel's frame never showed up — an overhead gate
+    that can pass while the profiler is blind would gate nothing."""
+    reset()
+    failures: List[str] = []
+    # warm-up outside the measurement (numpy allocator, code paths)
+    _smoke_round_loop(threading.Lock())
+
+    off_s: List[float] = []
+    on_s: List[float] = []
+    for _ in range(trials):
+        configure(enabled=False)
+        off_s.append(_smoke_round_loop(lock("prof.smoke")))
+        configure(enabled=True)  # default 67 Hz — the gated config
+        on_s.append(_smoke_round_loop(lock("prof.smoke")))
+    state = collect_state()
+    configure(enabled=False)
+
+    # judge the MINIMA: the profiler's cost is constant per trial, so it
+    # survives in the min, while scheduler/BLAS noise only inflates
+    # individual trials — medians on this gVisor-class host swing ±5%
+    # run-to-run, which would flap a 3% gate (reported for context)
+    off_ms = min(off_s) * 1e3
+    on_ms = min(on_s) * 1e3
+    overhead_pct = (100.0 * (on_ms - off_ms) / off_ms) if off_ms else 0.0
+    if overhead_pct > bound_pct:
+        failures.append(
+            f"profiling overhead {overhead_pct:.2f}% exceeds the "
+            f"{bound_pct:.1f}% bound (off {off_ms:.1f}ms, on "
+            f"{on_ms:.1f}ms)")
+    if not state.get("samples"):
+        failures.append("sampler collected no stacks during the ON runs")
+    table = frame_table(folded_counts(state))
+    if not any("np_stacked_scaled_add" in row["frame"] for row in table):
+        failures.append("fold kernel frame missing from the profile "
+                        "(sampler ran blind)")
+    summary = {
+        "trials": trials,
+        "off_ms_min": round(off_ms, 2),
+        "on_ms_min": round(on_ms, 2),
+        "off_ms_median": round(statistics.median(off_s) * 1e3, 2),
+        "on_ms_median": round(statistics.median(on_s) * 1e3, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": bound_pct,
+        "samples": state.get("samples", 0),
+        "ticks": state.get("ticks", 0),
+        "stacks_tracked": len(folded_counts(state)),
+        "top_frame": table[0]["frame"] if table else "",
+        "failures": failures,
+    }
+    print(json.dumps(summary, indent=2))
+    return 1 if failures else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        "metisfl_tpu.telemetry.prof",
+        description="continuous-profiling utilities")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the CI overhead gate (bench round loop "
+                             "prof on vs off; exit 1 past the bound)")
+    parser.add_argument("--bound-pct", type=float, default=3.0,
+                        help="smoke: maximum tolerated overhead percent")
+    parser.add_argument("--trials", type=int, default=7,
+                        help="smoke: interleaved trials per side "
+                             "(minima judged; medians reported)")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        return _smoke(bound_pct=args.bound_pct, trials=args.trials)
+    parser.print_usage()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -267,6 +267,11 @@ class RoundProfile:
     learners: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     totals: Dict[str, float] = field(default_factory=dict)
     serving: Dict[str, Any] = field(default_factory=dict)
+    # per-round folded-stack delta from the continuous profiler
+    # (telemetry/prof.py): which frames grew while this round ran —
+    # {"samples": N, "stacks": [[folded_stack, delta], ...]}. Empty when
+    # the sampler is off; perf --flame-diff run@A run@B diffs rounds.
+    prof: Dict[str, Any] = field(default_factory=dict)
     # jax.profiler capture armed for this round (trace_every_rounds)
     trace_armed: bool = False
     schema: int = SCHEMA_VERSION
@@ -319,6 +324,9 @@ class ProfileCollector:
         # cumulative codec-attribution snapshot at the last round close
         # (comm/codec.py keeps the process totals; per-round = delta)
         self._codec_snapshot: Dict[Any, float] = {}
+        # cumulative folded-stack snapshot at the last round close
+        # (telemetry/prof.py sampler; per-round profile = delta)
+        self._prof_snapshot: Optional[Dict[str, float]] = None
         # bounded recent-profile tail (post-mortem bundles, describe())
         self._tail: List[dict] = []
         self._tail_limit = 16
@@ -502,6 +510,20 @@ class ProfileCollector:
                 profile.serving = dict(self.serving_probe() or {})
             except Exception:  # noqa: BLE001 - a probe never fails a round
                 logger.exception("serving occupancy probe failed")
+        try:
+            # per-round folded-stack delta (telemetry/prof.py): one
+            # attribute check + a dict diff when the sampler is live,
+            # nothing otherwise
+            from metisfl_tpu.telemetry import prof as _prof
+
+            if _prof.sampling():
+                counts = _prof.counts_snapshot()
+                if self._prof_snapshot is not None:
+                    profile.prof = _prof.delta(self._prof_snapshot,
+                                               counts)
+                self._prof_snapshot = counts
+        except Exception:  # noqa: BLE001 - profiling is best-effort
+            logger.exception("round profile stack delta failed")
         record = profile.to_dict()
         with self._lock:
             self._tail.append(record)

@@ -91,4 +91,24 @@ case "$rc" in
           "(rc=$rc)" >&2
      rc=2 ;;
 esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
+# ISSUE 13 continuous-profiling overhead gate (docs/OBSERVABILITY.md
+# "Continuous profiling"): the bench round loop with the sampler (67 Hz
+# default) + instrumented locks ON vs OFF, interleaved trials, minima
+# judged. The build fails when profiling costs more than the pinned 3%
+# bound, when the sampler collects nothing, or when the fold kernel's
+# frame never appears in the profile (a blind profiler gates nothing).
+JAX_PLATFORMS=cpu timeout -k 10 120 "$PYTHON" -m metisfl_tpu.telemetry \
+  --prof-smoke --bound-pct 3
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: prof-overhead PASS (sampler + lock telemetry" \
+          "within the 3% bound, hot frames visible in the profile)" ;;
+  1) echo "chaos_smoke: prof-overhead FAIL — profiling overhead past the" \
+          "bound or the sampler ran blind (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: prof-overhead FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
 exit "$rc"

@@ -118,9 +118,13 @@ def test_clock_sync_rtt_gate_rejects_outlier_samples():
 # --------------------------------------------------------------------- #
 
 def test_trace_span_ring_cursor(clean_fabric):
+    # the seq counter deliberately survives reconfigures (collector
+    # cursors stay monotone): anchor on the live cursor, not 0 — an
+    # earlier test in the same process may have recorded ring spans
+    _, base, _ = ttrace.spans_since(0)
     for i in range(4):
         ttrace.event(f"work/{i}", 0.001)
-    batch, cursor, lost = ttrace.spans_since(0)
+    batch, cursor, lost = ttrace.spans_since(base)
     assert [r["name"] for r in batch] == [f"work/{i}" for i in range(4)]
     assert cursor == batch[-1]["seq"] and lost == 0
     # incremental: only the new span comes back, cursor advances

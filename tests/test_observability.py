@@ -149,6 +149,57 @@ def test_postmortem_unconfigured_is_noop(journal):
     assert rec.dump("whatever") is None
 
 
+def test_postmortem_bundle_carries_profiler_snapshot(journal, tmp_path,
+                                                     capsys):
+    """ISSUE 13 satellite: a crash/chaos-kill bundle must carry the
+    continuous profiler's top-table and lock-contention snapshot at
+    death, and the --postmortem viewer must render them (the
+    chaos-kill path calls the same ``dump()`` this exercises)."""
+    import threading
+    import time
+
+    from metisfl_tpu.telemetry import prof as tprof
+    from metisfl_tpu.telemetry.__main__ import main as viewer_main
+
+    tprof.reset()
+    try:
+        tprof.configure(enabled=True)
+        lk = tprof.lock("pm.site")
+
+        def holder():
+            with lk:
+                time.sleep(0.08)
+
+        thread = threading.Thread(target=holder)
+        thread.start()
+        time.sleep(0.01)
+        with lk:  # contended: the snapshot must show the wait
+            pass
+        thread.join()
+        for _ in range(5):
+            tprof.sample_once()
+        tpostmortem.configure(str(tmp_path), service="unit",
+                              install_hooks=False)
+        path = tpostmortem.dump("chaos_kill")
+        assert path is not None
+        bundle = json.load(open(path))
+        assert bundle["prof"]["samples"] > 0
+        assert bundle["prof"]["top"], "top-table missing from bundle"
+        assert bundle["prof"]["locks"]["pm.site"]["contentions"] >= 1
+        assert viewer_main(["--postmortem", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "profiler at death" in out
+        assert "lock contention at death" in out and "pm.site" in out
+        # disabled profiler → no prof section at all (stub posture)
+        tprof.configure(enabled=False)
+        bundle2 = json.load(open(tpostmortem.dump("chaos_kill_again")))
+        assert "prof" not in bundle2
+    finally:
+        tpostmortem.configure("", service="unit", install_hooks=False)
+        tprof.reset()
+        tprof.configure(enabled=False)
+
+
 # --------------------------------------------------------------------- #
 # live status plane
 # --------------------------------------------------------------------- #
