@@ -924,6 +924,155 @@ def bench_serving(requests: int = 64, rows_per_request: int = 4,
     }
 
 
+def bench_fleet(replica_counts=(1, 2, 4), requests: int = 96,
+                rows_per_request: int = 4, threads: int = 8,
+                decode_prompts=(8, 64), decode_new: int = 24):
+    """Serving-fleet section (serving/fleet.py, docs/DEPLOYMENT.md
+    "Serving fleet"): router-fronted throughput vs replica count over
+    REAL gRPC loopback (in-process gateways + router, wire-realistic
+    client traffic), the worst request latency observed during a
+    zero-drop ROLLING hot-swap across the fleet, and continuous-batching
+    decode tokens/s at two prompt lengths (serving/decode.py)."""
+    import threading as _threading
+
+    from metisfl_tpu.config import (ServingConfig, ServingDecodeConfig,
+                                    ServingFleetConfig)
+    from metisfl_tpu.models import FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.models.zoo.transformer import LlamaLite
+    from metisfl_tpu.serving import (ContinuousBatcher, RouterServer,
+                                     ServingClient, ServingGateway,
+                                     ServingRouter, ServingServer)
+    from metisfl_tpu.tensor.pytree import pack_model
+
+    dim = 64
+    ops = FlaxModelOps(MLP(features=(256, 256), num_outputs=16),
+                       np.zeros((2, dim), np.float32), rng_seed=0)
+    blob = pack_model(ops.get_variables())
+    cfg = ServingConfig(enabled=True, max_batch=16, max_wait_ms=0.5,
+                        fleet=ServingFleetConfig(enabled=True))
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((rows_per_request, dim)).astype(np.float32)
+          for _ in range(requests)]
+    out = {"fleet_requests": requests,
+           "fleet_replica_counts": list(replica_counts)}
+
+    def _boot(n):
+        gateways, servers = [], []
+        for _ in range(n):
+            gw = ServingGateway(ops, cfg)
+            gw.install("stable", 1, blob)
+            srv = ServingServer(gw, host="127.0.0.1", port=0)
+            port = srv.start()
+            gateways.append(gw)
+            servers.append((srv, port))
+        router = ServingRouter(cfg)
+        for i, (_, port) in enumerate(servers):
+            router.add_replica(f"r{i}", "127.0.0.1", port)
+        rserver = RouterServer(router, host="127.0.0.1", port=0)
+        rport = rserver.start()
+        return gateways, servers, rserver, rport
+
+    def _drive(rport, tag):
+        client = ServingClient("127.0.0.1", rport)
+        client.predict(xs[0], key="warmup")  # compile outside the window
+        client.close()
+        t0 = time.perf_counter()
+        errs = []
+
+        def worker(w):
+            cl = ServingClient("127.0.0.1", rport)
+            try:
+                for i in range(w, requests, threads):
+                    cl.predict(xs[i], key=f"{tag}{i}")
+            except Exception as exc:  # noqa: BLE001 - recorded, fatal
+                errs.append(exc)
+            finally:
+                cl.close()
+
+        ts = [_threading.Thread(target=worker, args=(w,))
+              for w in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return time.perf_counter() - t0
+
+    for n in replica_counts:
+        gateways, servers, rserver, rport = _boot(n)
+        try:
+            elapsed = _drive(rport, f"n{n}_")
+            out[f"fleet_router_rows_per_sec_r{n}"] = round(
+                requests * rows_per_request / elapsed, 1)
+        finally:
+            rserver.stop()
+            for srv, _ in servers:
+                srv.stop()
+
+    # rolling hot-swap across 2 replicas under hammer: worst request
+    # latency while replicas swap ONE AT A TIME (the staggered-poll
+    # posture), plus the total roll duration
+    gateways, servers, rserver, rport = _boot(2)
+    try:
+        cl = ServingClient("127.0.0.1", rport)
+        cl.predict(xs[0], key="warmup")
+        stop = _threading.Event()
+        worst_ms = [0.0]
+
+        def hammer():
+            h = ServingClient("127.0.0.1", rport)
+            i = 0
+            while not stop.is_set():
+                t1 = time.perf_counter()
+                h.predict(xs[i % len(xs)], key=f"h{i}")
+                worst_ms[0] = max(worst_ms[0],
+                                  (time.perf_counter() - t1) * 1e3)
+                i += 1
+            h.close()
+
+        t = _threading.Thread(target=hammer)
+        t.start()
+        time.sleep(0.05)
+        t0 = time.perf_counter()
+        for gw in gateways:            # one replica at a time
+            gw.install("stable", 2, blob)
+        roll_s = time.perf_counter() - t0
+        time.sleep(0.05)
+        stop.set()
+        t.join()
+        cl.close()
+        out["fleet_rolling_swap_ms"] = round(roll_s * 1e3, 3)
+        out["fleet_rolling_swap_worst_request_ms"] = round(worst_ms[0], 3)
+    finally:
+        rserver.stop()
+        for srv, _ in servers:
+            srv.stop()
+
+    # continuous-batching decode throughput at two prompt lengths
+    module = LlamaLite(vocab_size=512, dim=64, depth=2, heads=4)
+    lm_ops = FlaxModelOps(module, np.zeros((1, 8), np.int32), rng_seed=0)
+    for plen in decode_prompts:
+        engine = ContinuousBatcher(
+            lm_ops, 1, lm_ops.get_variables(),
+            slots=ServingDecodeConfig().slots,
+            max_len=plen + decode_new + 1, channel=f"bench{plen}")
+        try:
+            prompt = rng.integers(1, 512, size=(plen,)).astype(np.int32)
+            engine.submit(prompt, 4).result(timeout=120.0)  # compile
+            t0 = time.perf_counter()
+            futs = [engine.submit(
+                rng.integers(1, 512, size=(plen,)).astype(np.int32),
+                decode_new) for _ in range(8)]
+            toks = sum(len(f.result(timeout=120.0)[0]) for f in futs)
+            out[f"fleet_decode_tokens_per_sec_p{plen}"] = round(
+                toks / (time.perf_counter() - t0), 1)
+        finally:
+            engine.close()
+    return out
+
+
 def bench_cohort(sizes=(1024, 4096), stride: int = 64,
                  ingest_workers=(1, 4, 16)):
     """Cohort-scale ingest + fold (VERDICT r4 #6 / weak #5, docs/SCALE.md):
@@ -1482,6 +1631,7 @@ _SECTIONS = {
     "fabric": lambda a: bench_fabric(),
     "prof": lambda a: bench_prof(),
     "tree_dist": lambda a: bench_tree_dist(),
+    "fleet": lambda a: bench_fleet(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1709,7 +1859,7 @@ _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "e2e": 600, "cohort": 1200, "health": 240,
                      "serving": 300, "churn": 240, "obs": 240,
                      "fabric": 240, "prof": 240, "tree_dist": 300,
-                     "lora": 600}
+                     "fleet": 300, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1757,7 +1907,7 @@ _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
 _HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
-                  "obs", "fabric", "prof", "tree_dist")
+                  "obs", "fabric", "prof", "tree_dist", "fleet")
 def _default_partial_path() -> str:
     """Where the crash-durable partials land by default:
     ``bench_results/`` — NOT the repo root. Three separate rounds shipped

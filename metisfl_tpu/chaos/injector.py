@@ -107,7 +107,15 @@ class FaultRule:
     trigger the re-homing acceptance gate (tests/test_slice.py,
     scripts/chaos_smoke.sh) is built on. Supervised relaunches run clean
     (driver arms original incarnations only), so re-homing + re-adoption
-    can be proven to converge."""
+    can be proven to converge.
+
+    Kill-at-serving-replica: the serving fleet routes the same way —
+    ``process="serving"`` arms every gateway replica,
+    ``"serving_<idx>"`` exactly one, ``"router"`` the consistent-hash
+    router. A killed replica's keys fall to the next hash owners and
+    the driver's supervised relaunch re-pins it via its first registry
+    poll (the replica-kill gate in serving/smoke.py exercises the same
+    path with a raw SIGKILL)."""
 
     fault: str                    # drop | delay | hang | corrupt | kill |
                                   # flap | slow | partition
@@ -115,7 +123,8 @@ class FaultRule:
     service: str = ""
     method: str = ""
     process: str = ""             # controller | learner | learner_<idx> |
-                                  # serving | slice | slice_<idx>
+                                  # serving | serving_<idx> | router |
+                                  # slice | slice_<idx>
     prob: float = 1.0             # firing probability per eligible call
     after_calls: int = 0          # skip the first N matching calls
     max_fires: int = 0            # 0 = unlimited

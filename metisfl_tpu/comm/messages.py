@@ -351,6 +351,33 @@ class ServeReply(Message):
 
 
 @dataclass
+class GenerateRequest(Message):
+    """Serving-gateway generation request (serving/decode.py): an
+    autoregressive continuation of ``prompt`` through the gateway's
+    continuous-batching decode loop. Greedy by contract — a shared
+    in-flight batch cannot reproduce any single request's sampling
+    stream, and serving replies must be replica-independent."""
+
+    request_id: str = ""
+    # deterministic canary/consistent-hash routing key (see ServeRequest)
+    key: str = ""
+    prompt: bytes = b""         # packed {"tokens": (L,) int32} ModelBlob
+    max_new_tokens: int = 16
+    eos_id: int = -1            # < 0 = no early stop
+
+
+@dataclass
+class GenerateReply(Message):
+    request_id: str = ""
+    # packed {"tokens": (max_new_tokens,) int32} ModelBlob; pad (0) after
+    # an emitted eos — models/generate.py's exact contract
+    tokens: bytes = b""
+    model_version: int = 0
+    channel: str = ""
+    duration_ms: float = 0.0
+
+
+@dataclass
 class InferResult(Message):
     task_id: str = ""
     learner_id: str = ""

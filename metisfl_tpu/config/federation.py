@@ -430,6 +430,66 @@ class RegistryConfig:
 
 
 @dataclass
+class ServingFleetConfig:
+    """Replicated serving fleet (serving/fleet.py, docs/DEPLOYMENT.md
+    "Serving fleet"): N driver-booted gateway replicas behind a
+    consistent-hash router process (``python -m metisfl_tpu.serving
+    --router``). Key-stable routing keeps the crc32 canary split
+    globally coherent across replicas; replicas stagger their registry
+    polls deterministically so a promotion rolls through the fleet one
+    replica at a time; the router drains around dead/draining replicas
+    with bounded retry to the next hash owner. ``enabled=false``
+    (default) keeps PR 5's single supervised gateway exactly as it
+    was."""
+
+    enabled: bool = False
+    # replicas booted at launch (the autoscaler moves the live count
+    # within [min_replicas, max_replicas] afterwards)
+    replicas: int = 2
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # router gRPC port (0: the driver picks a free one and points
+    # serving.port — what serving_client() dials — at it)
+    router_port: int = 0
+    # consistent-hash virtual nodes per replica (keyspace smoothing)
+    vnodes: int = 64
+    # bounded retry past the hash owner when it fails at call time
+    retry_hops: int = 2
+    # router health-probe cadence over the replica fleet
+    probe_every_s: float = 1.0
+    # autoscaler rules (telemetry/alerts.py AlertRule schema, kinds
+    # value|rate, evaluated over fleet-summed serving_* families by the
+    # driver): scale_up firing boots a replica, scale_down drains one.
+    # Empty = no autoscaler. Example:
+    #   scale_up: {metric: serving_requests_total, kind: rate,
+    #              window_s: 10, op: ">", threshold: 50, for_s: 2}
+    scale_up: Dict[str, Any] = field(default_factory=dict)
+    scale_down: Dict[str, Any] = field(default_factory=dict)
+    # minimum seconds between scale actions (flap damping)
+    scale_cooldown_s: float = 30.0
+    # replica endpoints [{name, host, port}]; the driver fills one per
+    # replica when left empty (operators running their own fleet list
+    # them explicitly)
+    gateways: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class ServingDecodeConfig:
+    """Continuous-batching autoregressive decode (serving/decode.py):
+    the gateway's ``Generate`` endpoint schedules a slot-based
+    in-flight batch at step granularity over the KV-cache programs in
+    models/generate.py — finished sequences retire and queued prompts
+    join between decode steps, one jitted step program at fixed slot
+    shapes. Greedy by contract; output is bit-identical to a solo
+    ``generate`` call at the same ``max_len``."""
+
+    # concurrent sequences per channel's in-flight batch
+    slots: int = 4
+    # KV-cache length: every request's prompt + max_new_tokens must fit
+    max_len: int = 512
+
+
+@dataclass
 class ServingConfig:
     """Serving gateway (serving/gateway.py): a driver-bootable process
     (``python -m metisfl_tpu.serving``) serving inference over the
@@ -456,6 +516,10 @@ class ServingConfig:
     # which learner recipe builds the gateway's model engine (the forward
     # pass needs the same architecture the federation trains)
     recipe_index: int = 0
+    # replicated fleet behind a consistent-hash router (serving/fleet.py)
+    fleet: ServingFleetConfig = field(default_factory=ServingFleetConfig)
+    # continuous-batching decode for the Generate endpoint
+    decode: ServingDecodeConfig = field(default_factory=ServingDecodeConfig)
 
 
 @dataclass
@@ -644,6 +708,67 @@ class FederationConfig:
                 # a negative index would silently pick a recipe from the
                 # END of the driver's list via Python indexing
                 raise ValueError("serving.recipe_index must be >= 0")
+            if self.serving.decode.slots < 1:
+                raise ValueError("serving.decode.slots must be >= 1")
+            if self.serving.decode.max_len < 2:
+                # one prompt token + one generated token is the minimum
+                # generation the cache must hold
+                raise ValueError("serving.decode.max_len must be >= 2")
+            fleet = self.serving.fleet
+            if fleet.enabled:
+                if fleet.min_replicas < 1:
+                    raise ValueError(
+                        "serving.fleet.min_replicas must be >= 1")
+                if fleet.max_replicas < fleet.min_replicas:
+                    raise ValueError(
+                        "serving.fleet.max_replicas must be >= "
+                        "min_replicas")
+                if not (fleet.min_replicas <= fleet.replicas
+                        <= fleet.max_replicas):
+                    raise ValueError(
+                        "serving.fleet.replicas must lie within "
+                        "[min_replicas, max_replicas]")
+                if fleet.vnodes < 1:
+                    raise ValueError("serving.fleet.vnodes must be >= 1")
+                if fleet.retry_hops < 0:
+                    raise ValueError(
+                        "serving.fleet.retry_hops must be >= 0")
+                if fleet.probe_every_s <= 0.0:
+                    raise ValueError(
+                        "serving.fleet.probe_every_s must be > 0")
+                if fleet.scale_cooldown_s < 0.0:
+                    raise ValueError(
+                        "serving.fleet.scale_cooldown_s must be >= 0")
+                if fleet.scale_up or fleet.scale_down:
+                    # a typo'd scale rule must fail at config time, not
+                    # at the first traffic surge (the alert/chaos-rule
+                    # posture); quantile kinds are rejected inside —
+                    # a scraped family sum has no digest to read
+                    from metisfl_tpu.serving.fleet import FleetAutoscaler
+                    try:
+                        FleetAutoscaler(
+                            fleet.scale_up or None,
+                            fleet.scale_down or None,
+                            fleet.min_replicas, fleet.max_replicas,
+                            cooldown_s=fleet.scale_cooldown_s)
+                    except (TypeError, ValueError) as exc:
+                        raise ValueError(
+                            f"invalid serving.fleet scale rule: "
+                            f"{exc}") from None
+        fleet = self.serving.fleet
+        if fleet.enabled and not self.serving.enabled:
+            # the silently-armed-nothing posture (quorum/overprovision):
+            # a fleet block on a disabled serving plane boots nothing
+            raise ValueError(
+                "serving.fleet.enabled requires serving.enabled")
+        if ((fleet.scale_up or fleet.scale_down)
+                and not fleet.enabled):
+            # scale rules only drive the fleet autoscaler — accepting
+            # them alone would silently arm nothing
+            raise ValueError(
+                "serving.fleet.scale_up/scale_down require "
+                "serving.fleet.enabled (the autoscaler boots and drains "
+                "fleet replicas)")
         if not 0.0 < self.telemetry.health.alpha <= 1.0:
             # a typo'd blend weight would silently freeze (0) or unsmooth
             # (>1 oscillates) every divergence score

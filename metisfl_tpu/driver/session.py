@@ -52,6 +52,12 @@ _M_CTRL_RESTARTS = _tmetrics.registry().counter(
 _M_GATEWAY_RESTARTS = _tmetrics.registry().counter(
     _tel.M_GATEWAY_RESTARTS_TOTAL,
     "Supervised serving-gateway relaunches after a crash")
+_M_FLEET_REPLICAS = _tmetrics.registry().gauge(
+    _tel.M_SERVING_FLEET_REPLICAS,
+    "Serving-fleet replica count as the autoscaler maintains it")
+_M_SCALE_TOTAL = _tmetrics.registry().counter(
+    _tel.M_SERVING_SCALE_TOTAL,
+    "Autoscaler actions on the serving fleet", ("direction",))
 
 
 @dataclass
@@ -190,17 +196,22 @@ class DriverSession:
         self._known_endpoints: List[dict] = []
         # controller crash-failover supervision state
         self._controller_restarts = 0
-        self._gateway_restarts = 0
-        # earliest wall-clock for the next gateway relaunch (doubling,
-        # capped): a deterministically-crashing gateway must not
-        # crash-loop at the monitor's poll rate — but unlike the
+        # serving supervision state, PER PROCESS NAME ("serving" for the
+        # single gateway; "serving_<idx>" per fleet replica; "router"):
+        # doubling capped backoff — a deterministically-crashing gateway
+        # must not crash-loop at the monitor's poll rate, but unlike the
         # controller it never fails the run (serving is auxiliary)
-        self._gateway_restart_after = 0.0
+        self._serving_restarts: Dict[str, int] = {}
+        self._serving_restart_after: Dict[str, float] = {}
+        # serving-fleet autoscaler (serving/fleet.py FleetAutoscaler):
+        # constructed at initialize when scale rules are configured
+        self._autoscaler = None
         self._shutting_down = False
         # chaos arms ORIGINAL incarnations only (see _chaos_env): learner
         # indices that already got their armed launch
         self._chaos_armed_learners: set = set()
         self._chaos_armed_slices: set = set()
+        self._chaos_armed_serving: set = set()
         # slice-aggregator supervision (stateless-ish relaunch: the spool
         # persists on disk and the controller re-adopts a relaunched
         # aggregator at its next round's assign). PER-SLICE counters and
@@ -347,24 +358,46 @@ class DriverSession:
         if self.config.checkpoint.dir:
             os.makedirs(self.config.checkpoint.dir, exist_ok=True)
 
-        # serving gateway: the config file below ships to the gateway
-        # process too, so its port must be pinned BEFORE the write — an
-        # ephemeral bind would leave the driver (and clients) unable to
-        # dial it for shutdown or traffic
-        if self.config.serving.enabled and not self.config.serving.port:
-            if (self.config.controller_host or
-                    "localhost") not in self._LOCAL_HOSTS:
+        # serving gateway/fleet: the config file below ships to the
+        # gateway (and router) processes too, so every port must be
+        # pinned BEFORE the write — an ephemeral bind would leave the
+        # driver (and clients) unable to dial it for shutdown or traffic
+        if self.config.serving.enabled:
+            fleet = self.config.serving.fleet
+            needs_ports = (not self.config.serving.port
+                           or (fleet.enabled
+                               and (not fleet.router_port
+                                    or not fleet.gateways)))
+            if needs_ports and (self.config.controller_host or
+                                "localhost") not in self._LOCAL_HOSTS:
                 # same guard as the multi-host coordinator port: a port
                 # probed on the driver machine says nothing about the
                 # remote host the gateway will bind on
                 raise ValueError(
                     "serving on remote host "
-                    f"{self.config.controller_host!r} requires an "
-                    "explicit serving.port")
+                    f"{self.config.controller_host!r} requires explicit "
+                    "serving ports (serving.port / serving.fleet."
+                    "router_port + gateways)")
             import socket as _socket
-            with _socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                self.config.serving.port = s.getsockname()[1]
+
+            def _free_port() -> int:
+                with _socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    return s.getsockname()[1]
+
+            if fleet.enabled:
+                if not fleet.gateways:
+                    fleet.gateways = [
+                        {"name": f"serving_{idx}", "host": "localhost",
+                         "port": _free_port()}
+                        for idx in range(fleet.replicas)]
+                if not fleet.router_port:
+                    fleet.router_port = _free_port()
+                # serving.port is what serving_client() (and every other
+                # consumer) dials — in a fleet that is the ROUTER
+                self.config.serving.port = fleet.router_port
+            elif not self.config.serving.port:
+                self.config.serving.port = _free_port()
 
         # distributed slice aggregators (aggregation/slice.py): pin their
         # endpoints + spool dirs BEFORE the config write — the config
@@ -428,7 +461,14 @@ class DriverSession:
         for idx in range(len(self.learner_recipes)):
             self.launch_learner(idx)
         if self.config.serving.enabled:
-            self._launch_gateway()
+            fleet = self.config.serving.fleet
+            if fleet.enabled:
+                for idx in range(len(fleet.gateways)):
+                    self._launch_gateway(idx)
+                self._launch_router()
+                self._setup_autoscaler()
+            else:
+                self._launch_gateway()
         self._start_fleet_collector()
         self._started_at = time.time()
 
@@ -466,10 +506,28 @@ class DriverSession:
                           "role": "learner"})
         if self.config.serving.enabled and self.config.serving.port:
             from metisfl_tpu.serving.service import SERVING_SERVICE
-            specs.append({"name": "serving", "host": ctrl_host,
-                          "port": self.config.serving.port,
-                          "service_name": SERVING_SERVICE,
-                          "role": "serving"})
+            fleet = self.config.serving.fleet
+            if fleet.enabled:
+                # router + EVERY gateway replica as role="serving" peers:
+                # the fabric pulls (spans/events/metrics/prof) cover the
+                # whole fleet and status --fleet prints per-replica
+                # prof: lines
+                specs.append({"name": "router", "host": ctrl_host,
+                              "port": fleet.router_port,
+                              "service_name": SERVING_SERVICE,
+                              "role": "serving"})
+                for idx, spec in enumerate(fleet.gateways):
+                    specs.append({
+                        "name": spec.get("name") or f"serving_{idx}",
+                        "host": spec.get("host", "localhost"),
+                        "port": spec["port"],
+                        "service_name": SERVING_SERVICE,
+                        "role": "serving"})
+            else:
+                specs.append({"name": "serving", "host": ctrl_host,
+                              "port": self.config.serving.port,
+                              "service_name": SERVING_SERVICE,
+                              "role": "serving"})
         tree = self.config.aggregation.tree
         if tree.enabled and tree.distributed:
             from metisfl_tpu.aggregation.slice import SLICE_SERVICE
@@ -613,10 +671,11 @@ class DriverSession:
                 cloudpickle.dump(self.learner_recipes[idx], f)
         return path
 
-    def _launch_gateway(self) -> _Proc:
-        """(Re)launch the serving gateway (serving/__main__.py). It needs
-        no state handoff: the first registry poll pins a relaunch back to
-        the last promoted stable version."""
+    def _launch_gateway(self, replica: Optional[int] = None) -> _Proc:
+        """(Re)launch a serving gateway (serving/__main__.py) — the
+        single supervised gateway (``replica=None``) or fleet replica
+        ``replica``. It needs no state handoff: the first registry poll
+        pins a relaunch back to the last promoted stable version."""
         cfg = self.config.serving
         if cfg.recipe_index >= len(self.learner_recipes):
             # same rationale as the config's negative-index rejection: a
@@ -632,18 +691,92 @@ class DriverSession:
                 "-m", "metisfl_tpu.serving",
                 "--config", self._config_path,
                 "--recipe", recipe_path]
+        name = "serving"
+        chaos_idx = None
+        if replica is not None:
+            spec = cfg.fleet.gateways[replica]
+            name = spec.get("name") or f"serving_{replica}"
+            # each replica binds its pinned port and staggers its
+            # registry polls by its fleet index (serving/fleet.py
+            # poll_stagger — the thundering-herd fix, and what makes
+            # promotion a ROLLING swap across the fleet)
+            argv += ["--port", str(spec["port"]),
+                     "--replica-index", str(replica),
+                     "--replicas", str(len(cfg.fleet.gateways))]
+            chaos_idx = replica
         if isinstance(launcher, SSHLauncher):
             launcher.ship([self._config_path, recipe_path]
                           + self._ssl_files())
         env = dict(self._base_env())
-        if self._gateway_restarts == 0:
+        if name not in self._chaos_armed_serving:
             # original incarnation only — a supervised relaunch runs
-            # clean, same contract as the controller/learner chaos arming
-            env.update(self._chaos_env("serving"))
-        self._procs = [p for p in self._procs if p.name != "serving"]
-        proc = launcher.launch("serving", argv, env=env)
+            # clean, same contract as the controller/learner chaos
+            # arming (process="serving" arms every replica,
+            # "serving_<idx>" exactly one)
+            self._chaos_armed_serving.add(name)
+            env.update(self._chaos_env("serving", chaos_idx))
+        self._procs = [p for p in self._procs if p.name != name]
+        proc = launcher.launch(name, argv, env=env)
         self._procs.append(proc)
         return proc
+
+    def _launch_router(self) -> _Proc:
+        """(Re)launch the serving-fleet router (``python -m
+        metisfl_tpu.serving --router``). Stateless: it re-reads the
+        initial fleet from the config and the driver re-syncs any
+        autoscaled replicas right after (_sync_router_fleet)."""
+        launcher = self._launcher_for(self.config.controller_host or
+                                      "localhost")
+        argv = [getattr(launcher, "python", sys.executable),
+                "-m", "metisfl_tpu.serving", "--router",
+                "--config", self._config_path]
+        if isinstance(launcher, SSHLauncher):
+            launcher.ship([self._config_path] + self._ssl_files())
+        env = dict(self._base_env())
+        if "router" not in self._chaos_armed_serving:
+            self._chaos_armed_serving.add("router")
+            env.update(self._chaos_env("router"))
+        self._procs = [p for p in self._procs if p.name != "router"]
+        proc = launcher.launch("router", argv, env=env)
+        self._procs.append(proc)
+        return proc
+
+    def _serving_proc_names(self) -> List[str]:
+        """Names of every serving-plane process the driver supervises."""
+        if not self.config.serving.enabled:
+            return []
+        fleet = self.config.serving.fleet
+        if not fleet.enabled:
+            return ["serving"]
+        return [spec.get("name") or f"serving_{i}"
+                for i, spec in enumerate(fleet.gateways)] + ["router"]
+
+    def _router_admin(self):
+        """A fail-fast RpcClient against the router's admin surface."""
+        from metisfl_tpu.comm.rpc import RpcClient
+        from metisfl_tpu.serving.service import SERVING_SERVICE
+        return RpcClient(self.config.controller_host or "localhost",
+                         self.config.serving.fleet.router_port,
+                         SERVING_SERVICE, retries=0, ssl=self.config.ssl)
+
+    def _sync_router_fleet(self) -> None:
+        """AddReplica every current replica (idempotent) — how a
+        relaunched router learns about autoscaled replicas its config
+        file predates."""
+        from metisfl_tpu.comm.codec import dumps as _dumps
+        client = self._router_admin()
+        try:
+            for idx, spec in enumerate(self.config.serving.fleet.gateways):
+                client.call("AddReplica", _dumps(
+                    {"name": spec.get("name") or f"serving_{idx}",
+                     "host": spec.get("host", "localhost"),
+                     "port": spec["port"]}), timeout=5.0,
+                    wait_ready=False)
+        except Exception:  # noqa: BLE001 - probes re-adopt eventually
+            logger.warning("router fleet re-sync failed; the router "
+                           "keeps its config-file fleet")
+        finally:
+            client.close()
 
     def _launch_slice(self, idx: int) -> _Proc:
         """(Re)launch slice aggregator ``idx`` (aggregation/slice.py). It
@@ -719,29 +852,244 @@ class DriverSession:
         return restarted
 
     def _supervise_gateway(self) -> bool:
-        """Serving-gateway crash failover: a dead gateway is relaunched
-        (unbounded — it is stateless; the registry re-pins it), so a
-        chaos kill mid-canary costs one restart, not the serving plane.
-        Returns True when a restart happened this call."""
+        """Serving-plane crash failover: a dead gateway (single, or any
+        fleet replica, or the router) is relaunched (unbounded — all are
+        stateless; the registry re-pins a replica and the probe loop
+        re-adopts it into the ring), so a chaos kill mid-canary costs
+        one restart, not the serving plane. Per-process backoff: one
+        crash-looping replica never delays another's relaunch. Returns
+        True when any restart happened this call."""
         if not self.config.serving.enabled or self._shutting_down:
             return False
-        gw = next((p for p in self._procs if p.name == "serving"), None)
-        if gw is None or gw.process.poll() is None:
-            return False
-        if time.time() < self._gateway_restart_after:
-            return False  # backoff window: relaunch on a later poll
-        code = gw.process.poll()
-        self._gateway_restarts += 1
-        self._gateway_restart_after = time.time() + min(
-            30.0, 0.5 * (2 ** (self._gateway_restarts - 1)))
-        logger.warning("serving gateway died (exit %s); supervised "
-                       "relaunch %d", code, self._gateway_restarts)
-        _tpostmortem.dump("gateway_relaunch",
-                          extra={"exit_code": code,
-                                 "restart": self._gateway_restarts})
-        self._launch_gateway()
-        _M_GATEWAY_RESTARTS.inc()
-        return True
+        fleet = self.config.serving.fleet
+        restarted = False
+        for name in self._serving_proc_names():
+            proc = next((p for p in self._procs if p.name == name), None)
+            if proc is None or proc.process.poll() is None:
+                continue
+            if time.time() < self._serving_restart_after.get(name, 0.0):
+                continue  # this process's backoff window
+            code = proc.process.poll()
+            restarts = self._serving_restarts.get(name, 0) + 1
+            self._serving_restarts[name] = restarts
+            self._serving_restart_after[name] = time.time() + min(
+                30.0, 0.5 * (2 ** (restarts - 1)))
+            logger.warning("%s died (exit %s); supervised relaunch %d",
+                           name, code, restarts)
+            _tpostmortem.dump("gateway_relaunch",
+                              extra={"process": name, "exit_code": code,
+                                     "restart": restarts})
+            if name == "router":
+                self._launch_router()
+                # a relaunched router re-reads the config-file fleet;
+                # autoscaled replicas are re-added once it answers
+                self._sync_router_fleet()
+            elif fleet.enabled:
+                idx = next(
+                    (i for i, spec in enumerate(fleet.gateways)
+                     if (spec.get("name") or f"serving_{i}") == name),
+                    None)
+                if idx is None:
+                    continue  # scaled away between poll and relaunch
+                self._launch_gateway(idx)
+            else:
+                self._launch_gateway()
+            _M_GATEWAY_RESTARTS.inc()
+            restarted = True
+        return restarted
+
+    # ------------------------------------------------------------------ #
+    # serving-fleet autoscaling (serving/fleet.py FleetAutoscaler)
+    # ------------------------------------------------------------------ #
+
+    def _setup_autoscaler(self) -> None:
+        fleet = self.config.serving.fleet
+        if not (self.config.serving.enabled and fleet.enabled
+                and (fleet.scale_up or fleet.scale_down)):
+            return
+        from metisfl_tpu.serving.fleet import FleetAutoscaler
+        self._autoscaler = FleetAutoscaler(
+            fleet.scale_up or None, fleet.scale_down or None,
+            fleet.min_replicas, fleet.max_replicas,
+            cooldown_s=fleet.scale_cooldown_s)
+        _M_FLEET_REPLICAS.set(len(fleet.gateways))
+
+    def _scrape_serving_families(self) -> Dict[str, float]:
+        """Fleet-summed ``serving_*`` family values: one GetMetrics
+        scrape per live replica, counters/gauges summed across series
+        and replicas — the sample the autoscaler's alert rules judge."""
+        from metisfl_tpu.comm.rpc import RpcClient
+        from metisfl_tpu.serving.service import SERVING_SERVICE
+        totals: Dict[str, float] = {}
+        fleet = self.config.serving.fleet
+        # replicas + the ROUTER: serving_router_* families (fleet QPS as
+        # the router sees it) live in the router process — a rule over
+        # them must not silently sample 0 forever
+        targets = ([(spec.get("host", "localhost"), spec["port"])
+                    for spec in fleet.gateways]
+                   + [(self.config.controller_host or "localhost",
+                       fleet.router_port)])
+        for host, port in targets:
+            client = RpcClient(host, port, SERVING_SERVICE, retries=0,
+                               ssl=self.config.ssl)
+            try:
+                text = client.call("GetMetrics", b"", timeout=5.0,
+                                   wait_ready=False,
+                                   idempotent=True).decode("utf-8")
+            except Exception:  # noqa: BLE001 - a dead replica scrapes 0
+                continue
+            finally:
+                client.close()
+            try:
+                series = _tmetrics.parse_exposition(text)
+            except ValueError:
+                continue
+            for name, cells in series.items():
+                if not name.startswith("serving_"):
+                    continue
+                if name.endswith(("_bucket", "_sum", "_count")):
+                    continue  # histogram internals are not family sums
+                totals[name] = totals.get(name, 0.0) + sum(cells.values())
+        return totals
+
+    def _autoscale_serving(self) -> Optional[str]:
+        """One autoscaler evaluation + action (called per monitor poll).
+        Returns the action taken ("up"/"down") or None."""
+        if self._autoscaler is None or self._shutting_down:
+            return None
+        fleet = self.config.serving.fleet
+        values = self._scrape_serving_families()
+        decision = self._autoscaler.observe(values,
+                                            replicas=len(fleet.gateways))
+        if decision == "up":
+            return self._scale_up_serving(values)
+        if decision == "down":
+            return self._scale_down_serving(values)
+        return None
+
+    def _scale_up_serving(self, values: Dict[str, float]) -> str:
+        from metisfl_tpu.comm.codec import dumps as _dumps
+        fleet = self.config.serving.fleet
+        import socket as _socket
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        idx = len(fleet.gateways)
+        name = f"serving_{idx}"
+        while any((sp.get("name") or "") == name for sp in fleet.gateways):
+            idx += 1
+            name = f"serving_{idx}"
+        fleet.gateways.append({"name": name, "host": "localhost",
+                               "port": port})
+        self._launch_gateway(len(fleet.gateways) - 1)
+        # hand the replica to the router immediately but OUT of the ring
+        # (wait_serving): the router's own probe loop admits it on its
+        # first SERVING probe, so the supervision thread never blocks on
+        # a cold boot and no keys route to a replica that cannot answer
+        client = self._router_admin()
+        try:
+            client.call("AddReplica", _dumps({"name": name,
+                                              "host": "localhost",
+                                              "port": port,
+                                              "wait_serving": True}),
+                        timeout=5.0, wait_ready=False)
+        except Exception:  # noqa: BLE001 - probes re-adopt eventually
+            logger.warning("router AddReplica(%s) failed", name)
+        finally:
+            client.close()
+        rule = self._autoscaler.up_rule
+        _tevents.emit(_tevents.ServingScaledUp, replica=name,
+                      replicas=len(fleet.gateways),
+                      rule=rule.describe_expr() if rule else "",
+                      value=self._autoscaler.last_values.get("up", 0.0))
+        _M_FLEET_REPLICAS.set(len(fleet.gateways))
+        _M_SCALE_TOTAL.inc(direction="up")
+        logger.warning("serving fleet scaled UP to %d replicas (+%s): "
+                       "%s", len(fleet.gateways), name, values)
+        return "up"
+
+    def _scale_down_serving(self, values: Dict[str, float]) -> str:
+        from metisfl_tpu.comm.codec import dumps as _dumps
+        from metisfl_tpu.comm.rpc import RpcClient
+        from metisfl_tpu.serving.service import SERVING_SERVICE
+        fleet = self.config.serving.fleet
+        if len(fleet.gateways) <= fleet.min_replicas:
+            return "down"  # raced the floor; the autoscaler re-checks
+        spec = fleet.gateways[-1]  # newest replica drains first (LIFO)
+        name = spec.get("name") or f"serving_{len(fleet.gateways) - 1}"
+        client = self._router_admin()
+        try:
+            # ring removal FIRST: no new requests route to it; its
+            # in-flight work (queued micro-batches, multi-second decode
+            # sequences) gets a bounded idle wait below before shutdown
+            # — the zero-drop drain contract
+            client.call("DrainReplica", _dumps({"name": name}),
+                        timeout=5.0, wait_ready=False)
+        except Exception:  # noqa: BLE001 - a dead router still drains:
+            logger.warning("router drain(%s) failed", name)  # probes
+        finally:                       # see the replica NOT_SERVING next
+            client.close()
+        from metisfl_tpu.comm.codec import loads as _loads
+        rc = RpcClient(spec.get("host", "localhost"), spec["port"],
+                       SERVING_SERVICE, retries=0, ssl=self.config.ssl)
+        try:
+            # wait (bounded) for the drained replica to go idle: router
+            # forwards already dispatched to it — a long Generate
+            # included — must finish on it, not be cancelled mid-decode
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                try:
+                    desc = _loads(rc.call("GetServingStatus", b"",
+                                          timeout=5.0, wait_ready=False,
+                                          idempotent=True))
+                except Exception:  # noqa: BLE001 - already gone
+                    break
+                # decode sequences are the multi-second in-flight work
+                # (predict micro-batches finish in milliseconds and the
+                # gateway's own ShutDown drains them regardless)
+                decode = desc.get("decode") or {}
+                if not any(d.get("queued", 0) or d.get("active", 0)
+                           for d in decode.values()):
+                    break
+                time.sleep(0.25)
+            rc.call("ShutDown", b"", timeout=5.0, wait_ready=False)
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+        finally:
+            rc.close()
+        # router-side cleanup LAST: RemoveReplica closes the router's
+        # channel to the replica, which must not cancel a forward the
+        # drain window above was letting finish
+        client = self._router_admin()
+        try:
+            client.call("RemoveReplica", _dumps({"name": name}),
+                        timeout=5.0, wait_ready=False)
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            client.close()
+        fleet.gateways.remove(spec)
+        proc = next((p for p in self._procs if p.name == name), None)
+        if proc is not None:
+            try:
+                proc.process.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                _terminate_process(proc.process)
+            self._procs = [p for p in self._procs if p.name != name]
+        # a later scale-up may reuse the name: stale backoff windows
+        # must not delay the fresh replica's supervision
+        self._serving_restarts.pop(name, None)
+        self._serving_restart_after.pop(name, None)
+        rule = self._autoscaler.down_rule
+        _tevents.emit(_tevents.ServingScaledDown, replica=name,
+                      replicas=len(fleet.gateways),
+                      rule=rule.describe_expr() if rule else "",
+                      value=self._autoscaler.last_values.get("down", 0.0))
+        _M_FLEET_REPLICAS.set(len(fleet.gateways))
+        _M_SCALE_TOTAL.inc(direction="down")
+        logger.warning("serving fleet scaled DOWN to %d replicas (-%s)",
+                       len(fleet.gateways), name)
+        return "down"
 
     def serving_client(self):
         """A :class:`metisfl_tpu.serving.ServingClient` dialing this
@@ -885,11 +1233,14 @@ class DriverSession:
             self._supervise_controller()
             self._supervise_gateway()
             self._supervise_slices()
+            self._autoscale_serving()
             skip = (("controller",)
                     if self.config.failover.supervise_controller else ())
             if self.config.serving.enabled:
-                # the gateway is always supervised (stateless relaunch)
-                skip = tuple(skip) + ("serving",)
+                # every serving-plane process (gateway, fleet replicas,
+                # router) is always supervised (stateless relaunch) —
+                # and fleet replicas are chaos-killable BY DESIGN
+                skip = tuple(skip) + tuple(self._serving_proc_names())
             tree = self.config.aggregation.tree
             if tree.enabled and tree.distributed:
                 # slice aggregators are chaos-killable BY DESIGN: a death
@@ -1259,18 +1610,29 @@ class DriverSession:
                     sc.close()
                 except Exception:  # noqa: BLE001 - already gone
                     pass
-        if self.config.serving.enabled and self.config.serving.port:
+        if self.config.serving.enabled:
             # fail-fast like the learner loop above: a dead gateway must
-            # not park shutdown in the transport's default deadline
-            try:
-                from metisfl_tpu.serving.service import SERVING_SERVICE
-                gw = RpcClient(self.config.controller_host or "localhost",
-                               self.config.serving.port, SERVING_SERVICE,
-                               retries=0, ssl=self.config.ssl)
-                gw.call("ShutDown", b"", timeout=5.0, wait_ready=False)
-                gw.close()
-            except Exception:  # noqa: BLE001 - gateway may already be gone
-                pass
+            # not park shutdown in the transport's default deadline. In
+            # a fleet: replicas first, then the router (serving.port IS
+            # the router there, so the single-gateway branch covers it)
+            from metisfl_tpu.serving.service import SERVING_SERVICE
+            targets: List[tuple] = []
+            fleet = self.config.serving.fleet
+            if fleet.enabled:
+                targets = [(spec.get("host", "localhost"), spec["port"])
+                           for spec in fleet.gateways]
+            if self.config.serving.port:
+                targets.append((self.config.controller_host or
+                                "localhost", self.config.serving.port))
+            for host, port in targets:
+                try:
+                    gw = RpcClient(host, port, SERVING_SERVICE,
+                                   retries=0, ssl=self.config.ssl)
+                    gw.call("ShutDown", b"", timeout=5.0,
+                            wait_ready=False)
+                    gw.close()
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
         try:
             if self._client is not None:
                 self._client.shutdown_controller()

@@ -173,3 +173,123 @@ def generate(module, variables: Pytree, prompt, max_new_tokens: int, *,
 # left-padding rather than compiling one program per length.
 _COMPILED: dict = {}
 _COMPILED_MAX = 32
+
+
+class SlotDecoder:
+    """Fixed-slot KV-cache decode programs for continuous batching (Orca,
+    Yu et al. OSDI 2022 — iteration-level scheduling over an in-flight
+    batch).
+
+    :func:`generate` compiles one program per *whole generation*: every
+    request runs prefill + all its decode steps alone, and a prompt that
+    arrives mid-generation waits for the running batch to finish. This
+    class exposes the two primitives a continuous batcher schedules at
+    *step* granularity instead:
+
+    - ``prefill(variables, slot, prompt)`` — write one prompt's K/V into
+      slot ``slot`` of the shared cache and return its first greedy
+      token (one program per prompt length, LRU-bounded);
+    - ``step(variables, tokens, positions)`` — ONE jitted program
+      advancing every slot a single token, each at its own cache
+      position (``vmap`` over the slot axis carries the per-slot
+      position the module's scalar ``position`` argument cannot).
+
+    The caches are allocated once at fixed slot shapes
+    ``(slots, 1, kv_heads, max_len, head_dim)`` per block, so however
+    requests come and go the step stays one compiled program. A retiring
+    slot needs no cleanup: attention masks every cache position beyond
+    the occupant's frontier to ``finfo.min`` (exactly-zero softmax
+    weight), and a new occupant's prefill + sequential decode writes
+    overwrite every position before it becomes attendable — which is
+    also why the outputs are bit-identical to a solo :func:`generate`
+    call at the same ``max_len`` (tests/test_fleet.py pins it).
+
+    Greedy only: a shared in-flight batch samples per-slot rng streams,
+    which would no longer be comparable to any single-request call;
+    serving-plane generation (serving/decode.py) is deterministic by
+    contract.
+    """
+
+    _PREFILL_MAX = 16  # compiled prefill programs kept (per prompt length)
+
+    def __init__(self, module, slots: int, max_len: int):
+        self.module = module
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        kv_heads = module.kv_heads or module.heads
+        head_dim = module.dim // module.heads
+        dtype = module.dtype or jnp.float32
+        shape = (self.slots, 1, kv_heads, self.max_len, head_dim)
+        # per block: (K, V), slot-major with each slot a batch-1 cache —
+        # exactly the shape one solo generate(B=1) call sees
+        self.caches = tuple(
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(module.depth))
+        self._prefill_fns: dict = {}
+        self._step_fn = None
+
+    def prefill(self, variables, slot: int, prompt) -> int:
+        """Admit a prompt into ``slot``: write its K/V, return the first
+        greedy token. The prompt runs at its EXACT length (no padding) —
+        the same program a solo generate's prefill compiles — which is
+        what keeps slot outputs bit-identical to single-request decode."""
+        prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
+        L = int(prompt.shape[1])
+        if L < 1 or L >= self.max_len:
+            raise ValueError(
+                f"prompt length {L} must be in [1, max_len={self.max_len})")
+        fn = self._prefill_fns.get(L)
+        if fn is None:
+            module = self.module
+
+            def run(variables, caches, prompt, slot):
+                sub = tuple(
+                    (jax.lax.dynamic_index_in_dim(ck, slot, 0,
+                                                  keepdims=False),
+                     jax.lax.dynamic_index_in_dim(cv, slot, 0,
+                                                  keepdims=False))
+                    for ck, cv in caches)
+                logits, sub = module.apply(variables, prompt, caches=sub,
+                                           position=0)
+                caches = tuple(
+                    (jax.lax.dynamic_update_index_in_dim(ck, sk, slot, 0),
+                     jax.lax.dynamic_update_index_in_dim(cv, sv, slot, 0))
+                    for (ck, cv), (sk, sv) in zip(caches, sub))
+                tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return caches, tok[0]
+
+            while len(self._prefill_fns) >= self._PREFILL_MAX:
+                self._prefill_fns.pop(next(iter(self._prefill_fns)))
+            fn = self._prefill_fns[L] = jax.jit(run)
+        else:
+            self._prefill_fns[L] = self._prefill_fns.pop(L)  # LRU refresh
+        self.caches, tok = fn(variables, self.caches, prompt,
+                              jnp.asarray(slot, jnp.int32))
+        return int(tok)
+
+    def step(self, variables, tokens, positions):
+        """Advance EVERY slot one decode token (one fixed-shape jitted
+        program). ``tokens``/``positions`` are (slots,) int arrays; free
+        slots pass any value (their lanes compute garbage that is never
+        read, and their cache writes land at positions a future prefill
+        overwrites). Returns the (slots,) next greedy tokens."""
+        if self._step_fn is None:
+            module = self.module
+
+            def run(variables, caches, toks, positions):
+                def one(sub, tok, pos):
+                    logits, sub = module.apply(
+                        variables, tok.reshape(1, 1), caches=sub,
+                        position=pos)
+                    nxt = jnp.argmax(logits[:, -1], axis=-1)
+                    return sub, nxt.astype(jnp.int32)[0]
+
+                return jax.vmap(one, in_axes=(0, 0, 0))(caches, toks,
+                                                        positions)
+
+            self._step_fn = jax.jit(run)
+        self.caches, nxt = self._step_fn(
+            variables, self.caches, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32))
+        import numpy as _np
+        return _np.asarray(nxt)

@@ -9,6 +9,14 @@ promotion, and a deterministic canary split toward the candidate
 channel. See docs/DEPLOYMENT.md.
 """
 
+from metisfl_tpu.serving.decode import ContinuousBatcher
+from metisfl_tpu.serving.fleet import (
+    FleetAutoscaler,
+    HashRing,
+    RouterServer,
+    ServingRouter,
+    poll_stagger,
+)
 from metisfl_tpu.serving.gateway import (
     ControllerRegistrySource,
     DirectRegistrySource,
@@ -25,10 +33,16 @@ from metisfl_tpu.serving.service import (
 __all__ = [
     "ServingGateway",
     "MicroBatcher",
+    "ContinuousBatcher",
     "ControllerRegistrySource",
     "DirectRegistrySource",
     "canary_channel",
     "ServingServer",
     "ServingClient",
+    "ServingRouter",
+    "RouterServer",
+    "FleetAutoscaler",
+    "HashRing",
+    "poll_stagger",
     "SERVING_SERVICE",
 ]

@@ -274,6 +274,9 @@ class ServingGateway:
         self._models: Dict[str, Tuple[int, Any]] = {}
         self._treedef_like = model_ops.get_variables()
         self._batchers: Dict[str, MicroBatcher] = {}
+        # continuous-batching decode engines (serving/decode.py), one per
+        # channel, created lazily on the first Generate for that channel
+        self._decoders: Dict[str, Any] = {}
         self._requests = 0
         self._shut_down = False
         self._started_at = time.time()
@@ -326,6 +329,12 @@ class ServingGateway:
         with self._lock:
             previous = self._models.get(channel, (0, None))[0]
             self._models[channel] = (int(version), variables)
+            decoder = self._decoders.get(channel)
+        if decoder is not None:
+            # the decode loop's zero-drop swap: in-flight generations
+            # finish on the pair they captured, queued ones drain onto
+            # this one (serving/decode.py)
+            decoder.swap(int(version), variables)
         _M_VERSION.set(int(version), channel=channel)
         if previous != version:
             _M_SWAPS.inc(channel=channel)
@@ -337,6 +346,11 @@ class ServingGateway:
     def uninstall(self, channel: str) -> None:
         with self._lock:
             gone = self._models.pop(channel, None)
+            decoder = self._decoders.pop(channel, None)
+        if decoder is not None:
+            # drain: queued/in-flight generations on the departing
+            # channel still finish on their captured pair
+            decoder.close()
         if gone is not None:
             _M_VERSION.remove(channel=channel)
             logger.info("serving %s uninstalled (was v%d)", channel,
@@ -370,12 +384,19 @@ class ServingGateway:
                 self.install(channel, head, blob)
         return self.installed()
 
-    def start_sync(self, source, poll_every_s: Optional[float] = None) -> None:
-        """Background registry polling (the gateway process's main loop)."""
+    def start_sync(self, source, poll_every_s: Optional[float] = None,
+                   initial_delay_s: float = 0.0) -> None:
+        """Background registry polling (the gateway process's main loop).
+        ``initial_delay_s`` phases the FIRST poll — fleet replicas pass
+        :func:`metisfl_tpu.serving.fleet.poll_stagger` offsets so a
+        promotion rolls through the fleet one replica at a time instead
+        of every replica hitting the registry in the same instant."""
         period = (self.config.poll_every_s if poll_every_s is None
                   else poll_every_s)
 
         def _loop():
+            if initial_delay_s > 0.0:
+                self._sync_stop.wait(initial_delay_s)
             while not self._sync_stop.is_set():
                 try:
                     self.sync(source)
@@ -466,13 +487,80 @@ class ServingGateway:
         _M_LATENCY.observe(time.perf_counter() - t0)
         return outs, version, served_channel
 
+    def _decoder_for(self, channel: str):
+        """The channel's continuous-batching decode engine, created on
+        first use from the channel's installed (version, variables)
+        pair (serving/decode.py)."""
+        from metisfl_tpu.serving.decode import ContinuousBatcher
+        with self._lock:
+            if self._shut_down:
+                raise RuntimeError("serving gateway is shut down")
+            decoder = self._decoders.get(channel)
+            if decoder is None:
+                entry = self._models.get(channel)
+                if entry is None:
+                    raise RuntimeError(
+                        f"no model installed on channel {channel!r}")
+                version, variables = entry
+                decode_cfg = getattr(self.config, "decode", None)
+                decoder = ContinuousBatcher(
+                    self.model_ops, version, variables,
+                    slots=getattr(decode_cfg, "slots", 4),
+                    max_len=getattr(decode_cfg, "max_len", 512),
+                    channel=channel)
+                self._decoders[channel] = decoder
+            return decoder
+
+    def generate(self, prompt, max_new_tokens: int, key: str = "",
+                 eos_id: Optional[int] = None,
+                 timeout_s: float = 120.0) -> Tuple[np.ndarray, int, str]:
+        """Route one generation request through the continuous-batching
+        decode loop. Returns ``(tokens, served version, channel)`` —
+        tokens are the (max_new_tokens,) greedy continuation, pad after
+        eos (bit-identical to a solo models/generate.py call at the
+        same max_len)."""
+        t0 = time.perf_counter()
+        channel = canary_channel(key or "", self.config.canary_percent)
+        with self._lock:
+            if channel not in self._models:
+                channel = CHANNEL_STABLE  # same degrade rule as predict
+            if channel not in self._models:
+                raise RuntimeError("no model installed (registry has no "
+                                   "stable version yet)")
+        try:
+            tokens, version = self._decoder_for(channel).submit(
+                prompt, max_new_tokens,
+                eos_id=eos_id).result(timeout=timeout_s)
+        except RuntimeError:
+            # the candidate was uninstalled (promoted/superseded) between
+            # routing and decode — its engine is gone or drained closed:
+            # degrade the canary request to stable instead of failing
+            # user traffic, predict()'s exact rule
+            if channel != CHANNEL_CANDIDATE:
+                raise
+            channel = CHANNEL_STABLE
+            with self._lock:
+                if channel not in self._models:
+                    raise RuntimeError(
+                        "no model installed (registry has no stable "
+                        "version yet)") from None
+            tokens, version = self._decoder_for(channel).submit(
+                prompt, max_new_tokens,
+                eos_id=eos_id).result(timeout=timeout_s)
+        with self._lock:
+            self._requests += 1
+        _M_REQUESTS.inc(channel=channel)
+        _M_LATENCY.observe(time.perf_counter() - t0)
+        return tokens, version, channel
+
     # -- status --------------------------------------------------------- #
 
     def describe(self) -> Dict[str, Any]:
         with self._lock:
             installed = {ch: v for ch, (v, _) in self._models.items()}
             requests = self._requests
-        return {
+            decoders = dict(self._decoders)
+        out = {
             "installed": installed,
             "canary_percent": float(self.config.canary_percent),
             "max_batch": int(self.config.max_batch),
@@ -481,6 +569,13 @@ class ServingGateway:
             "uptime_s": round(time.time() - self._started_at, 3),
             "last_sync_error": self._last_sync_error,
         }
+        if decoders:
+            # continuous-batching decode section (serving/decode.py) —
+            # present only once a Generate armed an engine, so pre-decode
+            # gateways describe byte-identically to before
+            out["decode"] = {ch: d.describe()
+                             for ch, d in decoders.items()}
+        return out
 
     def queue_snapshot(self) -> Dict[str, Any]:
         """Micro-batch queue occupancy (per channel + total) — wired as
@@ -489,10 +584,17 @@ class ServingGateway:
         training cost."""
         with self._lock:
             batchers = dict(self._batchers)
+            decoders = dict(self._decoders)
         depths = {ch: b.depth() for ch, b in batchers.items()}
-        return {"queue_depth": sum(depths.values()),
-                "queue_depth_by_channel": depths,
-                "max_batch": int(self.config.max_batch)}
+        out = {"queue_depth": sum(depths.values()),
+               "queue_depth_by_channel": depths,
+               "max_batch": int(self.config.max_batch)}
+        if decoders:
+            out["decode_queue_depth"] = sum(d.depth()
+                                            for d in decoders.values())
+            out["decode_active_slots"] = sum(d.active()
+                                             for d in decoders.values())
+        return out
 
     def shutdown(self) -> None:
         coll = _tprofile.collector()
@@ -505,5 +607,9 @@ class ServingGateway:
             self._shut_down = True
             batchers = list(self._batchers.values())
             self._batchers.clear()
+            decoders = list(self._decoders.values())
+            self._decoders.clear()
         for batcher in batchers:
             batcher.close()
+        for decoder in decoders:
+            decoder.close()

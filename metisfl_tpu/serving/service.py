@@ -16,7 +16,8 @@ from typing import Optional
 import numpy as np
 
 from metisfl_tpu.comm.codec import dumps, loads
-from metisfl_tpu.comm.messages import ServeReply, ServeRequest
+from metisfl_tpu.comm.messages import (GenerateReply, GenerateRequest,
+                                       ServeReply, ServeRequest)
 from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
 from metisfl_tpu.serving.gateway import ServingGateway
 from metisfl_tpu.tensor.pytree import ModelBlob
@@ -40,6 +41,7 @@ class ServingServer:
         self._server.add_service(self._health_servicer.service())
         self._server.add_service(BytesService(SERVING_SERVICE, {
             "Predict": self._predict,
+            "Generate": self._generate,
             "GetServingStatus": self._status,
             "GetHealthStatus": self._health,
             "GetMetrics": self._get_metrics,
@@ -62,6 +64,27 @@ class ServingServer:
             request_id=req.request_id,
             predictions=ModelBlob(
                 tensors=[("predictions", np.asarray(outs))]).to_bytes(),
+            model_version=version,
+            channel=channel,
+            duration_ms=(time.time() - t0) * 1e3,
+        ).to_wire()
+
+    def _generate(self, raw: bytes) -> bytes:
+        req = GenerateRequest.from_wire(raw)
+        tensors = dict(ModelBlob.from_bytes(req.prompt).tensors)
+        if "tokens" not in tensors:
+            raise ValueError(
+                "GenerateRequest.prompt must pack a 'tokens' tensor")
+        t0 = time.time()
+        tokens, version, channel = self.gateway.generate(
+            tensors["tokens"], max_new_tokens=int(req.max_new_tokens),
+            key=req.key or req.request_id,
+            eos_id=None if req.eos_id < 0 else int(req.eos_id))
+        return GenerateReply(
+            request_id=req.request_id,
+            tokens=ModelBlob(
+                tensors=[("tokens",
+                          np.asarray(tokens, np.int32))]).to_bytes(),
             model_version=version,
             channel=channel,
             duration_ms=(time.time() - t0) * 1e3,
@@ -130,6 +153,26 @@ class ServingClient:
     def predictions(self, reply: ServeReply) -> np.ndarray:
         return dict(ModelBlob.from_bytes(
             reply.predictions).tensors)["predictions"]
+
+    def generate(self, prompt, max_new_tokens: int = 16, key: str = "",
+                 eos_id: int = -1,
+                 timeout: Optional[float] = 180.0) -> GenerateReply:
+        """One continuous-batching generation: ``prompt`` is a (L,) or
+        (1, L) int token array; the reply's tokens come back via
+        :meth:`tokens`."""
+        req = GenerateRequest(
+            request_id=uuid.uuid4().hex,
+            key=key,
+            prompt=ModelBlob(tensors=[
+                ("tokens",
+                 np.asarray(prompt, np.int32).reshape(-1))]).to_bytes(),
+            max_new_tokens=int(max_new_tokens),
+            eos_id=int(eos_id))
+        return GenerateReply.from_wire(
+            self._client.call("Generate", req.to_wire(), timeout=timeout))
+
+    def tokens(self, reply: GenerateReply) -> np.ndarray:
+        return dict(ModelBlob.from_bytes(reply.tokens).tensors)["tokens"]
 
     def status(self, timeout: float = 10.0,
                wait_ready: bool = True) -> dict:

@@ -260,14 +260,43 @@ def render_snapshot(snap: Dict[str, Any], target: str = "",
     return "\n".join(lines)
 
 
-def render_fleet(snap: Dict[str, Any], span_tail: int = 25) -> str:
+def render_serving_line(desc: Dict[str, Any]) -> str:
+    """The ``serving:`` line from a ``GetServingStatus`` reply — a
+    router's reply (serving/fleet.py) renders per-replica state/health/
+    installed versions; a single gateway's reply renders its installed
+    map."""
+    if desc.get("router"):
+        cells = []
+        for row in desc.get("replicas", []):
+            installed = row.get("installed") or {}
+            vers = ",".join(f"{ch}=v{v}"
+                            for ch, v in sorted(installed.items()))
+            cells.append(f"{row.get('replica', '?')}="
+                         f"{row.get('state', '?')}"
+                         + (f"({vers})" if vers else ""))
+        return (f"serving: {desc.get('live', 0)}/"
+                f"{len(desc.get('replicas', []))} replicas up  "
+                f"requests={desc.get('requests', 0)}  "
+                + "  ".join(cells))
+    installed = desc.get("installed") or {}
+    vers = "  ".join(f"{ch}=v{v}" for ch, v in sorted(installed.items()))
+    return (f"serving: 1 gateway  requests={desc.get('requests', 0)}  "
+            f"{vers or 'nothing installed'}")
+
+
+def render_fleet(snap: Dict[str, Any], span_tail: int = 25,
+                 serving: Optional[Dict[str, Any]] = None) -> str:
     """One :meth:`FleetCollector.snapshot` as the ``--fleet`` screen:
-    per-peer liveness/health/offset rows, the merged metric-family
-    summary, and the unified skew-corrected span waterfall."""
+    per-peer liveness/health/offset rows, the serving fleet's
+    per-replica line (``serving`` = a GetServingStatus reply, router or
+    gateway), the merged metric-family summary, and the unified
+    skew-corrected span waterfall."""
     lines: List[str] = []
     peers = snap.get("peers", [])
     lines.append(f"fleet: {snap.get('live', 0)}/{len(peers)} peers live  "
                  f"polls={snap.get('polls', 0)}")
+    if serving:
+        lines.append(render_serving_line(serving))
     if peers:
         lines.append(f"{'peer':<28} {'role':<10} {'target':<22} "
                      f"{'health':<12} {'state':<8} {'offset':>9} "
@@ -382,11 +411,30 @@ def _fleet_collector(args, ssl=None):
                           "service_name": LEARNER_SERVICE,
                           "role": "learner"})
         if getattr(args, "serving_port", 0):
-            from metisfl_tpu.serving.service import SERVING_SERVICE
+            from metisfl_tpu.serving.service import (SERVING_SERVICE,
+                                                     ServingClient)
             specs.append({"name": "serving", "host": args.host,
                           "port": args.serving_port,
                           "service_name": SERVING_SERVICE,
                           "role": "serving"})
+            # a fleet ROUTER on that port names its replicas — pull each
+            # as its own role="serving" peer so fabric spans/metrics/
+            # prof: lines cover every replica, not just the front door
+            try:
+                sc = ServingClient(args.host, args.serving_port, ssl=ssl)
+                try:
+                    desc = sc.status(timeout=3.0, wait_ready=False)
+                finally:
+                    sc.close()
+                for row in (desc.get("replicas") or []):
+                    host, _, port = row.get("target", "").rpartition(":")
+                    if host and port.isdigit():
+                        specs.append({"name": row.get("replica", host),
+                                      "host": host, "port": int(port),
+                                      "service_name": SERVING_SERVICE,
+                                      "role": "serving"})
+            except Exception:  # noqa: BLE001 - a plain gateway, or down
+                pass
         return specs
 
     collector = FleetCollector(ssl=ssl, discover_fn=_discover)
@@ -466,8 +514,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "clock offset, merged metric families, one "
                              "skew-corrected span waterfall")
     parser.add_argument("--serving-port", type=int, default=0,
-                        help="--fleet: also pull the serving gateway on "
-                             "this port")
+                        help="--fleet: also pull the serving plane on "
+                             "this port (the fleet ROUTER when one runs "
+                             "— its reply renders the per-replica "
+                             "serving: line — or the single gateway)")
     parser.add_argument("--ssl-cert", default="",
                         help="federation TLS cert (a TLS-enabled run — the "
                              "driver's auto-generated pair lives in "
@@ -485,6 +535,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     target = f"{args.host}:{args.port}"
     if args.fleet:
         collector, client = _fleet_collector(args, ssl=ssl)
+
+        def _serving_desc():
+            """GetServingStatus off --serving-port (router or gateway);
+            None keeps the screen serving-line-free."""
+            if not args.serving_port:
+                return None
+            from metisfl_tpu.serving.service import ServingClient
+            sc = ServingClient(args.host, args.serving_port, ssl=ssl)
+            try:
+                return sc.status(timeout=5.0, wait_ready=False)
+            except Exception:  # noqa: BLE001 - best-effort line
+                return None
+            finally:
+                sc.close()
+
         try:
             while True:
                 collector.poll_once(timeout=10.0)
@@ -492,10 +557,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # a second poll refines the first's offset estimate
                     # before the one-shot render
                     collector.poll_once(timeout=10.0)
-                    print(render_fleet(collector.snapshot()))
+                    print(render_fleet(collector.snapshot(),
+                                       serving=_serving_desc()))
                     return 0
                 sys.stdout.write("\x1b[2J\x1b[H"
-                                 + render_fleet(collector.snapshot())
+                                 + render_fleet(collector.snapshot(),
+                                                serving=_serving_desc())
                                  + "\n")
                 sys.stdout.flush()
                 time.sleep(args.interval)

@@ -165,6 +165,18 @@ M_SERVING_BATCH_ROWS = "serving_batch_rows"
 M_SERVING_MODEL_VERSION = "serving_model_version"
 M_SERVING_SWAPS_TOTAL = "serving_swaps_total"
 M_SERVING_QUEUE_DEPTH = "serving_queue_depth"
+# continuous-batching decode (serving/decode.py)
+M_SERVING_DECODE_QUEUE_DEPTH = "serving_decode_queue_depth"
+M_SERVING_DECODE_ACTIVE_SLOTS = "serving_decode_active_slots"
+M_SERVING_DECODE_TOKENS_TOTAL = "serving_decode_tokens_total"
+M_SERVING_DECODE_TOKENS_PER_SEC = "serving_decode_tokens_per_sec"
+# serving fleet: router + autoscaler (serving/fleet.py + driver/session.py)
+M_ROUTER_REQUESTS_TOTAL = "serving_router_requests_total"
+M_ROUTER_RETRIES_TOTAL = "serving_router_retries_total"
+M_ROUTER_REQUEST_LATENCY_SECONDS = "serving_router_request_latency_seconds"
+M_SERVING_REPLICA_UP = "serving_replica_up"
+M_SERVING_FLEET_REPLICAS = "serving_fleet_replicas"
+M_SERVING_SCALE_TOTAL = "serving_scale_total"
 
 __all__ = [
     "metrics",

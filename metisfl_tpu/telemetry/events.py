@@ -282,6 +282,55 @@ class FabricPeerRecovered:
 
 
 @dataclass(frozen=True)
+class ServingReplicaDead:
+    """The serving router declared a gateway replica dead (consecutive
+    forward failures confirmed by a grpc.health.v1 probe, or the probe
+    loop itself); its keyspace arcs fell to the next consistent-hash
+    owners (serving/fleet.py)."""
+
+    kind: ClassVar[str] = "serving_replica_dead"
+    replica: str
+    reason: str = ""
+    failures: int = 0
+
+
+@dataclass(frozen=True)
+class ServingReplicaRecovered:
+    """A dead or draining serving replica probed SERVING again and
+    rejoined the router's hash ring."""
+
+    kind: ClassVar[str] = "serving_replica_recovered"
+    replica: str
+
+
+@dataclass(frozen=True)
+class ServingScaledUp:
+    """The serving autoscaler booted a gateway replica (a ``serving_*``
+    scale-up rule fired past its hold; driver/session.py). ``value`` is
+    the rule's sampled value at the decision — the evidence trail next
+    to the queue-occupancy profile."""
+
+    kind: ClassVar[str] = "serving_scaled_up"
+    replica: str
+    replicas: int = 0
+    rule: str = ""
+    value: float = 0.0
+
+
+@dataclass(frozen=True)
+class ServingScaledDown:
+    """The serving autoscaler drained a gateway replica back out of the
+    fleet (scale-down rule fired, floor ``serving.fleet.min_replicas``
+    respected)."""
+
+    kind: ClassVar[str] = "serving_scaled_down"
+    replica: str
+    replicas: int = 0
+    rule: str = ""
+    value: float = 0.0
+
+
+@dataclass(frozen=True)
 class SliceAggregatorLost:
     """A slice aggregator process stopped answering (consecutive RPC
     failures confirmed by a grpc.health.v1 probe); its cohort slice is
@@ -317,7 +366,9 @@ EVENT_TYPES: Dict[str, type] = {
                 RoundHalted, VersionRegistered, VersionPromoted,
                 VersionRolledBack, ServingSwapped, AlertFiring,
                 AlertResolved, FabricPeerStale, FabricPeerRecovered,
-                SliceAggregatorLost, SliceRehomed)
+                SliceAggregatorLost, SliceRehomed, ServingReplicaDead,
+                ServingReplicaRecovered, ServingScaledUp,
+                ServingScaledDown)
 }
 
 

@@ -93,6 +93,29 @@ case "$rc" in
 esac
 [ "$rc" -eq 0 ] || exit "$rc"
 
+# ISSUE 15 serving-fleet replica-kill gate (docs/DEPLOYMENT.md "Serving
+# fleet"): three real gateway-replica subprocesses over gRPC behind the
+# consistent-hash router, live canary traffic, one replica SIGKILLed
+# mid-canary. The build fails unless ZERO requests drop (the router
+# drains around the corpse with bounded retry to the next hash owner),
+# the router marks the replica dead, every key's replies stay on one
+# canary channel, the surviving replicas roll to the mid-run promotion,
+# and the relaunched replica re-pins to the promoted version.
+JAX_PLATFORMS=cpu timeout -k 10 180 "$PYTHON" -m metisfl_tpu.serving \
+  --fleet-smoke --smoke-replicas 3
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: replica-kill PASS (replica SIGKILLed mid-canary," \
+          "zero requests dropped, router drained around it, channels" \
+          "stayed coherent, relaunch re-pinned to the promoted version)" ;;
+  1) echo "chaos_smoke: replica-kill FAIL — requests dropped, channels" \
+          "mixed, or the relaunch did not re-pin (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: replica-kill FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
 # ISSUE 13 continuous-profiling overhead gate (docs/OBSERVABILITY.md
 # "Continuous profiling"): the bench round loop with the sampler (67 Hz
 # default) + instrumented locks ON vs OFF, interleaved trials, minima
