@@ -1615,6 +1615,77 @@ def bench_prof(trials=5, acquire_iters=200_000, sample_iters=300):
     }
 
 
+def _synth_trace(n_spans: int) -> list:
+    """A synthetic round-shaped trace of ~``n_spans`` records: one round
+    root, fan-out dispatch/learner subtrees (each train span outliving
+    its dispatch parent — the fork-join shape the walk is built for),
+    and an aggregate tail. Deterministic: same n, same tree."""
+    spans = []
+    t0 = 1_000_000.0
+
+    def rec(i, name, parent, start, dur_ms, attrs=None):
+        r = {"trace": "b" * 32, "span": f"{i:016x}", "parent": parent,
+             "name": name, "service": "bench", "start": start,
+             "dur_ms": round(dur_ms, 3)}
+        if attrs:
+            r["attrs"] = attrs
+        spans.append(r)
+        return r["span"]
+
+    root = rec(0, "round", "", t0, 5000.0, {"round": 1})
+    i = 1
+    disp = rec(i, "round.dispatch", root, t0 + 1.0, 80.0)
+    i += 1
+    # each learner subtree: rpc.server/RunTask > learner.train > leaves
+    per_learner = 4
+    learners = max(1, (n_spans - 4) // (per_learner + 1))
+    for li in range(learners):
+        start = t0 + 2.0 + 0.01 * li
+        task = rec(i, "rpc.server/RunTask", disp, start,
+                   3000.0 + 7.0 * (li % 13))
+        i += 1
+        train = rec(i, "learner.train", task, start + 0.005,
+                    2990.0 + 7.0 * (li % 13),
+                    {"learner": f"learner_{li}"})
+        i += 1
+        for leaf in range(per_learner - 1):
+            rec(i, f"learner.step_{leaf}", train,
+                start + 0.01 + leaf * 0.9, 850.0)
+            i += 1
+    agg = rec(i, "round.aggregate", root, t0 + 3.2, 1700.0)
+    i += 1
+    rec(i, "round.agg_block", agg, t0 + 3.25, 1600.0)
+    return spans
+
+
+def bench_trace(trials=5, cp_trials=7):
+    """Causal-tracing section (docs/OBSERVABILITY.md "Causal tracing"):
+    the per-RPC context-propagation cost (inject + extract, the tax
+    every hop pays) and the critical-path analysis cost at 1k / 10k
+    spans (the ``perf --critical-path`` / fleet-sweep consumer side).
+    Host-side and self-contained; the ns/ms keys are direction-
+    classified (lower better) for ``perf --trajectory``."""
+    from metisfl_tpu.telemetry import causal as tcausal
+    from metisfl_tpu.telemetry import trace as ttrace
+
+    ttrace.configure(enabled=True, service="bench-trace", dir="")
+    propagate_ns = min(tcausal._propagation_overhead_ns(iters=20000)
+                       for _ in range(trials))
+    out = {"trace_propagate_ns": round(propagate_ns, 1)}
+    for label, n in (("1k", 1000), ("10k", 10000)):
+        spans = _synth_trace(n)
+        times = []
+        for _ in range(cp_trials):
+            t0 = time.perf_counter()
+            cp = tcausal.critical_path(spans)
+            times.append((time.perf_counter() - t0) * 1e3)
+        assert cp is not None and cp["edges"], "walk must attribute"
+        out[f"trace_critical_path_{label}_ms"] = round(min(times), 3)
+        out[f"trace_spans_{label}"] = len(spans)
+    out["trace_coverage_synth"] = round(cp["coverage"], 4)
+    return out
+
+
 _SECTIONS = {
     "train": lambda a: bench_train_step(),
     "ckks": lambda a: bench_secure_ckks(),
@@ -1632,6 +1703,7 @@ _SECTIONS = {
     "prof": lambda a: bench_prof(),
     "tree_dist": lambda a: bench_tree_dist(),
     "fleet": lambda a: bench_fleet(),
+    "trace": lambda a: bench_trace(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1859,7 +1931,7 @@ _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "e2e": 600, "cohort": 1200, "health": 240,
                      "serving": 300, "churn": 240, "obs": 240,
                      "fabric": 240, "prof": 240, "tree_dist": 300,
-                     "fleet": 300, "lora": 600}
+                     "fleet": 300, "trace": 240, "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1907,7 +1979,7 @@ _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
 _HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
-                  "obs", "fabric", "prof", "tree_dist", "fleet")
+                  "obs", "fabric", "prof", "tree_dist", "fleet", "trace")
 def _default_partial_path() -> str:
     """Where the crash-durable partials land by default:
     ``bench_results/`` — NOT the repo root. Three separate rounds shipped

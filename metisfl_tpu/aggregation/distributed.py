@@ -72,6 +72,7 @@ from metisfl_tpu.aggregation.base import np_finalize
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import prof as _prof
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.telemetry.sketch import QuantileDigest, SpaceSaving
 from metisfl_tpu.tensor.pytree import ModelBlob
 
@@ -501,10 +502,19 @@ class DistributedSliceReducer:
         for lid in ids:
             groups.setdefault(self._base_owner(lid), []).append(lid)
         order = sorted(groups, key=lambda i: (i == ROOT, i))
-        futures = {
-            idx: self._executor().submit(self._fold_group, idx, groups[idx],
-                                         scales, subblock, round_id)
-            for idx in order}
+        # the aggregate span's context, captured HERE on the scheduling
+        # thread: the fold pool's threads have empty contextvars, and the
+        # FoldPartial RPCs must parent under the round's aggregate span
+        # (the slice's server-side fold span completes the causal chain)
+        trace_ctx = _ttrace.current_context()
+
+        def _fold_traced(idx):
+            with _ttrace.use_context(trace_ctx):
+                return self._fold_group(idx, groups[idx], scales,
+                                        subblock, round_id)
+
+        futures = {idx: self._executor().submit(_fold_traced, idx)
+                   for idx in order}
         partials: List[SlicePartial] = []
         errors: List[str] = []
         # settle EVERY future before raising (the TreeReducer.reduce

@@ -50,6 +50,7 @@ from metisfl_tpu.aggregation.tree import _DEFAULT_SUBBLOCK, TreeReducer
 from metisfl_tpu.comm.codec import dumps, loads
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import prof as _prof
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.telemetry.sketch import QuantileDigest, SpaceSaving
 from metisfl_tpu.tensor.pytree import ModelBlob
 
@@ -222,7 +223,13 @@ class SliceAggregator:
                     if lid in snapshot}
 
         subblock = int(stride) or _DEFAULT_SUBBLOCK
-        partial = TreeReducer._fold_slice(list(ids), scales, fetch, subblock)
+        # named fold span under the ambient rpc.server/FoldPartial: the
+        # critical-path edge then reads "<slice>/slice.fold", not a bare
+        # RPC method
+        with _ttrace.span("slice.fold",
+                          attrs={"slice": self.name, "ids": len(ids)}):
+            partial = TreeReducer._fold_slice(list(ids), scales, fetch,
+                                              subblock)
         reply: Dict[str, Any] = {
             "ok": True,
             "count": partial.count,

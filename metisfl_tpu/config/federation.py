@@ -290,6 +290,13 @@ class FabricConfig:
     # per-process finished-span ring the cursor pulls read from
     # (0 → the trace module's default, 4096)
     span_ring: int = 0
+    # causal critical-path attribution (telemetry/causal.py) over the
+    # merged span buffer: refresh per sweep, export the heaviest edges
+    # as round_critical_path_seconds{edge} and the snapshot's crit row.
+    # False skips the walk (span collection itself is unaffected).
+    critical_path: bool = True
+    # how many heaviest edges each summary/gauge keeps
+    critical_path_edges: int = 5
 
 
 @dataclass
@@ -799,6 +806,11 @@ class FederationConfig:
             raise ValueError("telemetry.fabric.rtt_gate must be >= 1")
         if fab.span_ring < 0:
             raise ValueError("telemetry.fabric.span_ring must be >= 0")
+        if fab.critical_path_edges < 1:
+            # 0 edges is an attribution that attributes nothing — turn
+            # the walk off with critical_path=false instead
+            raise ValueError(
+                "telemetry.fabric.critical_path_edges must be >= 1")
         pr = self.telemetry.prof
         if pr.enabled:
             if not 0.0 < pr.hz <= 1000.0:

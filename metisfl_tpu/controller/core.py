@@ -1062,10 +1062,14 @@ class Controller:
             # drops an accepted uplink: an unreachable owner re-homes
             # (bounded retry/backoff) and the fold-of-last-resort is the
             # root's residual buffer.
-            fwd_sp = _ttrace.span("round.slice_submit",
-                                  parent=self._round_span,
-                                  attrs={"learner": result.learner_id})
-            with fwd_sp:
+            # parent on the uplink's server span when one is active (the
+            # causal chain: learner train → uplink RPC → slice submit),
+            # falling back to the round root for in-process deliveries
+            fwd_sp = _ttrace.span(
+                "round.slice_submit",
+                parent=_ttrace.current_context() or self._round_span,
+                attrs={"learner": result.learner_id})
+            with fwd_sp, fwd_sp.activate():
                 self._slices.submit(result.learner_id, model,
                                     result.round_id)
             _M_PHASE.observe(fwd_sp.duration_ms / 1e3, phase="slice_submit")
@@ -1084,7 +1088,8 @@ class Controller:
                 deferred_meta = True
             else:
                 insert_sp = _ttrace.span(
-                    "round.store_insert", parent=self._round_span,
+                    "round.store_insert",
+                    parent=_ttrace.current_context() or self._round_span,
                     attrs={"learner": result.learner_id})
                 with insert_sp:
                     self._store.insert(result.learner_id, model)
@@ -1598,12 +1603,16 @@ class Controller:
             # next round's uplinks re-derive the straggler median once
             self._straggler_median_cache = None
             round_sp, self._round_span = self._round_span, None
-        if profile_record is not None:
-            # the JSONL sink write stays off the controller lock
-            self._profile.persist(profile_record)
         if round_sp is not None:
+            # end the round root BEFORE the critical-path walk: the walk
+            # reads the finished-span ring, and the root must be in it
             round_sp.set_attr("learners", len(selected))
             round_sp.end()
+        if profile_record is not None:
+            # critical-path walk + JSONL sink write stay off the
+            # controller lock
+            self._profile.attach_critical_path(profile_record)
+            self._profile.persist(profile_record)
         _M_ROUND_DURATION.observe(round_wall_s)
         _M_ROUNDS.inc()
         ckpt = self.config.checkpoint
@@ -1877,7 +1886,7 @@ class Controller:
             slice_sp = _ttrace.span(
                 "round.slice_reduce", parent=agg_sp,
                 attrs={"cohort": len(ids)})
-            with slice_sp:
+            with slice_sp, slice_sp.activate():
                 reduced = self._slices.reduce(
                     ids, scales,
                     stride=self.config.aggregation.stride_length,
@@ -2202,9 +2211,15 @@ class Controller:
                 # root of this round's trace — learner train spans parent
                 # under it via the RPC metadata the dispatch carries
                 self._current_meta.started_at = time.time()
+                # deterministic root: the trace id IS the round serial
+                # (telemetry/causal.py selects a round's tree by id; a
+                # retry dispatch bumped the serial, so its trace never
+                # collides with the aborted attempt's)
                 self._round_span = _ttrace.span(
                     "round", parent=None,
-                    attrs={"round": self.global_iteration})
+                    trace_id=_ttrace.round_trace_id(self._round_serial),
+                    attrs={"round": self.global_iteration,
+                           "serial": self._round_serial})
                 _tevents.emit(_tevents.RoundStarted,
                               round=self.global_iteration,
                               cohort=len(learner_ids))
@@ -2283,8 +2298,12 @@ class Controller:
             # accumulate: join/rejoin re-dispatches add to the same round
             self._current_meta.dispatch_duration_ms += dispatch_sp.duration_ms
             if self._wait_span is None and learner_ids:
+                # passive: the wait measures the barrier, not a cause —
+                # the critical-path walk (telemetry/causal.py) skips it
+                # and descends into the dispatch subtree instead
                 self._wait_span = _ttrace.span("round.wait_uplinks",
-                                               parent=round_span)
+                                               parent=round_span,
+                                               attrs={"passive": True})
         if self._profile is not None:
             # waterfall boundary: the round's FIRST dispatch end (a
             # mid-round rejoin re-dispatch lands inside the wait window

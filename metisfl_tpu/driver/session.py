@@ -556,7 +556,9 @@ class DriverSession:
             # traces.jsonl exists (and grows) WHILE the run is alive
             trace_out=os.path.join(self.workdir, "traces.jsonl"),
             ssl=self.config.ssl, comm=self.config.comm,
-            discover_fn=self._fleet_peer_specs)
+            discover_fn=self._fleet_peer_specs,
+            critical_path=tel.fabric.critical_path,
+            critical_path_edges=tel.fabric.critical_path_edges)
         self._fleet.start()
 
     def fleet_collector(self):

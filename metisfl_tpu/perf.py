@@ -727,6 +727,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="diff a series of bench captures pairwise "
                              "(files and/or dirs of .json); exit 1 on "
                              "regression")
+    parser.add_argument("--critical-path", action="store_true",
+                        help="causal critical path of one round "
+                             "(--round; default: the latest) from a run "
+                             "dir or traces .jsonl — per-edge self-time "
+                             "and the dominant edge")
     parser.add_argument("--flame", metavar="SOURCE",
                         help="render a continuous-profiling capture as "
                              "collapsed folded stacks (stdout; speedscope/"
@@ -749,6 +754,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="comparison: show unchanged keys too")
     args = parser.parse_args(argv)
 
+    if args.critical_path:
+        if not args.paths:
+            parser.print_usage(sys.stderr)
+            return 2
+        return _critical_path_main(args.paths, args.round)
     if args.flame:
         return _flame_main(args.flame, args.round, args.top,
                            out_path=args.out)
@@ -837,6 +847,30 @@ def _trajectory_main(paths: List[str], threshold: float) -> int:
             regressions.append({"key": "value"})
         any_regression = any_regression or bool(regressions)
     return 1 if any_regression else 0
+
+
+def _critical_path_main(paths: List[str],
+                        want_round: Optional[int]) -> int:
+    """``--critical-path``: the longest causal chain of one round from
+    collected spans (fleet traces.jsonl or per-process sink files)."""
+    from metisfl_tpu.telemetry import causal as _causal
+
+    spans: List[dict] = []
+    for path in paths:
+        spans.extend(_load_trace_spans(path))
+    if not spans:
+        print("no trace spans found (is tracing enabled and the run dir "
+              "right?)", file=sys.stderr)
+        return 2
+    cp = _causal.round_critical_path(spans, round_no=want_round)
+    if cp is None:
+        which = (f"round {want_round}" if want_round is not None
+                 else "any round root")
+        print(f"no trace for {which} in {len(spans)} collected span(s)",
+              file=sys.stderr)
+        return 2
+    print(_causal.render_edges(cp))
+    return 0
 
 
 def _waterfall_main(paths: List[str], want_round: Optional[int],

@@ -39,6 +39,7 @@ from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.models.generate import SlotDecoder
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import prof as _prof
+from metisfl_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger("metisfl_tpu.serving")
 
@@ -67,7 +68,7 @@ class _GenPending:
     """One queued generation request + the future its caller blocks on."""
 
     __slots__ = ("prompt", "max_new", "eos_id", "future", "enqueued_at",
-                 "admitted_step")
+                 "admitted_step", "trace_ctx")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  eos_id: Optional[int]):
@@ -77,6 +78,10 @@ class _GenPending:
         self.future: "futures.Future" = futures.Future()
         self.enqueued_at = time.perf_counter()
         self.admitted_step = -1          # step index at admission (test pin)
+        # the submitter's span context: the decode loop retires slots on
+        # its own thread, where contextvars are empty — the causal link
+        # (serving.generate → decode.slot) rides on the request record
+        self.trace_ctx = _ttrace.current_context()
 
 
 class _Slot:
@@ -197,6 +202,17 @@ class ContinuousBatcher:
         req = slot.req
         out = np.full((req.max_new,), PAD_ID, np.int32)
         out[: len(slot.tokens)] = slot.tokens
+        if req.trace_ctx is not None:
+            # enqueue→retire as one already-measured interval, parented
+            # on the submitter's serving.generate span: the queue wait
+            # AND slot occupancy land on the request's causal chain
+            _ttrace.event(
+                "decode.slot", time.perf_counter() - req.enqueued_at,
+                parent=req.trace_ctx,
+                attrs={"channel": self.channel,
+                       "admitted_step": req.admitted_step,
+                       "retired_step": self.steps,
+                       "tokens": len(slot.tokens)})
         if not req.future.done():
             req.future.set_result((out, slot.version))
 

@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional
 from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.telemetry.alerts import AlertRule
 from metisfl_tpu.telemetry.timeseries import TimeSeriesRing
 
@@ -400,33 +401,40 @@ class ServingRouter:
         if not candidates:
             raise RuntimeError("no live serving replicas in the ring")
         last: Optional[Exception] = None
-        for hop, name in enumerate(candidates):
-            with self._lock:
-                replica = self._replicas.get(name)
-                if (replica is None
-                        or replica.state != ReplicaHandle.STATE_UP):
+        # activated: the replica hop's outbound metadata then carries
+        # this span as parent, so the request trace reads router.forward
+        # → rpc.server/<method> on the replica that ACTUALLY served it
+        fwd_sp = _ttrace.span("router.forward", attrs={"method": method})
+        with fwd_sp, fwd_sp.activate():
+            for hop, name in enumerate(candidates):
+                with self._lock:
+                    replica = self._replicas.get(name)
+                    if (replica is None
+                            or replica.state != ReplicaHandle.STATE_UP):
+                        continue
+                    client = self._client_for(replica)
+                if hop:
+                    _M_ROUTER_RETRIES.inc()
+                try:
+                    reply = client.call(method, raw, timeout=timeout,
+                                        wait_ready=False)
+                except Exception as exc:  # noqa: BLE001 - retry next owner
+                    last = exc
+                    _M_ROUTER_REQUESTS.inc(replica=name, outcome="error")
+                    self._note_failure(replica, exc)
                     continue
-                client = self._client_for(replica)
-            if hop:
-                _M_ROUTER_RETRIES.inc()
-            try:
-                reply = client.call(method, raw, timeout=timeout,
-                                    wait_ready=False)
-            except Exception as exc:  # noqa: BLE001 - retry next owner
-                last = exc
-                _M_ROUTER_REQUESTS.inc(replica=name, outcome="error")
-                self._note_failure(replica, exc)
-                continue
-            with self._lock:
-                replica.failures = 0
-                replica.requests += 1
-                self._requests += 1
-            _M_ROUTER_REQUESTS.inc(replica=name, outcome="ok")
-            _M_ROUTER_LATENCY.observe(time.perf_counter() - t0)
-            return reply
-        raise RuntimeError(
-            f"no serving replica could serve the request "
-            f"(tried {candidates}): {last}")
+                with self._lock:
+                    replica.failures = 0
+                    replica.requests += 1
+                    self._requests += 1
+                fwd_sp.set_attr("replica", name)
+                fwd_sp.set_attr("hops", hop + 1)
+                _M_ROUTER_REQUESTS.inc(replica=name, outcome="ok")
+                _M_ROUTER_LATENCY.observe(time.perf_counter() - t0)
+                return reply
+            raise RuntimeError(
+                f"no serving replica could serve the request "
+                f"(tried {candidates}): {last}")
 
     # -- status --------------------------------------------------------- #
 

@@ -38,6 +38,7 @@ from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import prof as _prof
 from metisfl_tpu.telemetry import profile as _tprofile
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.tensor.pytree import (
     ModelBlob,
     named_tensors_to_pytree,
@@ -477,8 +478,11 @@ class ServingGateway:
         if entry is None:
             raise RuntimeError("no model installed (registry has no "
                                "stable version yet)")
-        outs, (version, served_channel) = self._batcher_for(channel).submit(
-            np.asarray(x)).result(timeout=timeout_s)
+        # the batcher worker runs on its own thread: the span brackets
+        # submit→result on THIS thread, which is the request's true wait
+        with _ttrace.span("serving.predict", attrs={"channel": channel}):
+            outs, (version, served_channel) = self._batcher_for(
+                channel).submit(np.asarray(x)).result(timeout=timeout_s)
         with self._lock:
             self._requests += 1
         # label by what ACTUALLY served it: a canary request degraded to
@@ -527,26 +531,32 @@ class ServingGateway:
             if channel not in self._models:
                 raise RuntimeError("no model installed (registry has no "
                                    "stable version yet)")
-        try:
-            tokens, version = self._decoder_for(channel).submit(
-                prompt, max_new_tokens,
-                eos_id=eos_id).result(timeout=timeout_s)
-        except RuntimeError:
-            # the candidate was uninstalled (promoted/superseded) between
-            # routing and decode — its engine is gone or drained closed:
-            # degrade the canary request to stable instead of failing
-            # user traffic, predict()'s exact rule
-            if channel != CHANNEL_CANDIDATE:
-                raise
-            channel = CHANNEL_STABLE
-            with self._lock:
-                if channel not in self._models:
-                    raise RuntimeError(
-                        "no model installed (registry has no stable "
-                        "version yet)") from None
-            tokens, version = self._decoder_for(channel).submit(
-                prompt, max_new_tokens,
-                eos_id=eos_id).result(timeout=timeout_s)
+        # activated (not just opened): the decode loop retires slots on
+        # its own thread, so ContinuousBatcher.submit must capture the
+        # ambient context here to parent the decode.slot span
+        gen_sp = _ttrace.span("serving.generate",
+                              attrs={"channel": channel})
+        with gen_sp, gen_sp.activate():
+            try:
+                tokens, version = self._decoder_for(channel).submit(
+                    prompt, max_new_tokens,
+                    eos_id=eos_id).result(timeout=timeout_s)
+            except RuntimeError:
+                # the candidate was uninstalled (promoted/superseded)
+                # between routing and decode — its engine is gone or
+                # drained closed: degrade the canary request to stable
+                # instead of failing user traffic, predict()'s exact rule
+                if channel != CHANNEL_CANDIDATE:
+                    raise
+                channel = CHANNEL_STABLE
+                with self._lock:
+                    if channel not in self._models:
+                        raise RuntimeError(
+                            "no model installed (registry has no stable "
+                            "version yet)") from None
+                tokens, version = self._decoder_for(channel).submit(
+                    prompt, max_new_tokens,
+                    eos_id=eos_id).result(timeout=timeout_s)
         with self._lock:
             self._requests += 1
         _M_REQUESTS.inc(channel=channel)

@@ -20,6 +20,7 @@ from metisfl_tpu.comm.messages import (GenerateReply, GenerateRequest,
                                        ServeReply, ServeRequest)
 from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
 from metisfl_tpu.serving.gateway import ServingGateway
+from metisfl_tpu.telemetry import trace as _ttrace
 from metisfl_tpu.tensor.pytree import ModelBlob
 
 logger = logging.getLogger("metisfl_tpu.serving.service")
@@ -147,8 +148,17 @@ class ServingClient:
             key=key,
             inputs=ModelBlob(
                 tensors=[("x", np.asarray(x))]).to_bytes())
-        return ServeReply.from_wire(
-            self._client.call("Predict", req.to_wire(), timeout=timeout))
+        # deterministic serving trace root: the trace id is a pure
+        # function of the request id, so any party holding the id can
+        # look the trace up without a side channel
+        sp = _ttrace.span(
+            "serving.request", parent=None,
+            trace_id=_ttrace.request_trace_id(req.request_id),
+            attrs={"request_id": req.request_id, "method": "Predict"})
+        with sp, sp.activate():
+            return ServeReply.from_wire(
+                self._client.call("Predict", req.to_wire(),
+                                  timeout=timeout))
 
     def predictions(self, reply: ServeReply) -> np.ndarray:
         return dict(ModelBlob.from_bytes(
@@ -168,8 +178,14 @@ class ServingClient:
                  np.asarray(prompt, np.int32).reshape(-1))]).to_bytes(),
             max_new_tokens=int(max_new_tokens),
             eos_id=int(eos_id))
-        return GenerateReply.from_wire(
-            self._client.call("Generate", req.to_wire(), timeout=timeout))
+        sp = _ttrace.span(
+            "serving.request", parent=None,
+            trace_id=_ttrace.request_trace_id(req.request_id),
+            attrs={"request_id": req.request_id, "method": "Generate"})
+        with sp, sp.activate():
+            return GenerateReply.from_wire(
+                self._client.call("Generate", req.to_wire(),
+                                  timeout=timeout))
 
     def tokens(self, reply: GenerateReply) -> np.ndarray:
         return dict(ModelBlob.from_bytes(reply.tokens).tensors)["tokens"]

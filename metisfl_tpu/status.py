@@ -332,6 +332,18 @@ def render_fleet(snap: Dict[str, Any], span_tail: int = 25,
             cells.append(cell)
         if cells:
             lines.append("prof: " + "  |  ".join(cells))
+    crit = snap.get("crit") or {}
+    if crit:
+        # latest round's causal critical path (telemetry/causal.py via
+        # the fleet collector): who the round actually waited on
+        edges = "  ->  ".join(
+            f"{e.get('label', '?')} {e.get('self_ms', 0.0):.0f}ms"
+            for e in crit.get("edges", ())[:4])
+        lines.append(
+            f"crit: round {crit.get('round', '?')} "
+            f"{crit.get('coverage', 0.0) * 100:.0f}% of "
+            f"{crit.get('total_ms', 0.0) / 1e3:.2f}s"
+            + (f" = {edges}" if edges else ""))
     families = snap.get("families") or {}
     if families:
         shown = []

@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from metisfl_tpu.store.base import ModelStore
 from metisfl_tpu.telemetry import prof as _prof
+from metisfl_tpu.telemetry import trace as _trace
 
 logger = logging.getLogger("metisfl_tpu.store.ingest")
 
@@ -93,6 +94,10 @@ class IngestPipeline:
         the controller hangs result-metadata updates off it so a failed
         (fail-soft) write never pairs fresh metadata with the learner's
         older stored model."""
+        # the uplink's span context, captured on the RPC thread: the
+        # worker's contextvars are empty, so the causal link (train →
+        # uplink → ingest write) must travel with the queue entry
+        trace_ctx = _trace.current_context()
         with self._cond:
             if self._closed:
                 raise RuntimeError("ingest pipeline is shut down")
@@ -103,7 +108,8 @@ class IngestPipeline:
             self._pending[learner_id] = self._pending.get(learner_id, 0) + 1
             self._pending_total += 1
         try:
-            self._pool.submit(self._write, learner_id, model, on_success)
+            self._pool.submit(self._write, learner_id, model, on_success,
+                              trace_ctx)
         except BaseException:
             # a shutdown racing this submit: roll the counters back so
             # drain() fences don't wait on a write that will never run
@@ -122,7 +128,8 @@ class IngestPipeline:
 
     # -- worker ------------------------------------------------------------
     def _write(self, learner_id: str, model: Any,
-               on_success: Optional[Callable[[float], None]]) -> None:
+               on_success: Optional[Callable[[float], None]],
+               trace_ctx=None) -> None:
         t0 = time.perf_counter()
         ok = True
         try:
@@ -143,6 +150,11 @@ class IngestPipeline:
                 self._last_errors.append(f"{learner_id}: {exc!r}")
                 del self._last_errors[:-8]
         ms = (time.perf_counter() - t0) * 1e3
+        if ok and trace_ctx is not None:
+            # the write's span, parented on the uplink that queued it
+            # (already-measured interval: no open-span bookkeeping)
+            _trace.event("round.store_insert", ms / 1e3, parent=trace_ctx,
+                         attrs={"learner": learner_id, "ingest": True})
         if ok:
             # success callbacks run BEFORE the pending decrement so a
             # drain() fence returning implies their effects are visible

@@ -96,6 +96,8 @@ M_LEARNER_CHURN_SCORE = "learner_churn_score"
 # learning-health plane (controller/core.py + telemetry/health.py)
 M_LEARNER_DIVERGENCE_SCORE = "learner_divergence_score"
 M_ROUND_UPDATE_NORM = "round_update_norm"
+# causal tracing plane (telemetry/causal.py + telemetry/fabric.py)
+M_ROUND_CRITICAL_PATH_SECONDS = "round_critical_path_seconds"
 # performance observatory (telemetry/profile.py + controller/core.py)
 M_DOWNLINK_BYTES_TOTAL = "downlink_bytes_total"
 M_CODEC_LEARNER_SECONDS = "codec_learner_seconds_total"

@@ -249,6 +249,12 @@ def main(argv: List[str]) -> int:
         from metisfl_tpu.telemetry import prof as _prof
         return _prof.main(
             ["--smoke"] + [a for a in argv if a != "--prof-smoke"])
+    if "--causal-smoke" in argv:
+        # the causal-tracing CI gate (scripts/chaos_smoke.sh): slowed-
+        # learner attribution + orphan lint + propagation overhead
+        from metisfl_tpu.telemetry import causal as _causal
+        return _causal.main(
+            ["--smoke"] + [a for a in argv if a != "--causal-smoke"])
     show_attrs = "--attrs" in argv
     argv = [a for a in argv if a != "--attrs"]
     want_trace = want_round = None

@@ -1,0 +1,542 @@
+"""Causal analysis over collected trace spans: critical paths + lints.
+
+The trace layer (telemetry/trace.py) already records every hop of a
+federation round — controller dispatch, learner train, uplink ingest,
+slice fold, finalize — and of a serving request (router forward, replica
+predict/generate, decode slots) as spans stitched by wire-propagated
+trace ids. This module turns one trace's spans into *attribution*:
+
+- :func:`critical_path` walks the span tree and returns the longest
+  causal chain with per-edge self-time — "round 7: 83% = learner_3
+  train → uplink RTT → slice_1 fold". The walk is hierarchical and
+  fork-join aware: at each node it greedily covers the node's window
+  backwards from its end with the children whose *subtrees* finish
+  latest (a child's subtree can outlive the child itself — the learner
+  task span ends after the dispatch span that caused it), recursing into
+  each selected child with the remaining window. Time a node's window
+  not covered by selected children is the node's *self* time (e.g. the
+  uplink RTT gap between a train span ending and its ingest landing).
+  Self-times telescope: they sum to the root's duration exactly.
+- Spans flagged ``attrs.passive`` (the controller's barrier wait) are
+  *skipped* as chain candidates: a wait explains nothing — the thing it
+  waited on does.
+- :func:`orphan_spans` is the causality lint: spans whose parent id
+  resolves to no collected span. Outside the fabric's reported
+  ring-eviction budget (``spans_lost``), an orphan is a propagation bug
+  (a hop that dropped the context), not a rendering detail.
+
+``python -m metisfl_tpu.telemetry --causal-smoke`` runs the CI gate:
+context propagation over real gRPC with a deliberately slowed learner
+must name that learner as the dominant edge (and a control run must
+not), the orphan lint must pass, and per-RPC propagation overhead must
+stay within budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from metisfl_tpu.telemetry import trace as _trace
+
+# spans with this attribute are never chain candidates: their duration
+# measures waiting, and the critical path wants the cause of the wait
+PASSIVE_ATTR = "passive"
+
+# edge labels prefer the per-role identity when one is attached
+_IDENTITY_ATTRS = ("learner", "slice", "replica")
+
+
+def _end_s(span: Dict[str, Any]) -> float:
+    return float(span.get("start", 0.0)) + float(span.get("dur_ms",
+                                                          0.0)) / 1e3
+
+
+def _is_passive(span: Dict[str, Any]) -> bool:
+    return bool((span.get("attrs") or {}).get(PASSIVE_ATTR))
+
+
+def edge_label(span: Dict[str, Any]) -> str:
+    """``who/what`` for one chain edge: the fabric's peer name when the
+    record was fleet-collected, else a role identity attribute (learner /
+    slice / replica), else the recording process's service name."""
+    attrs = span.get("attrs") or {}
+    who = span.get("peer") or ""
+    if not who:
+        for key in _IDENTITY_ATTRS:
+            if attrs.get(key):
+                who = str(attrs[key])
+                break
+    who = who or str(span.get("service") or "?")
+    return f"{who}/{span.get('name', '?')}"
+
+
+def dedupe_spans(spans: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One record per span id. The driver can legitimately collect a span
+    twice (live fabric pull + shutdown file merge); keep the richer
+    record (a fleet-collected one carries ``peer`` and a skew-corrected
+    ``start``)."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for span in spans:
+        sid = span.get("span")
+        if not sid:
+            continue
+        held = by_id.get(sid)
+        if held is None or (span.get("peer") and not held.get("peer")):
+            by_id[sid] = span
+    return list(by_id.values())
+
+
+def orphan_spans(spans: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Spans whose parent id resolves to no collected span — causality
+    gaps. A clean traced run has none; a run that reported ring
+    evictions (``spans_lost``) may have up to that many."""
+    records = dedupe_spans(spans)
+    ids = {s["span"] for s in records}
+    return [s for s in records
+            if s.get("parent") and s["parent"] not in ids]
+
+
+def round_roots(spans: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The controller round root spans, oldest first."""
+    roots = [s for s in spans
+             if s.get("name") == "round"
+             and "round" in (s.get("attrs") or {})]
+    roots.sort(key=lambda s: (s.get("start", 0.0)))
+    return roots
+
+
+def critical_path(spans: Iterable[Dict[str, Any]],
+                  root_span_id: Optional[str] = None,
+                  trace_id: Optional[str] = None
+                  ) -> Optional[Dict[str, Any]]:
+    """The longest causal chain through one trace.
+
+    ``spans`` may hold many traces; ``trace_id`` (or the chosen root's
+    trace) selects one. The root defaults to the no-parent span with the
+    largest window in that trace. Same-trace spans whose parent was
+    never collected (single-process analysis of a multi-process round,
+    ring eviction) attach under the root as *detached* subtrees so their
+    time still attributes. Returns None when no root exists.
+    """
+    records = dedupe_spans(spans)
+    if trace_id is not None:
+        records = [s for s in records if s.get("trace") == trace_id]
+    if not records:
+        return None
+    by_id = {s["span"]: s for s in records}
+    root: Optional[Dict[str, Any]] = None
+    if root_span_id is not None:
+        root = by_id.get(root_span_id)
+        if root is None:
+            return None
+        records = [s for s in records
+                   if s.get("trace") == root.get("trace")]
+        by_id = {s["span"]: s for s in records}
+    else:
+        tops = [s for s in records
+                if not s.get("parent") or s["parent"] not in by_id]
+        if trace_id is None and tops:
+            # widest top-level window wins; then keep only its trace
+            root = max(tops, key=lambda s: float(s.get("dur_ms", 0.0)))
+            records = [s for s in records
+                       if s.get("trace") == root.get("trace")]
+            by_id = {s["span"]: s for s in records}
+        elif tops:
+            root = max(tops, key=lambda s: float(s.get("dur_ms", 0.0)))
+    if root is None:
+        return None
+
+    children: Dict[str, List[str]] = {}
+    detached = 0
+    for s in records:
+        if s is root:
+            continue
+        parent = s.get("parent") or ""
+        if parent in by_id and parent != s["span"]:
+            children.setdefault(parent, []).append(s["span"])
+        else:
+            # same trace, parent never collected: attach under the root
+            # so its subtree still attributes (flagged in the result)
+            children.setdefault(root["span"], []).append(s["span"])
+            detached += 1
+
+    # subtree end: a span's own end or its latest descendant's — async
+    # children (a train span outliving the dispatch that caused it)
+    # extend the parent's causal reach
+    sub_end: Dict[str, float] = {}
+
+    def _subtree_end(sid: str) -> float:
+        stack = [(sid, False)]
+        while stack:
+            cur, expanded = stack.pop()
+            if cur in sub_end:
+                continue
+            kids = children.get(cur, ())
+            if expanded or not kids:
+                end = _end_s(by_id[cur])
+                for k in kids:
+                    end = max(end, sub_end.get(k, 0.0))
+                sub_end[cur] = end
+            else:
+                stack.append((cur, True))
+                stack.extend((k, False) for k in kids
+                             if k not in sub_end)
+        return sub_end[sid]
+
+    _subtree_end(root["span"])
+
+    edges: List[Dict[str, Any]] = []
+    root_lo = float(root.get("start", 0.0))
+    root_hi = max(_end_s(root), root_lo)
+    # (span id, window lo, window hi) — pre-order, children pushed in
+    # reverse chronological order so the chain pops chronologically
+    walk: List[Tuple[str, float, float]] = [(root["span"], root_lo,
+                                             root_hi)]
+    while walk:
+        sid, lo, hi = walk.pop()
+        node = by_id[sid]
+        kids = sorted(
+            (k for k in children.get(sid, ())
+             if not _is_passive(by_id[k])),
+            key=lambda k: sub_end.get(k, 0.0), reverse=True)
+        cursor = hi
+        picked: List[Tuple[str, float, float]] = []
+        for k in kids:
+            k_lo = float(by_id[k].get("start", 0.0))
+            k_hi = min(sub_end.get(k, 0.0), cursor)
+            if k_hi <= lo or k_lo >= cursor or k_hi <= max(k_lo, lo):
+                continue
+            picked.append((k, max(k_lo, lo), k_hi))
+            cursor = max(k_lo, lo)
+            if cursor <= lo:
+                break
+        covered = sum(k_hi - k_lo for _, k_lo, k_hi in picked)
+        self_ms = max(0.0, ((hi - lo) - covered) * 1e3)
+        edges.append({
+            "name": node.get("name", "?"),
+            "label": edge_label(node),
+            "service": node.get("peer") or node.get("service") or "?",
+            "span": sid,
+            "start": round(lo, 6),
+            "self_ms": round(self_ms, 3),
+        })
+        walk.extend(reversed(picked))
+
+    total_ms = max((root_hi - root_lo) * 1e3, 1e-9)
+    for edge in edges:
+        edge["share"] = round(edge["self_ms"] / total_ms, 4)
+    dominant = max(edges, key=lambda e: e["self_ms"]) if edges else None
+    root_self = edges[0]["self_ms"] if edges else 0.0
+    result = {
+        "trace": root.get("trace", ""),
+        "root": root.get("name", "?"),
+        "root_span": root["span"],
+        "start": root_lo,
+        "total_ms": round(total_ms, 3),
+        # share of the root window the chain attributes BELOW the root
+        # (the root's own self-time is unexplained gap)
+        "coverage": round(max(0.0, 1.0 - root_self / total_ms), 4),
+        "edges": edges,
+        "dominant": dominant["label"] if dominant else "",
+        "spans": len(records),
+        "detached": detached,
+    }
+    attrs = root.get("attrs") or {}
+    if "round" in attrs:
+        try:
+            result["round"] = int(attrs["round"])
+        except (TypeError, ValueError):
+            pass
+    if "request_id" in attrs:
+        result["request_id"] = str(attrs["request_id"])
+    return result
+
+
+def round_critical_path(spans: Iterable[Dict[str, Any]],
+                        round_no: Optional[int] = None
+                        ) -> Optional[Dict[str, Any]]:
+    """Critical path of one federation round (the latest completed one
+    when ``round_no`` is omitted). Selects the round's trace by its root
+    span, so co-collected serving traces never leak in."""
+    records = dedupe_spans(spans)
+    roots = round_roots(records)
+    if round_no is not None:
+        roots = [r for r in roots
+                 if str((r.get("attrs") or {}).get("round"))
+                 == str(round_no)]
+    if not roots:
+        return None
+    root = roots[-1]
+    return critical_path(records, root_span_id=root["span"])
+
+
+def _fmt_ms(ms: float) -> str:
+    return f"{ms / 1e3:.2f}s" if ms >= 1e3 else f"{ms:.0f}ms"
+
+
+def render(cp: Dict[str, Any], min_share: float = 0.05,
+           max_edges: int = 6) -> str:
+    """One-line summary: ``round 7: 83% = learner_3/learner.train 1.2s
+    -> controller/round.aggregate 0.3s`` — the chain's heaviest edges in
+    causal order."""
+    if "round" in cp:
+        subject = f"round {cp['round']}"
+    elif cp.get("request_id"):
+        subject = f"request {cp['request_id'][:12]}"
+    else:
+        subject = f"trace {str(cp.get('trace', ''))[:8]}"
+    heavy = [e for e in cp.get("edges", ())[1:]
+             if e.get("share", 0.0) >= min_share]
+    heavy.sort(key=lambda e: e["start"])
+    heavy = heavy[:max_edges]
+    if not heavy:
+        return (f"{subject}: no attributable chain "
+                f"({_fmt_ms(cp.get('total_ms', 0.0))} total)")
+    chain = " -> ".join(
+        f"{e['label']} {_fmt_ms(e['self_ms'])}" for e in heavy)
+    return (f"{subject}: {cp.get('coverage', 0.0) * 100:.0f}% = {chain}"
+            f"  [{_fmt_ms(cp.get('total_ms', 0.0))} total]")
+
+
+def render_edges(cp: Dict[str, Any]) -> str:
+    """Full chain, one edge per line, causal (walk) order."""
+    lines = [render(cp)]
+    for e in cp.get("edges", ()):
+        lines.append(f"  {e['share'] * 100:5.1f}%  "
+                     f"{_fmt_ms(e['self_ms']):>8}  {e['label']}")
+    if cp.get("detached"):
+        lines.append(f"  ({cp['detached']} detached subtree(s) attached "
+                     "at the root: parents not collected here)")
+    return "\n".join(lines)
+
+
+def summarize(cp: Dict[str, Any], top: int = 5) -> Dict[str, Any]:
+    """Compact per-round summary (RoundProfile.critical_path, the fleet
+    snapshot's ``crit`` entry): heaviest edges only."""
+    edges = sorted(cp.get("edges", ()), key=lambda e: -e["self_ms"])[:top]
+    out = {
+        "trace": cp.get("trace", ""),
+        "total_ms": cp.get("total_ms", 0.0),
+        "coverage": cp.get("coverage", 0.0),
+        "dominant": cp.get("dominant", ""),
+        "edges": [{"label": e["label"], "self_ms": e["self_ms"],
+                   "share": e["share"]} for e in edges],
+        "detached": cp.get("detached", 0),
+    }
+    if "round" in cp:
+        out["round"] = cp["round"]
+    return out
+
+
+# --------------------------------------------------------------------- #
+# CI smoke gate (scripts/chaos_smoke.sh)
+# --------------------------------------------------------------------- #
+
+
+def _propagation_overhead_ns(iters: int = 20000) -> float:
+    """Mean cost of one RPC's worth of context propagation: inject on
+    the client (outbound_metadata) + extract on the server."""
+    with _trace.span("causal.smoke.bench", parent=None) as sp:
+        with sp.activate():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                md = _trace.outbound_metadata()
+                _trace.extract(md)
+            elapsed = time.perf_counter() - t0
+    return elapsed / iters * 1e9
+
+
+def _smoke_round(slow_factor: float, serial: int,
+                 base_s: float = 0.05) -> List[Dict[str, Any]]:
+    """One in-process federation round over REAL gRPC: controller
+    dispatches to two learner servers (learner_1 slowed by
+    ``slow_factor``), each learner reports its uplink back to the
+    controller server, and the controller folds through a slice server —
+    every hop context-propagated through comm/rpc.py. Returns the
+    collected finished-span records."""
+    from metisfl_tpu.comm.rpc import BytesService, RpcClient, RpcServer
+
+    _trace.configure(enabled=True, service="causal-smoke", dir="")
+    _trace.configure_ring(4096)
+    cursor_start = _trace.spans_since(0)[1]
+
+    uplinks: List[str] = []
+
+    def _train(name: str, factor: float):
+        def handler(payload: bytes) -> bytes:
+            with _trace.span("learner.train",
+                             attrs={"learner": name}) as sp:
+                with sp.activate():
+                    time.sleep(base_s * factor)
+                    ctrl_client.call("TrainDone",
+                                     name.encode("utf-8"))
+            return b"ok"
+        return handler
+
+    def _train_done(payload: bytes) -> bytes:
+        with _trace.span("round.store_insert",
+                         attrs={"learner": payload.decode("utf-8")}):
+            time.sleep(0.002)
+        uplinks.append(payload.decode("utf-8"))
+        return b"ok"
+
+    def _fold(payload: bytes) -> bytes:
+        # longer than an unslowed train with margin: the CONTROL run's
+        # dominant edge is deterministically the fold, never a learner
+        with _trace.span("slice.fold", attrs={"slice": "slice_0"}):
+            time.sleep(base_s * 2.4)
+        return b"ok"
+
+    ctrl = RpcServer("127.0.0.1", 0)
+    ctrl.add_service(BytesService("smoke.Controller",
+                                  {"TrainDone": _train_done}))
+    ctrl_port = ctrl.start()
+    ctrl_client = RpcClient("127.0.0.1", ctrl_port, "smoke.Controller")
+
+    learners = {}
+    for i in range(2):
+        name = f"learner_{i}"
+        server = RpcServer("127.0.0.1", 0)
+        factor = slow_factor if name == "learner_1" else 1.0
+        server.add_service(BytesService("smoke.Learner",
+                                        {"RunTask": _train(name, factor)}))
+        port = server.start()
+        learners[name] = (server,
+                          RpcClient("127.0.0.1", port, "smoke.Learner"))
+
+    slice_srv = RpcServer("127.0.0.1", 0)
+    slice_srv.add_service(BytesService("smoke.Slice", {"FoldPartial":
+                                                       _fold}))
+    slice_port = slice_srv.start()
+    slice_client = RpcClient("127.0.0.1", slice_port, "smoke.Slice")
+
+    try:
+        root = _trace.span("round", parent=None,
+                           trace_id=_trace.round_trace_id(serial),
+                           attrs={"round": serial})
+        with root.activate():
+            dispatch = _trace.span("round.dispatch")
+            with dispatch, dispatch.activate():
+                ctx = _trace.current_context()
+
+                def _dispatch_one(client):
+                    with _trace.use_context(ctx):
+                        client.call("RunTask", b"go", timeout=30.0)
+
+                threads = [threading.Thread(target=_dispatch_one,
+                                            args=(client,))
+                           for _, client in learners.values()]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            with _trace.span("round.aggregate") as agg:
+                with agg.activate():
+                    slice_client.call("FoldPartial", b"fold",
+                                      timeout=30.0)
+        root.end()
+    finally:
+        for server, client in learners.values():
+            client.close()
+            server.stop(grace=0.2)
+        slice_client.close()
+        ctrl_client.close()
+        ctrl.stop(grace=0.2)
+    if sorted(uplinks) != ["learner_0", "learner_1"]:
+        raise RuntimeError(f"uplinks incomplete: {uplinks}")
+    records, _cursor, lost = _trace.spans_since(cursor_start)
+    if lost:
+        raise RuntimeError(f"span ring evicted {lost} records mid-smoke")
+    return [r for r in records
+            if r.get("trace") == _trace.round_trace_id(serial)]
+
+
+def _smoke(overhead_budget_ns: float = 50000.0) -> int:
+    """Exit 0 when every gate passes: single-trace propagation across
+    dispatch → train → uplink → fold, orphan lint clean, the slowed
+    learner dominant (and NOT dominant in the control run), propagation
+    overhead within budget."""
+    failures: List[str] = []
+
+    slow = _smoke_round(slow_factor=8.0, serial=7)
+    control = _smoke_round(slow_factor=1.0, serial=8)
+
+    for tag, records in (("slow", slow), ("control", control)):
+        names = {r.get("name") for r in records}
+        need = {"round", "round.dispatch", "rpc.server/RunTask",
+                "learner.train", "rpc.server/TrainDone",
+                "round.store_insert", "round.aggregate",
+                "rpc.server/FoldPartial", "slice.fold"}
+        missing = need - names
+        if missing:
+            failures.append(f"{tag}: hops missing from the trace: "
+                            f"{sorted(missing)}")
+        if len({r.get("trace") for r in records}) != 1:
+            failures.append(f"{tag}: expected ONE trace id, got "
+                            f"{len({r.get('trace') for r in records})}")
+        orphans = orphan_spans(records)
+        if orphans:
+            failures.append(
+                f"{tag}: orphan lint: {len(orphans)} span(s) with "
+                f"uncollected parents outside a zero spans_lost budget: "
+                f"{[o.get('name') for o in orphans]}")
+
+    cp_slow = round_critical_path(slow, round_no=7)
+    cp_control = round_critical_path(control, round_no=8)
+    if cp_slow is None or cp_control is None:
+        failures.append("critical path could not be computed")
+    else:
+        print("slow:    " + render(cp_slow))
+        print("control: " + render(cp_control))
+        if "learner_1" not in cp_slow["dominant"]:
+            failures.append("slow run: dominant edge is "
+                            f"{cp_slow['dominant']!r}, expected the "
+                            "slowed learner_1")
+        if "learner_1" in cp_control["dominant"]:
+            failures.append("control run: dominant edge "
+                            f"{cp_control['dominant']!r} names the "
+                            "learner that was NOT slowed")
+        if cp_slow["coverage"] < 0.9:
+            failures.append(f"slow run: chain coverage "
+                            f"{cp_slow['coverage']:.2f} < 0.90")
+
+    overhead = _propagation_overhead_ns()
+    print(f"propagation overhead: {overhead:.0f}ns/RPC "
+          f"(budget {overhead_budget_ns:.0f}ns)")
+    if overhead > overhead_budget_ns:
+        failures.append(f"propagation overhead {overhead:.0f}ns/RPC "
+                        f"over budget {overhead_budget_ns:.0f}ns")
+
+    if failures:
+        for failure in failures:
+            print(f"FAIL: {failure}")
+        return 1
+    print("causal-smoke: all gates passed")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        "metisfl_tpu.telemetry.causal",
+        description="causal trace analysis utilities")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the CI causal-tracing gate (in-process "
+                             "real-gRPC hops; exit 1 on failure)")
+    parser.add_argument("--overhead-budget-ns", type=float,
+                        default=50000.0,
+                        help="smoke: per-RPC propagation overhead bound")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        return _smoke(overhead_budget_ns=args.overhead_budget_ns)
+    parser.print_usage()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -272,6 +272,11 @@ class RoundProfile:
     # {"samples": N, "stacks": [[folded_stack, delta], ...]}. Empty when
     # the sampler is off; perf --flame-diff run@A run@B diffs rounds.
     prof: Dict[str, Any] = field(default_factory=dict)
+    # controller-local causal critical path (telemetry/causal.py
+    # summarize()): the round's longest chain from the finished-span
+    # ring — heaviest edges + the dominant one. Empty when the span ring
+    # is off; the fleet collector's crit entry is the cross-process view.
+    critical_path: Dict[str, Any] = field(default_factory=dict)
     # jax.profiler capture armed for this round (trace_every_rounds)
     trace_armed: bool = False
     schema: int = SCHEMA_VERSION
@@ -330,6 +335,13 @@ class ProfileCollector:
         # bounded recent-profile tail (post-mortem bundles, describe())
         self._tail: List[dict] = []
         self._tail_limit = 16
+        # finished-span ring cursor + bounded record buffer for the
+        # per-round critical path (attach_critical_path): the buffer
+        # carries spans across pulls so an aggregation-failure retry's
+        # early spans are still visible when the retry's round closes
+        self._span_cursor = 0
+        self._span_buf: List[dict] = []
+        self._span_buf_limit = 4096
         # optional serving-occupancy probe (in-process gateway / tests):
         # a zero-arg callable returning a small dict snapshot
         self.serving_probe: Optional[Callable[[], Dict[str, Any]]] = None
@@ -529,6 +541,35 @@ class ProfileCollector:
             self._tail.append(record)
             del self._tail[:-self._tail_limit]
         return record
+
+    def attach_critical_path(self, record: dict) -> None:
+        """Fold the round's causal critical path (telemetry/causal.py)
+        into an assembled profile record, in place. Called OFF the
+        controller lock and strictly AFTER the round span ends — the
+        walk reads the finished-span ring, so the root record must have
+        landed. Populates nothing when the ring is off (``telemetry.
+        fabric.span_ring`` unset and fabric disabled) — the field stays
+        its empty default."""
+        try:
+            from metisfl_tpu.telemetry import causal as _causal
+            from metisfl_tpu.telemetry import trace as _trace
+
+            records, cursor, _lost = _trace.spans_since(self._span_cursor)
+            with self._lock:
+                self._span_cursor = cursor
+                if records:
+                    self._span_buf.extend(records)
+                    del self._span_buf[:-self._span_buf_limit]
+                spans = list(self._span_buf)
+            if not spans:
+                return
+            cp = _causal.round_critical_path(
+                spans, round_no=record.get("round"))
+            if cp is None:
+                return
+            record["critical_path"] = _causal.summarize(cp)
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            logger.exception("round critical-path attribution failed")
 
     @staticmethod
     def _codec_totals() -> Dict[Any, float]:

@@ -13,8 +13,15 @@ driver collects the sink files rather than opening spans). Spans are:
   hops threads (the controller's scheduling executor, the learner's train
   thread) — never inferred across a pool boundary;
 - cross-process: :func:`outbound_metadata` / :func:`extract` carry the
-  context over gRPC metadata (key ``metisfl-trace-ctx``), so a learner's
-  train span parents under the controller round span that dispatched it.
+  context over gRPC metadata (key ``metisfl-trace-ctx``) in a
+  W3C-traceparent-style frame (``00-<trace_id>-<span_id>-01``), so a
+  learner's train span parents under the controller round span that
+  dispatched it;
+- deterministic at the root: the controller derives the round trace id
+  from its round serial (:func:`round_trace_id`) and serving clients
+  derive theirs from the request id (:func:`request_trace_id`), so the
+  causal analyzer (telemetry/causal.py) can name a round's or request's
+  trace without a join table.
 
 Finished spans append one JSON line to ``<dir>/<service>-<pid>.jsonl``
 (per-process file: concurrent federation processes on one host must not
@@ -26,6 +33,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import contextvars
+import hashlib
 import json
 import os
 import threading
@@ -57,14 +65,44 @@ class SpanContext:
     span_id: str
 
     def to_wire(self) -> str:
-        return f"{self.trace_id}/{self.span_id}"
+        # W3C-traceparent framing: version 00, sampled flag 01. Trace and
+        # span ids are hex (never contain "-"), so the frame splits
+        # unambiguously.
+        return f"00-{self.trace_id}-{self.span_id}-01"
 
     @classmethod
     def from_wire(cls, value: str) -> Optional["SpanContext"]:
+        parts = value.split("-")
+        if len(parts) == 4:
+            _version, trace_id, span_id, _flags = parts
+            if trace_id and span_id:
+                return cls(trace_id=trace_id, span_id=span_id)
+            return None
+        # pre-traceparent peers framed the context as "trace/span" —
+        # tolerated so a mixed-version fleet keeps stitching
         trace_id, sep, span_id = value.partition("/")
         if not sep or not trace_id or not span_id:
             return None
         return cls(trace_id=trace_id, span_id=span_id)
+
+
+def round_trace_id(serial: int) -> str:
+    """Deterministic 32-hex trace id for one federation round dispatch:
+    the controller's round serial, zero-extended. Every hop the round
+    causes — dispatch, train, uplink, ingest, slice fold, finalize —
+    shares it, so ``perf --critical-path --round N`` selects the round's
+    causal tree by id, not by timestamp heuristics."""
+    return f"{int(serial) & ((1 << 128) - 1):032x}"
+
+
+def request_trace_id(request_id: str) -> str:
+    """Deterministic 32-hex trace id for one serving request (router →
+    replica → decode-slot chain), derived from the request id. The raw
+    request id travels as a span attribute; the hash keeps the trace id
+    fixed-width for arbitrary caller-chosen ids."""
+    digest = hashlib.sha256(b"metisfl-req:"
+                            + str(request_id).encode("utf-8", "replace"))
+    return digest.hexdigest()[:32]
 
 
 class Span:
@@ -77,9 +115,13 @@ class Span:
 
     def __init__(self, tracer: "_Tracer", name: str,
                  parent: Optional[SpanContext],
-                 attrs: Optional[Dict[str, Any]] = None):
+                 attrs: Optional[Dict[str, Any]] = None,
+                 trace_id: Optional[str] = None):
         self.name = name
-        self.trace_id = parent.trace_id if parent else os.urandom(16).hex()
+        # a parent's trace wins; an explicit trace_id names a NEW root
+        # trace deterministically (round serial / serving request id)
+        self.trace_id = (parent.trace_id if parent
+                         else (trace_id or os.urandom(16).hex()))
         self.span_id = os.urandom(8).hex()
         self.parent_id = parent.span_id if parent else ""
         self.attrs: Dict[str, Any] = dict(attrs or {})
@@ -397,17 +439,20 @@ def spans_since(cursor: int, limit: int = 0) -> Tuple[List[dict], int, int]:
 
 
 def span(name: str, parent: Any = _USE_CURRENT,
-         attrs: Optional[Dict[str, Any]] = None):
+         attrs: Optional[Dict[str, Any]] = None,
+         trace_id: Optional[str] = None):
     """Open a span. ``parent``: omitted → the calling context's active
     span; ``None`` → a new root trace; a :class:`Span` or
-    :class:`SpanContext` → explicit parent (the cross-thread form)."""
+    :class:`SpanContext` → explicit parent (the cross-thread form).
+    ``trace_id`` names a root trace deterministically (ignored when a
+    parent supplies one)."""
     if not _TRACER.enabled:
         return _NullSpan()
     if parent is _USE_CURRENT:
         parent = _CURRENT.get()
     elif isinstance(parent, (Span, _NullSpan)):
         parent = parent.context()
-    sp = Span(_TRACER, name, parent, attrs)
+    sp = Span(_TRACER, name, parent, attrs, trace_id=trace_id)
     # only factory-made spans are tracked as open: event() spans below are
     # born already-finished and must never show up in open_spans()
     _TRACER._opened(sp)

@@ -134,4 +134,28 @@ case "$rc" in
           "(rc=$rc)" >&2
      rc=2 ;;
 esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
+# ISSUE 16 causal-tracing gate (docs/OBSERVABILITY.md "Causal tracing"):
+# two same-seed synthetic rounds — one with a slowed learner, one
+# control — walked by the critical-path analyzer. The build fails when
+# the slow run's dominant edge is not the slowed learner's train span,
+# when the control attributes a dominant learner at all, when chain
+# coverage drops under 90% of round wall-clock, when the orphan lint
+# trips outside the spans_lost budget, or when per-RPC context
+# propagation costs more than the pinned 50 µs.
+JAX_PLATFORMS=cpu timeout -k 10 60 "$PYTHON" -m metisfl_tpu.telemetry \
+  --causal-smoke --overhead-budget-ns 50000
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: causal-trace PASS (slowed learner named dominant" \
+          "edge, control unattributed, chain coverage >= 90%, no orphan" \
+          "spans, propagation overhead within budget)" ;;
+  1) echo "chaos_smoke: causal-trace FAIL — wrong/missing dominant edge," \
+          "coverage or orphan lint failed, or propagation overhead past" \
+          "budget (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: causal-trace FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
 exit "$rc"
