@@ -38,7 +38,6 @@ import argparse
 import logging
 import os
 import sys
-import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -46,6 +45,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from metisfl_tpu import telemetry as _tel
+from metisfl_tpu.store import durable as _durable
 from metisfl_tpu.aggregation.tree import _DEFAULT_SUBBLOCK, TreeReducer
 from metisfl_tpu.comm.codec import dumps, loads
 from metisfl_tpu.telemetry import metrics as _tmetrics
@@ -72,15 +72,10 @@ def spool_path(spool_dir: str, learner_id: str) -> str:
     — path-safe by construction; anything else is sanitized, with a
     short digest suffix so two DISTINCT hostile ids can never collide
     onto one file (a collision would let the second acked uplink
-    silently overwrite the first's durability record). The exact id
+    silently overwrite the first's durability record —
+    store/durable.py, shared with the controller WAL). The exact id
     rides inside the record either way."""
-    safe = "".join(c if (c.isalnum() or c in "._-") else "_"
-                   for c in learner_id)
-    if safe != learner_id:
-        import hashlib
-        safe += "-" + hashlib.sha1(
-            learner_id.encode("utf-8", "surrogatepass")).hexdigest()[:8]
-    return os.path.join(spool_dir, f"{safe}.bin")
+    return os.path.join(spool_dir, f"{_durable.sanitize_id(learner_id)}.bin")
 
 
 def read_spool(spool_dir: str) -> Dict[str, bytes]:
@@ -94,20 +89,20 @@ def read_spool(spool_dir: str) -> Dict[str, bytes]:
     out: Dict[str, bytes] = {}
     if not os.path.isdir(spool_dir):
         return out
+
+    def _decode(raw: bytes):
+        record = loads(raw)
+        blob = record["model"]
+        ModelBlob.from_bytes(blob)  # integrity check before recovery
+        return str(record["learner_id"]), blob
+
     for name in sorted(os.listdir(spool_dir)):
         if not name.endswith(".bin"):
             continue
-        path = os.path.join(spool_dir, name)
-        try:
-            with open(path, "rb") as fh:
-                raw = fh.read()
-            record = loads(raw)
-            blob = record["model"]
-            ModelBlob.from_bytes(blob)  # integrity check before recovery
-            out[str(record["learner_id"])] = blob
-        except (OSError, ValueError, KeyError, TypeError) as exc:
-            logger.warning("spool file %s unreadable (%s); skipped",
-                           path, exc)
+        decoded = _durable.read_tolerant(
+            os.path.join(spool_dir, name), _decode)
+        if decoded is not None:
+            out[decoded[0]] = decoded[1]
     return out
 
 
@@ -167,16 +162,7 @@ class SliceAggregator:
             # a filesystem-hostile id through recovery)
             record = dumps({"learner_id": learner_id,
                             "round": int(round_id), "model": blob})
-            fd, tmp = tempfile.mkstemp(dir=self.spool_dir, prefix=".up_",
-                                       suffix=".tmp")
-            try:
-                with os.fdopen(fd, "wb") as fh:
-                    fh.write(record)
-                os.replace(tmp, path)
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
+            _durable.atomic_write(path, record, prefix=".up_")
         with self._lock:
             self._models[learner_id] = (int(round_id), model)
             held = len(self._models)

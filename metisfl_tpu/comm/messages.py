@@ -240,6 +240,14 @@ class TaskResult(Message):
     # Composite-key auth: the controller validates (learner_id, auth_token)
     # before accepting a model (reference controller.proto:146-148).
     auth_token: str = ""
+    # Incarnation the answered task was dispatched under (the TrainTask's
+    # controller_epoch, echoed back). A controller that restored another
+    # incarnation's state (hot-standby promotion, --resume relaunch)
+    # re-dispatches the abandoned round itself — an uplink the DEAD
+    # incarnation dispatched must land as a stale store, never advance
+    # the restored round's barrier, or it double-folds against the
+    # re-trained copy. Empty (legacy/test producers) means no check.
+    controller_epoch: str = ""
     round_id: int = 0
     model: bytes = b""          # locally trained ModelBlob
     num_train_examples: int = 0

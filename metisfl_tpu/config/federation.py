@@ -378,6 +378,45 @@ class FailoverConfig:
 
 
 @dataclass
+class ControllerStandbyConfig:
+    """Controller hot-standby (controller/wal.py + ``python -m
+    metisfl_tpu.controller --standby``; docs/RESILIENCE.md "Controller
+    hot-standby"). When enabled, the primary appends registry deltas and
+    round-state snapshots to a write-ahead log under ``wal_dir`` (atomic
+    rename before the ack, the spool posture) and the driver boots a
+    warm standby that tails it. The standby escalates exactly like every
+    other liveness path: WAL tail stale past ``stale_after_s`` →
+    grpc.health.v1 probe of the primary → ``probe_failures`` consecutive
+    non-SERVING verdicts → promote (restore WAL state, serve on its own
+    pinned port, re-dispatch the in-flight round)."""
+
+    enabled: bool = False
+    host: str = "localhost"
+    # standby gRPC port (0: the driver picks a free one and ships it to
+    # every peer so the two-endpoint redial contract is pinned up front)
+    port: int = 0
+    # WAL directory shared by primary and standby (empty: the driver
+    # defaults it under its workdir)
+    wal_dir: str = ""
+    # seconds without WAL progress before the standby probes the primary
+    stale_after_s: float = 3.0
+    # standby tail-loop poll cadence
+    probe_interval_s: float = 0.5
+    # consecutive non-SERVING health probes that trigger promotion
+    probe_failures: int = 3
+
+
+@dataclass
+class ControllerConfig:
+    """Controller-process knobs beyond the flat endpoint fields
+    (``controller_host``/``controller_port`` predate this block and stay
+    where every peer already reads them)."""
+
+    standby: ControllerStandbyConfig = field(
+        default_factory=ControllerStandbyConfig)
+
+
+@dataclass
 class ChaosConfig:
     """Deterministic fault injection (metisfl_tpu/chaos). ``rules`` are
     FaultRule dicts; each may carry ``process`` ("controller",
@@ -590,6 +629,7 @@ class FederationConfig:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     comm: CommConfig = field(default_factory=CommConfig)
     failover: FailoverConfig = field(default_factory=FailoverConfig)
+    controller: ControllerConfig = field(default_factory=ControllerConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     ssl: SSLConfig = field(default_factory=SSLConfig)
     train: TrainParams = field(default_factory=TrainParams)
@@ -682,6 +722,29 @@ class FederationConfig:
                 raise ValueError(f"invalid chaos rule: {exc}") from None
         if self.failover.max_controller_restarts < 0:
             raise ValueError("failover.max_controller_restarts must be >= 0")
+        standby = self.controller.standby
+        if standby.enabled:
+            if standby.stale_after_s <= 0.0:
+                raise ValueError(
+                    "controller.standby.stale_after_s must be > 0 (a "
+                    "zero staleness window probes a healthy primary "
+                    "every tick)")
+            if standby.probe_interval_s <= 0.0:
+                raise ValueError(
+                    "controller.standby.probe_interval_s must be > 0")
+            if standby.probe_failures < 1:
+                raise ValueError(
+                    "controller.standby.probe_failures must be >= 1 "
+                    "(promotion must require at least one probe verdict)")
+            if standby.port < 0:
+                raise ValueError("controller.standby.port must be >= 0")
+        elif standby.wal_dir:
+            # the silently-armed-nothing posture (quorum/overprovision):
+            # a wal_dir on a disabled standby replicates to nobody
+            raise ValueError(
+                "controller.standby.wal_dir requires "
+                "controller.standby.enabled (the WAL exists to keep a "
+                "standby promote-ready)")
         if self.registry.enabled and self.secure.enabled:
             # registered blobs are opaque ciphertext under secure agg: the
             # gateway could never decode them and eval-gated promotion

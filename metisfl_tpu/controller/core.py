@@ -56,6 +56,7 @@ from metisfl_tpu.scaling import (apply_staleness_decay, make_scaler,
 from metisfl_tpu.scheduling import SemiSynchronousScheduler, make_scheduler
 from metisfl_tpu.selection import ChurnTracker, make_selector
 from metisfl_tpu.store import EvictionPolicy, make_store
+from metisfl_tpu.store import durable as _durable
 from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.telemetry import events as _tevents
 from metisfl_tpu.telemetry import metrics as _tmetrics
@@ -120,6 +121,10 @@ _M_CHURN = _REG.gauge(
     "Churn/flap score: EWMA of leave, flap-rejoin, and failed-dispatch "
     "events (0 = stable, approaching 1 = flapping; selection.py "
     "ChurnTracker)", ("learner",), budget_label="learner")
+_M_WAL_RECORDS = _REG.counter(
+    _tel.M_CONTROLLER_WAL_RECORDS_TOTAL,
+    "Hot-standby round-state WAL records appended, by kind "
+    "(snapshot/join/leave; controller/wal.py)", ("kind",))
 
 # EWMA smoothing for per-learner train/eval durations (straggler
 # analytics): ~the last 3-4 rounds dominate, so a recovered learner's
@@ -478,6 +483,17 @@ class Controller:
         # community-blob write on the scheduling executor, not N
         self._ckpt_queued = False
 
+        # Hot-standby round-state WAL (controller/wal.py): registry
+        # deltas land synchronously on the join/leave RPC path (before
+        # the ack), full snapshots ride the same coalesced executor hook
+        # as the checkpoint. None when no standby is configured — every
+        # membership path then costs one attribute check.
+        self._wal = None
+        standby = config.controller.standby
+        if standby.enabled and standby.wal_dir:
+            from metisfl_tpu.controller.wal import RoundStateLog
+            self._wal = RoundStateLog(standby.wal_dir)
+
         # Learning-health plane (telemetry/health.py): per-uplink update
         # statistics + per-learner divergence scores. None when opted
         # out or under secure aggregation (opaque payloads) — the uplink
@@ -638,6 +654,7 @@ class Controller:
                 if not self._shutdown.is_set():
                     self._pool.submit(self._guard, self._schedule_initial,
                                       record.learner_id)
+                self._wal_join(record)
                 self._checkpoint_async()
                 return JoinReply(learner_id=record.learner_id,
                                  auth_token=record.auth_token, rejoined=True,
@@ -683,6 +700,7 @@ class Controller:
                     if not self._shutdown.is_set():
                         self._pool.submit(self._guard, self._schedule_initial,
                                           match.learner_id)
+                    self._wal_join(match)
                     self._checkpoint_async()
                     return JoinReply(learner_id=match.learner_id,
                                      auth_token=token, rejoined=True,
@@ -711,6 +729,7 @@ class Controller:
             self._pool.submit(self._guard, self._schedule_initial, learner_id)
         # registry durability: a controller crash between here and the next
         # round checkpoint must not forget this learner's identity/token
+        self._wal_join(record)
         self._checkpoint_async()
         return JoinReply(learner_id=learner_id, auth_token=token,
                          controller_epoch=self.controller_epoch)
@@ -732,6 +751,10 @@ class Controller:
                         if lid == learner_id]:
                 self._tasks_in_flight.pop(tid, None)
                 self._task_dispatched_at.pop(tid, None)
+        # the standby must forget this learner too, before the ack — a
+        # promoted registry resurrecting a departed learner would ghost
+        # the barrier exactly like the duplicate-id case join() guards
+        self._wal_leave(learner_id)
         # bounded metric cardinality under churn: a departed learner's
         # per-learner series (uplink bytes, straggler AND divergence
         # scores) must not accumulate for the process lifetime. Detach
@@ -893,14 +916,45 @@ class Controller:
         # a model-less state a failover restart cannot train from.
         self._checkpoint_async()
 
+    def _wal_join(self, record: LearnerRecord) -> None:
+        """Append the learner's full registry entry to the hot-standby
+        WAL on the join path, BEFORE the JoinReply ack returns: a
+        learner the primary acked must exist in a promoted standby's
+        registry as ITSELF (same id, token, party index). Append
+        failures are logged, not raised — a disk hiccup must not reject
+        the join (the checkpoint save's best-effort posture)."""
+        if self._wal is None:
+            return
+        from metisfl_tpu.controller import wal as _walmod
+        try:
+            self._wal.append(_walmod.JOIN, self._learner_entry(record))
+            _M_WAL_RECORDS.inc(kind="join")
+        except Exception:  # noqa: BLE001 - best-effort durability
+            logger.exception("WAL join append for %s failed",
+                             record.learner_id)
+
+    def _wal_leave(self, learner_id: str) -> None:
+        """Append a leave delta before the leave ack (see _wal_join)."""
+        if self._wal is None:
+            return
+        from metisfl_tpu.controller import wal as _walmod
+        try:
+            self._wal.append(_walmod.LEAVE, {"learner_id": learner_id})
+            _M_WAL_RECORDS.inc(kind="leave")
+        except Exception:  # noqa: BLE001 - best-effort durability
+            logger.exception("WAL leave append for %s failed", learner_id)
+
     def _checkpoint_async(self) -> None:
-        """Queue a checkpoint save onto the scheduling executor (off the
-        RPC path; serialized with round logic). Coalescing: while a save
-        is already queued, further requests are no-ops — the queued save
-        snapshots state at RUN time, so it covers them. No-op when
-        checkpointing is unconfigured, during restore, or at shutdown."""
-        if (not self.config.checkpoint.dir or self._in_restore
-                or self._shutdown.is_set()):
+        """Queue a round-state save onto the scheduling executor (off
+        the RPC path; serialized with round logic): the on-disk
+        checkpoint when checkpoint.dir is set, a WAL snapshot when a
+        standby is configured — both from ONE state capture. Coalescing:
+        while a save is already queued, further requests are no-ops —
+        the queued save snapshots state at RUN time, so it covers them.
+        No-op when neither sink is armed, during restore, or at
+        shutdown."""
+        if ((not self.config.checkpoint.dir and self._wal is None)
+                or self._in_restore or self._shutdown.is_set()):
             return
         with self._lock:
             if self._ckpt_queued:
@@ -911,9 +965,14 @@ class Controller:
             with self._lock:
                 self._ckpt_queued = False
             try:
-                self.save_checkpoint()
+                state = self._checkpoint_state()
+                if self.config.checkpoint.dir:
+                    self.save_checkpoint(state=state)
+                if self._wal is not None:
+                    self._wal.snapshot(state)
+                    _M_WAL_RECORDS.inc(kind="snapshot")
             except Exception:  # noqa: BLE001 - best-effort durability
-                logger.exception("checkpoint save failed")
+                logger.exception("round-state save failed")
 
         try:
             self._pool.submit(self._guard, _save)
@@ -996,7 +1055,16 @@ class Controller:
             # model (fresh data for later rounds) but do not advance the
             # current round's barrier — and keep its timings out of the
             # current round's metadata (it belongs to an abandoned round).
-            stale = result.task_id in self._expired_tasks
+            # Same verdict for an uplink dispatched by ANOTHER controller
+            # incarnation (hot-standby promotion / --resume relaunch):
+            # the restored controller re-dispatched that round itself, so
+            # folding the dead incarnation's copy too would double-count
+            # it — and shift every later round's bits off the same-seed
+            # undisturbed run (the chaos gate's bit-identity pin).
+            stale = (result.task_id in self._expired_tasks
+                     or bool(result.controller_epoch
+                             and result.controller_epoch
+                             != self.controller_epoch))
             self._expired_tasks.pop(result.task_id, None)
             if not stale:
                 self._current_meta.train_received_at[result.learner_id] = start
@@ -2508,14 +2576,14 @@ class Controller:
 
     _CKPT_NAME = "controller_ckpt.bin"
 
-    def save_checkpoint(self, path: Optional[str] = None) -> str:
-        """Persist community model + round counter + lineage metadata.
-
-        Closes the reference's resume gap (SURVEY.md §5.4: resume there is
-        manual re-seeding via ReplaceCommunityModel, controller.cc:85-96 —
-        the round counter and metadata lineage are lost)."""
-        if path is None:
-            path = os.path.join(self.config.checkpoint.dir, self._CKPT_NAME)
+    def _checkpoint_state(self) -> Dict[str, Any]:
+        """One serializable capture of everything round bit-identity
+        depends on — community model, round counter + lineage metadata,
+        learner registry + auth tokens, aggregator/SCAFFOLD state,
+        registry lineage, health scores, metric-budget sketches. Shared
+        verbatim by the on-disk checkpoint (save_checkpoint) and the
+        hot-standby WAL snapshot (controller/wal.py): a promoted standby
+        restores exactly what ``--resume`` restores."""
         with self._lock:
             state = {
                 "global_iteration": self.global_iteration,
@@ -2528,24 +2596,8 @@ class Controller:
                 # SCAFFOLD party index — or every credentialed rejoin
                 # would register a ghost duplicate and secure-agg party
                 # maps would break. Proxies are rebuilt at restore.
-                "learners": [
-                    {"learner_id": r.learner_id,
-                     "auth_token": r.auth_token,
-                     "hostname": r.hostname,
-                     "port": r.port,
-                     "num_train_examples": r.num_train_examples,
-                     "num_val_examples": r.num_val_examples,
-                     "num_test_examples": r.num_test_examples,
-                     "completed_batches": r.completed_batches,
-                     "ms_per_step": float(r.ms_per_step),
-                     "last_result_round": r.last_result_round,
-                     "party_index": r.party_index,
-                     "local_steps_override": r.local_steps_override,
-                     # straggler analytics survive a failover restart so
-                     # scores do not reset to "everyone is typical"
-                     "ewma_train_s": float(r.ewma_train_s),
-                     "ewma_eval_s": float(r.ewma_eval_s)}
-                    for r in self._learners.values()],
+                "learners": [self._learner_entry(r)
+                             for r in self._learners.values()],
             }
             # Rolling rules (FedRec) carry cross-round state; persist the
             # contribution scales so resume can rebuild wc_scaled/z from the
@@ -2578,21 +2630,46 @@ class Controller:
             budget_state = _REG.budget_state()
             if budget_state:
                 state["metrics_budget"] = budget_state
+        return state
+
+    @staticmethod
+    def _learner_entry(r: LearnerRecord) -> Dict[str, Any]:
+        """The learner's serialized registry entry — one shape shared by
+        checkpoint/WAL-snapshot state and the WAL's per-join delta, so
+        replay merge (wal.py) and restore agree field-for-field.
+        Straggler EWMAs ride along so scores do not reset to "everyone
+        is typical" after a failover."""
+        return {"learner_id": r.learner_id,
+                "auth_token": r.auth_token,
+                "hostname": r.hostname,
+                "port": r.port,
+                "num_train_examples": r.num_train_examples,
+                "num_val_examples": r.num_val_examples,
+                "num_test_examples": r.num_test_examples,
+                "completed_batches": r.completed_batches,
+                "ms_per_step": float(r.ms_per_step),
+                "last_result_round": r.last_result_round,
+                "party_index": r.party_index,
+                "local_steps_override": r.local_steps_override,
+                "ewma_train_s": float(r.ewma_train_s),
+                "ewma_eval_s": float(r.ewma_eval_s)}
+
+    def save_checkpoint(self, path: Optional[str] = None,
+                        state: Optional[Dict[str, Any]] = None) -> str:
+        """Persist community model + round counter + lineage metadata.
+
+        Closes the reference's resume gap (SURVEY.md §5.4: resume there is
+        manual re-seeding via ReplaceCommunityModel, controller.cc:85-96 —
+        the round counter and metadata lineage are lost). ``state`` lets
+        the coalesced saver reuse one capture for checkpoint + WAL
+        snapshot; the write is atomic-rename durable (store/durable.py)."""
+        if path is None:
+            path = os.path.join(self.config.checkpoint.dir, self._CKPT_NAME)
+        if state is None:
+            state = self._checkpoint_state()
         buf = codec_dumps(state)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        # unique temp per writer: concurrent saves (per-round auto-checkpoint
-        # racing an operator-initiated one) must not share a staging file
-        import tempfile as _tempfile
-        fd, tmp = _tempfile.mkstemp(dir=os.path.dirname(path) or ".",
-                                    prefix=".ckpt_", suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(buf)
-            os.replace(tmp, path)  # atomic: a crash never leaves a torn file
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        _durable.atomic_write(path, buf, prefix=".ckpt_")
         return path
 
     def restore_checkpoint(self, path: Optional[str] = None) -> bool:
@@ -2606,6 +2683,41 @@ class Controller:
             return False
         with open(path, "rb") as f:
             state = codec_loads(f.read())
+        self._restore_state(state)
+        with self._lock:
+            n_learners = len(self._learners)
+        logger.info("restored checkpoint %s at round %d (%d learner(s) in "
+                    "registry, epoch %s)", path, self.global_iteration,
+                    n_learners, self.controller_epoch[:8])
+        return True
+
+    def restore_from_wal(self) -> bool:
+        """Promote-time restore for the hot standby: merge the WAL's
+        latest snapshot with every registry delta appended after it
+        (controller/wal.py replay/merge) and restore exactly like
+        ``--resume`` does from a checkpoint. Returns False when the log
+        is empty (primary died before anything durable happened — the
+        standby then serves a fresh federation and learners re-attach
+        via their own join path)."""
+        if self._wal is None:
+            return False
+        from metisfl_tpu.controller.wal import RoundStateLog
+        snapshot, deltas = self._wal.replay()
+        state = RoundStateLog.merge(snapshot, deltas)
+        if state is None:
+            return False
+        self._restore_state(state)
+        with self._lock:
+            n_learners = len(self._learners)
+        logger.info("restored WAL round state at round %d (%d learner(s), "
+                    "%d registry delta(s) past the snapshot, epoch %s)",
+                    self.global_iteration, n_learners, len(deltas),
+                    self.controller_epoch[:8])
+        return True
+
+    def _restore_state(self, state: Dict[str, Any]) -> None:
+        """Apply one ``_checkpoint_state``-shaped dict to this (fresh)
+        controller — shared by checkpoint restore and WAL promotion."""
         blob = state.get("community_blob") or None
         with self._lock:
             self.global_iteration = int(state["global_iteration"])
@@ -2680,10 +2792,6 @@ class Controller:
                 for lid, score in self._health.scores().items():
                     if lid in self._learners:
                         _M_DIVERGENCE.set(round(score, 4), learner=lid)
-        logger.info("restored checkpoint %s at round %d (%d learner(s) in "
-                    "registry, epoch %s)", path, self.global_iteration,
-                    len(self._learners), self.controller_epoch[:8])
-        return True
 
     def resume_round(self) -> bool:
         """Kick the restored federation: dispatch a fresh round to the

@@ -283,34 +283,121 @@ class ControllerServer:
 
 class ControllerClient:
     """Learner/driver → controller client (reference
-    grpc_controller_client.py:11-297)."""
+    grpc_controller_client.py:11-297).
 
-    def __init__(self, host: str, port: int, ssl=None, comm=None):
+    ``standby`` is the hot-standby's ``(host, port)``: when set, a call
+    that exhausts the transport's own bounded UNAVAILABLE retries
+    re-resolves the controller by grpc.health.v1 probe over BOTH known
+    endpoints (primary first, then standby) and re-issues once against
+    whichever answers SERVING — the two-endpoint redial contract of
+    docs/RESILIENCE.md "Controller hot-standby". Peers never discover
+    endpoints at failover time; both are pinned at construction."""
+
+    def __init__(self, host: str, port: int, ssl=None, comm=None,
+                 standby: Optional[tuple] = None):
+        self._ssl, self._comm = ssl, comm
+        self._endpoints = [(host, int(port))]
+        if standby and int(standby[1]) > 0:
+            self._endpoints.append((standby[0], int(standby[1])))
+        self._redial_lock = threading.Lock()
+        self._generation = 0
+        self._retries = comm.retries if comm is not None else 10
+        self._retry_sleep_s = (comm.retry_sleep_s if comm is not None
+                               else 1.0)
+        self._active = (host, int(port))
         self._client = RpcClient(host, port, CONTROLLER_SERVICE, ssl=ssl,
                                  **_comm_kwargs(comm))
 
+    def endpoint(self) -> tuple:
+        """The (host, port) currently dialed."""
+        return self._active
+
+    def _call(self, method: str, payload: bytes, **kwargs) -> bytes:
+        """One RPC with failover redial: the underlying client already
+        retries UNAVAILABLE in place (comm.retries × retry_sleep_s);
+        only when that budget is spent — the endpoint is DEAD, not
+        blinking — do we probe for the promoted standby and re-issue.
+        Without a standby endpoint this is exactly ``RpcClient.call``."""
+        import grpc
+
+        if len(self._endpoints) > 1:
+            # HA mode: fail FAST on a dead endpoint. wait-for-ready would
+            # park the call until the full deadline (120 s default) on a
+            # SIGKILLed primary — the bounded in-place UNAVAILABLE
+            # retries plus the redial probe below are the failure
+            # detector, and they need the UNAVAILABLE immediately. The
+            # retry budget covers the standby's promotion window (and
+            # its ms-scale stop→start listener gap) with round-seconds
+            # to spare. Explicit caller wait_ready always wins.
+            kwargs.setdefault("wait_ready", False)
+        gen = self._generation
+        try:
+            return self._client.call(method, payload, **kwargs)
+        except (grpc.RpcError, ValueError):
+            # ValueError: another thread's redial closed our channel
+            # mid-call — fall through and retry on the fresh client
+            if not self._redial(gen):
+                raise
+        return self._client.call(method, payload, **kwargs)
+
+    def _redial(self, gen: int) -> bool:
+        """Re-resolve the controller endpoint after a dead-channel call.
+        Probes every known endpoint (bounded: ``comm.retries`` rounds at
+        ``retry_sleep_s`` cadence — the promotion window the standby
+        needs is well inside it) and swaps the transport to whichever
+        answers SERVING. Serialized: concurrent failed callers re-dial
+        once, the rest piggyback on the fresh channel."""
+        if len(self._endpoints) < 2:
+            return False
+        from metisfl_tpu.comm.health import probe_health
+
+        with self._redial_lock:
+            if self._generation != gen:
+                return True  # another caller already re-dialed
+            for _ in range(max(1, self._retries)):
+                for host, port in self._endpoints:
+                    if probe_health(host, port, CONTROLLER_SERVICE,
+                                    ssl=self._ssl,
+                                    comm=self._comm) != "SERVING":
+                        continue
+                    old = self._client
+                    self._client = RpcClient(host, port, CONTROLLER_SERVICE,
+                                             ssl=self._ssl,
+                                             **_comm_kwargs(self._comm))
+                    self._active = (host, port)
+                    self._generation += 1
+                    try:
+                        old.close()
+                    except Exception:  # noqa: BLE001 - already dead
+                        pass
+                    logger.warning("controller re-dialed to %s:%d "
+                                   "(failover)", host, port)
+                    return True
+                time.sleep(self._retry_sleep_s)
+            return False
+
     def join(self, request: JoinRequest) -> JoinReply:
         # idempotent: a re-sent join lands on the rejoin path
-        return JoinReply.from_wire(self._client.call(
+        return JoinReply.from_wire(self._call(
             "JoinFederation", request.to_wire(), idempotent=True))
 
     def leave(self, learner_id: str, auth_token: str) -> bool:
-        raw = self._client.call("LeaveFederation", dumps(
+        raw = self._call("LeaveFederation", dumps(
             {"learner_id": learner_id, "auth_token": auth_token}))
         return bool(loads(raw)["ok"])
 
     def task_completed(self, result: TaskResult) -> bool:
-        raw = self._client.call("MarkTaskCompleted", result.to_wire())
+        raw = self._call("MarkTaskCompleted", result.to_wire())
         return bool(loads(raw)["ok"])
 
     def replace_community_model(self, blob: bytes) -> bool:
-        return bool(loads(self._client.call("ReplaceCommunityModel", blob))["ok"])
+        return bool(loads(self._call("ReplaceCommunityModel", blob))["ok"])
 
     def get_community_model(self) -> bytes:
-        return self._client.call("GetCommunityModel", b"", idempotent=True)
+        return self._call("GetCommunityModel", b"", idempotent=True)
 
     def get_statistics(self) -> dict:
-        return loads(self._client.call("GetStatistics", b"",
+        return loads(self._call("GetStatistics", b"",
                                        idempotent=True))
 
     def get_runtime_metadata(self, tail: int = 0,
@@ -321,14 +408,14 @@ class ControllerClient:
         a poll against a dead controller fail fast instead of parking in
         the channel's wait-for-ready — the driver's supervision loop
         needs the failure signal to trigger the failover restart."""
-        raw = self._client.call("GetRuntimeMetadata", dumps({"tail": tail}),
+        raw = self._call("GetRuntimeMetadata", dumps({"tail": tail}),
                                 timeout=timeout, wait_ready=wait_ready,
                                 idempotent=True)
         return loads(raw)
 
     def get_evaluation_lineage(self, tail: int = 0) -> list:
         """Last ``tail`` evaluation entries (0 = full lineage)."""
-        raw = self._client.call("GetEvaluationLineage", dumps({"tail": tail}),
+        raw = self._call("GetEvaluationLineage", dumps({"tail": tail}),
                                 idempotent=True)
         return loads(raw)["community_evaluations"]
 
@@ -337,17 +424,17 @@ class ControllerClient:
         """Registered learner endpoints [{learner_id, hostname, port}] — the
         ports learners actually bound (JoinRequest.port), for shutdown and
         monitoring (replaces any port-arithmetic assumptions driver-side)."""
-        return loads(self._client.call("ListLearners", b"", timeout=timeout,
+        return loads(self._call("ListLearners", b"", timeout=timeout,
                                        wait_ready=wait_ready,
                                        idempotent=True))["learners"]
 
     def health(self, timeout: float = 5.0) -> dict:
-        return loads(self._client.call("GetHealthStatus", b"",
+        return loads(self._call("GetHealthStatus", b"",
                                        timeout=timeout, idempotent=True))
 
     def get_metrics(self, timeout: float = 5.0) -> str:
         """The controller's Prometheus text exposition (GetMetrics RPC)."""
-        return self._client.call("GetMetrics", b"", timeout=timeout,
+        return self._call("GetMetrics", b"", timeout=timeout,
                                  idempotent=True).decode("utf-8")
 
     def describe_federation(self, event_tail: int = 50,
@@ -357,7 +444,7 @@ class ControllerClient:
         per-learner liveness + straggler scores, in-flight tasks, store
         occupancy, event-ring tail. Fail-fast polling works like
         get_runtime_metadata: short ``timeout`` + ``wait_ready=False``."""
-        raw = self._client.call("DescribeFederation",
+        raw = self._call("DescribeFederation",
                                 dumps({"event_tail": int(event_tail)}),
                                 timeout=timeout, wait_ready=wait_ready,
                                 idempotent=True)
@@ -369,7 +456,7 @@ class ControllerClient:
         lineage); ``{"enabled": False}`` when the registry is off. The
         serving gateway polls this fail-fast (short timeout, no
         wait-for-ready) like the driver's supervision polls."""
-        raw = self._client.call("DescribeRegistry", b"", timeout=timeout,
+        raw = self._call("DescribeRegistry", b"", timeout=timeout,
                                 wait_ready=wait_ready, idempotent=True)
         return loads(raw)
 
@@ -377,7 +464,7 @@ class ControllerClient:
                              timeout: Optional[float] = None) -> bytes:
         """A registered version's community blob, by version id or channel
         name (b'' when absent)."""
-        return self._client.call(
+        return self._call(
             "GetRegisteredModel",
             dumps({"version": int(version), "channel": channel}),
             timeout=timeout, idempotent=True)
@@ -386,13 +473,13 @@ class ControllerClient:
                         timeout: Optional[float] = None) -> dict:
         """Operator promotion: ``{"ok": bool, ...}`` — a failing gate
         comes back as ``ok=False`` with the reasons, not an exception."""
-        return loads(self._client.call(
+        return loads(self._call(
             "PromoteVersion", dumps({"version": int(version),
                                      "force": bool(force)}),
             timeout=timeout))
 
     def rollback_version(self, timeout: Optional[float] = None) -> dict:
-        return loads(self._client.call("RollbackVersion", dumps({}),
+        return loads(self._call("RollbackVersion", dumps({}),
                                        timeout=timeout))
 
     def list_methods(self, timeout: float = 5.0) -> dict:
@@ -400,12 +487,12 @@ class ControllerClient:
         names + transport capability flags, JSON-encoded so non-codec
         tooling can probe it too."""
         import json as _json
-        raw = self._client.call("ListMethods", b"", timeout=timeout,
+        raw = self._call("ListMethods", b"", timeout=timeout,
                                 idempotent=True)
         return _json.loads(raw.decode("utf-8"))
 
     def shutdown_controller(self) -> bool:
-        return bool(loads(self._client.call("ShutDown", b""))["ok"])
+        return bool(loads(self._call("ShutDown", b""))["ok"])
 
     def close(self) -> None:
         self._client.close()

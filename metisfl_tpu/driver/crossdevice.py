@@ -688,7 +688,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "bit-identical to the no-kill control")
     parser.add_argument("--slices", type=int, default=3,
                         help="aggregator subprocess count for --slice-smoke")
+    parser.add_argument("--controller-smoke", action="store_true",
+                        help="run the controller-kill chaos gate instead: "
+                             "real-gRPC federation with a warm --standby, "
+                             "controller SIGKILLed mid-round with uplinks "
+                             "in the air; FAIL unless the standby promotes "
+                             "itself, every round completes, and the "
+                             "community model is bit-identical to the "
+                             "same-seed undisturbed control run")
     args = parser.parse_args(argv)
+
+    if args.controller_smoke:
+        from metisfl_tpu.driver.ha_smoke import run_ha_smoke
+        out = run_ha_smoke(rounds=min(args.rounds, 3), seed=args.seed,
+                           timeout_s=args.timeout)
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
 
     if args.slice_smoke:
         out = run_slice_smoke(clients=min(args.clients, 24),

@@ -1,0 +1,200 @@
+"""Controller-kill chaos gate (`scripts/chaos_smoke.sh`).
+
+A real-gRPC federation — subprocess controller, warm `--standby`,
+subprocess learners — where the seeded chaos injector SIGKILLs the
+controller on its first ``MarkTaskCompleted`` (= mid-round, after
+dispatch, with uplinks in the air). The gate passes iff:
+
+- the standby **promotes itself** (probe-driven: WAL stall →
+  grpc.health.v1 escalation) and the driver hands the controller
+  endpoint over — ``controller_failover`` fired for BOTH roles
+  (``standby`` from the promoted process, ``driver`` from the handoff);
+- every round completes without operator action; and
+- each round's registered community model is **bit-identical** to the
+  same-seed undisturbed control run (which must stay failover-silent).
+
+Bit-identity is compared on *round-pinned* registry versions, not the
+live community head — the federation keeps aggregating until shutdown,
+so the head is a moving target while version ``k`` is exactly round
+``k``'s aggregate in both runs. Two learners keep the root fold
+order-independent at the bit level (IEEE addition is commutative), so
+arrival-order jitter cannot move the bits; what the gate actually pins
+is that promotion reconstructed the round state the bits depend on.
+
+Run directly::
+
+    python -m metisfl_tpu.driver.crossdevice --controller-smoke
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger("metisfl_tpu.driver.ha_smoke")
+
+
+def _failover_events(workdir: str) -> Dict[str, int]:
+    """``controller_failover`` events by role from every telemetry
+    journal under ``workdir`` (the promoted standby writes its own
+    JSONL; the driver's in-process events are counted by the caller
+    via the metrics registry)."""
+    counts: Dict[str, int] = {}
+    pattern = os.path.join(workdir, "telemetry", "*-events.jsonl")
+    for path in glob.glob(pattern):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "controller_failover":
+                        role = str(rec.get("role", "?"))
+                        counts[role] = counts.get(role, 0) + 1
+        except OSError:
+            continue
+    return counts
+
+
+def _run_one(workdir: str, seed: int, rounds: int, kill: bool,
+             timeout_s: float) -> Dict[str, Any]:
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, ChaosConfig,
+                                    ControllerConfig,
+                                    ControllerStandbyConfig, EvalConfig,
+                                    FederationConfig, RegistryConfig,
+                                    TerminationConfig)
+    from metisfl_tpu.driver.session import DriverSession
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+    from metisfl_tpu.telemetry import parse_exposition
+
+    import socket as _socket
+
+    def _free_port() -> int:
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((4, 2)).astype(np.float32)
+
+    def make_recipe(idx: int):
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+
+        def recipe():
+            ops = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                               np.zeros((2, 4), np.float32), rng_seed=0)
+            return ops, ArrayDataset(x, y, seed=idx)
+
+        return recipe
+
+    recipes = [make_recipe(0), make_recipe(1)]
+    template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                            np.zeros((2, 4), np.float32),
+                            rng_seed=0).get_variables()
+    config = FederationConfig(
+        controller_port=_free_port(),
+        round_deadline_secs=60.0,
+        aggregation=AggregationConfig(scaler="participants"),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        # round-pinned bit-identity evidence: version k is round k-1's
+        # aggregate in both runs. Retention must outlast the rounds the
+        # federation keeps racing through between termination detection
+        # and shutdown, or GC evicts the very versions under comparison.
+        registry=RegistryConfig(enabled=True, retention=64),
+        termination=TerminationConfig(
+            federation_rounds=rounds,
+            execution_cutoff_mins=max(1.0, timeout_s / 60.0)),
+        controller=ControllerConfig(standby=ControllerStandbyConfig(
+            enabled=True, stale_after_s=1.5, probe_interval_s=0.25,
+            probe_failures=2)),
+        chaos=ChaosConfig(enabled=kill, seed=seed, rules=([
+            {"process": "controller", "side": "server", "fault": "kill",
+             "method": "MarkTaskCompleted", "max_fires": 1}]
+            if kill else [])),
+    )
+    # driver-side failover handoffs, counted per run from the process-
+    # global registry (both runs share this smoke process)
+    def _driver_failovers() -> float:
+        series = parse_exposition(telemetry.render_metrics()).get(
+            "controller_failover_total", {})
+        return sum(v for labels, v in series.items()
+                   if ("role", "driver") in labels)
+
+    base_driver = _driver_failovers()
+    session = DriverSession(config, template, recipes, workdir=workdir)
+    t0 = time.time()
+    blobs: Dict[int, str] = {}
+    try:
+        session.initialize_federation()
+        stats = session.monitor_federation(poll_every_s=0.5,
+                                           eval_drain_timeout_s=0)
+        missing = []
+        for version in range(1, rounds + 1):
+            raw = session._client.get_registered_model(version=version,
+                                                       timeout=30.0)
+            if not raw:
+                missing.append(version)
+            blobs[version] = hashlib.sha256(raw or b"").hexdigest()
+        promoted = session._standby_promoted
+        completed = int(stats.get("global_iteration", 0))
+        learners = len(stats.get("learners", []))
+    finally:
+        session.shutdown_federation()
+    events = _failover_events(workdir)
+    return {
+        "kill": kill,
+        "seed": seed,
+        "rounds_target": rounds,
+        "rounds_completed": completed,
+        "learners": learners,
+        "promoted": promoted,
+        "failover_events": events,
+        "driver_failovers": _driver_failovers() - base_driver,
+        "model_sha256": blobs,
+        "missing_versions": missing,
+        "wall_s": round(time.time() - t0, 3),
+        "ok": completed >= rounds and learners == 2 and not missing,
+    }
+
+
+def run_ha_smoke(rounds: int = 3, seed: int = 7,
+                 timeout_s: float = 240.0,
+                 workdir: Optional[str] = None) -> Dict[str, Any]:
+    """Kill run (chaos SIGKILL on the controller's first uplink of a
+    round) versus the same-seed undisturbed control, both with the hot
+    standby armed. Passes iff the kill run promoted + completed with
+    ``controller_failover`` fired for both roles, the control stayed
+    silent, and every round-pinned community model matches bit-for-bit."""
+    root = workdir or tempfile.mkdtemp(prefix="metisfl_tpu_ha_")
+    kill = _run_one(os.path.join(root, "kill"), seed, rounds,
+                    kill=True, timeout_s=timeout_s)
+    control = _run_one(os.path.join(root, "control"), seed, rounds,
+                       kill=False, timeout_s=timeout_s)
+    bit_identical = (bool(kill["model_sha256"])
+                     and kill["model_sha256"] == control["model_sha256"])
+    kill_events = kill["failover_events"]
+    ok = (kill["ok"] and control["ok"]
+          and kill["promoted"]
+          and kill_events.get("standby", 0) >= 1
+          and kill["driver_failovers"] >= 1
+          # the control run must be failover-silent end to end
+          and not control["promoted"]
+          and not control["failover_events"]
+          and control["driver_failovers"] == 0
+          and bit_identical)
+    return {"kill": kill, "control": control,
+            "bit_identical": bit_identical, "workdir": root, "ok": ok}

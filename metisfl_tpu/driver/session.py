@@ -49,6 +49,10 @@ logger = logging.getLogger("metisfl_tpu.driver")
 _M_CTRL_RESTARTS = _tmetrics.registry().counter(
     _tel.M_CONTROLLER_RESTARTS_TOTAL,
     "Supervised controller relaunches after a crash")
+_M_CTRL_FAILOVER = _tmetrics.registry().counter(
+    _tel.M_CONTROLLER_FAILOVER_TOTAL,
+    "Standby promotions to controller, by role of the emitting process",
+    ("role",))
 _M_GATEWAY_RESTARTS = _tmetrics.registry().counter(
     _tel.M_GATEWAY_RESTARTS_TOTAL,
     "Supervised serving-gateway relaunches after a crash")
@@ -196,6 +200,15 @@ class DriverSession:
         self._known_endpoints: List[dict] = []
         # controller crash-failover supervision state
         self._controller_restarts = 0
+        # controller hot-standby state (controller.standby): pre-promotion
+        # standby crashes get bounded relaunches (warm redundancy must not
+        # silently evaporate); once the driver hands the controller
+        # endpoint over to a promoted standby there is no third
+        # incarnation — the next controller death is a double fault
+        self._standby_restarts = 0
+        self._standby_restart_after = 0.0
+        self._standby_promoted = False
+        self._chaos_armed_standby = False
         # serving supervision state, PER PROCESS NAME ("serving" for the
         # single gateway; "serving_<idx>" per fleet replica; "router"):
         # doubling capped backoff — a deterministically-crashing gateway
@@ -358,6 +371,33 @@ class DriverSession:
         if self.config.checkpoint.dir:
             os.makedirs(self.config.checkpoint.dir, exist_ok=True)
 
+        # Controller hot-standby (controller/wal.py + controller/__main__
+        # --standby): pin the standby's endpoint and WAL dir BEFORE the
+        # config write below. The config ships to the standby (it tails
+        # wal_dir), to the controller (it arms its WAL appends), and to
+        # learners + the serving gateway (they hold BOTH controller
+        # endpoints up front — failover is a re-dial to a known port,
+        # never a discovery).
+        standby = self.config.controller.standby
+        if standby.enabled:
+            if not standby.wal_dir:
+                standby.wal_dir = os.path.join(self.workdir, "wal")
+            os.makedirs(standby.wal_dir, exist_ok=True)
+            if not standby.port:
+                if (standby.host or
+                        "localhost") not in self._LOCAL_HOSTS:
+                    # same guard as serving/coordinator ports: a port
+                    # probed on the driver machine says nothing about
+                    # the remote host the standby will bind on
+                    raise ValueError(
+                        "controller.standby on remote host "
+                        f"{standby.host!r} requires an explicit "
+                        "controller.standby.port")
+                import socket as _socket
+                with _socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    standby.port = s.getsockname()[1]
+
         # serving gateway/fleet: the config file below ships to the
         # gateway (and router) processes too, so every port must be
         # pinned BEFORE the write — an ephemeral bind would leave the
@@ -446,9 +486,17 @@ class DriverSession:
 
         ctrl_host = self.config.controller_host or "localhost"
         self._launch_controller(resume=self.resume)
+        if standby.enabled:
+            # boot the standby right behind the primary so it tails the
+            # WAL from record one; the driver's own client carries the
+            # standby endpoint too and re-dials on failover like any peer
+            self._launch_standby()
         self._client = ControllerClient(ctrl_host, self.config.controller_port,
                                         ssl=self.config.ssl,
-                                        comm=self.config.comm)
+                                        comm=self.config.comm,
+                                        standby=((standby.host or "localhost",
+                                                  standby.port)
+                                                 if standby.enabled else None))
         self._wait_healthy(health_retries, health_sleep_s)
 
         # ship initial model (reference _ship_model_to_controller :334-342)
@@ -489,6 +537,17 @@ class DriverSession:
                   "port": self.config.controller_port,
                   "service_name": CONTROLLER_SERVICE,
                   "role": "controller"}]
+        standby = self.config.controller.standby
+        if standby.enabled and not self._standby_promoted:
+            # the warm standby answers CollectTelemetry on a role-tagged
+            # methodless service (controller/__main__.py), so `status
+            # --fleet` shows it as a live role="standby" peer; after the
+            # handoff the controller row above IS the promoted standby
+            specs.append({"name": "standby",
+                          "host": standby.host or "localhost",
+                          "port": standby.port,
+                          "service_name": CONTROLLER_SERVICE,
+                          "role": "standby"})
         try:
             endpoints = self._client.list_learners(timeout=5.0,
                                                    wait_ready=False)
@@ -608,17 +667,51 @@ class DriverSession:
         self._procs.append(proc)
         return proc
 
+    def _launch_standby(self) -> _Proc:
+        """(Re)launch the warm hot-standby (controller/__main__.py
+        ``--standby``): it tails the WAL at ``controller.standby.wal_dir``
+        and promotes itself on primary death — the driver never promotes
+        it by RPC, it only observes the promotion (probe-driven, the same
+        staleness→health escalation every peer uses)."""
+        standby = self.config.controller.standby
+        host = standby.host or "localhost"
+        launcher = self._launcher_for(host)
+        argv = [getattr(launcher, "python", sys.executable),
+                "-m", "metisfl_tpu.controller",
+                "--config", self._config_path,
+                "--port", str(standby.port),
+                "--standby"]
+        if isinstance(launcher, SSHLauncher):
+            launcher.ship([self._config_path] + self._ssl_files())
+        env = dict(self._base_env())
+        if not self._chaos_armed_standby:
+            # original incarnation only, same posture as every other
+            # chaos-killable process: a supervised relaunch runs clean
+            self._chaos_armed_standby = True
+            env.update(self._chaos_env("standby"))
+        self._procs = [p for p in self._procs if p.name != "standby"]
+        proc = launcher.launch("standby", argv, env=env)
+        self._procs.append(proc)
+        return proc
+
     def _supervise_controller(self) -> bool:
         """Crash failover (docs/RESILIENCE.md): when the controller
-        process has died, relaunch it with ``--resume`` under a bounded
-        restart budget with doubling backoff. Returns True when a restart
-        happened this call; raises once the budget is exhausted (a
-        deterministically-crashing controller must fail the run, not
-        crash-loop forever)."""
+        process has died, either hand the federation over to the hot
+        standby (``controller.standby.enabled`` — wait for its probe-
+        driven promotion, then swap the configured controller endpoint)
+        or relaunch it with ``--resume`` under a bounded restart budget
+        with doubling backoff. Returns True when a restart/handoff
+        happened this call; raises once the budget is exhausted or no
+        standby is left (a deterministically-crashing controller must
+        fail the run, not crash-loop forever)."""
         ctrl = next((p for p in self._procs if p.name == "controller"), None)
         if (ctrl is None or self._shutting_down
                 or ctrl.process.poll() is None):
             return False
+        if self.config.controller.standby.enabled:
+            # hot-standby posture: the primary is never relaunched — the
+            # warm standby promotes and the driver re-points everything
+            return self._failover_to_standby(ctrl)
         fo = self.config.failover
         if not fo.supervise_controller:
             return False  # _check_procs_alive reports the death as fatal
@@ -662,6 +755,107 @@ class DriverSession:
             return True
         logger.info("controller restarted and healthy (restart %d)",
                     self._controller_restarts)
+        return True
+
+    def _failover_to_standby(self, ctrl: _Proc) -> bool:
+        """Controller death with a hot standby configured: wait (bounded)
+        for the standby's self-promotion to answer SERVING on the
+        controller service, then swap ``controller_host``/``_port`` to
+        the standby endpoint — every config consumer (fleet peer specs,
+        shutdown dialing, learner relaunch argv) follows automatically,
+        and live peers re-dial on their own via the two-endpoint client
+        contract. A dead standby (or a second controller death after the
+        handoff) is a double fault: fail fast, there is no third
+        incarnation."""
+        code = ctrl.process.poll()
+        standby = self.config.controller.standby
+        host = standby.host or "localhost"
+        sb = next((p for p in self._procs if p.name == "standby"), None)
+        if self._standby_promoted or sb is None or (
+                sb.process.poll() is not None):
+            with open(ctrl.log_path) as f:
+                tail = f.read()[-2000:]
+            raise RuntimeError(
+                f"controller died (exit {code}) with no live standby "
+                "left (double fault); log tail:\n" + tail)
+        logger.warning("controller died (exit %s); waiting for standby "
+                       "%s:%d to promote", code, host, standby.port)
+        _tevents.emit(_tevents.FailoverBegan, restart=1, exit_code=code)
+        _tpostmortem.dump("failover_handoff", extra={"exit_code": code})
+        from metisfl_tpu.comm.health import probe_health
+        from metisfl_tpu.controller.service import CONTROLLER_SERVICE
+        # promotion budget: one full staleness window + the probe
+        # escalation, plus headroom for the WAL restore itself
+        budget = (standby.stale_after_s
+                  + standby.probe_interval_s * (standby.probe_failures + 2)
+                  + 30.0)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < budget:
+            if sb.process.poll() is not None:
+                break  # died mid-promotion → double-fault below
+            if probe_health(host, standby.port, CONTROLLER_SERVICE,
+                            ssl=self.config.ssl,
+                            comm=self.config.comm) == "SERVING":
+                waited = time.monotonic() - t0
+                self.config.controller_host = host
+                self.config.controller_port = standby.port
+                self._standby_promoted = True
+                # the promoted standby IS the controller now: retag the
+                # tracked process (dropping the dead primary) so shutdown
+                # waits on it and a later death trips the double-fault
+                # branch above instead of "standby died" supervision
+                self._procs = [p for p in self._procs
+                               if p.name != "controller"]
+                sb.name = "controller"
+                _M_CTRL_FAILOVER.inc(role="driver")
+                _tevents.emit(_tevents.ControllerFailover, role="driver",
+                              host=host, port=standby.port,
+                              promote_s=round(waited, 4),
+                              reason=f"controller_exit_{code}")
+                logger.warning(
+                    "standby promoted at %s:%d after %.1fs; controller "
+                    "endpoint handed over", host, standby.port, waited)
+                return True
+            time.sleep(min(1.0, standby.probe_interval_s))
+        with open(sb.log_path) as f:
+            tail = f.read()[-2000:]
+        raise RuntimeError(
+            f"controller died (exit {code}) and the standby at "
+            f"{host}:{standby.port} never promoted within {budget:.0f}s; "
+            "standby log tail:\n" + tail)
+
+    def _supervise_standby(self) -> bool:
+        """Pre-promotion standby supervision: a crashed WARM standby is
+        relaunched (bounded, capped doubling backoff) — it re-tails the
+        WAL and is promote-ready again with no handoff. Budget exhausted
+        = the federation runs on without hot-standby cover (logged
+        loudly; the next controller death is then fatal). Never fails
+        the run: the standby is redundancy, not the service."""
+        standby = self.config.controller.standby
+        if (not standby.enabled or self._standby_promoted
+                or self._shutting_down):
+            return False
+        sb = next((p for p in self._procs if p.name == "standby"), None)
+        if sb is None or sb.process.poll() is None:
+            return False
+        if time.time() < self._standby_restart_after:
+            return False
+        code = sb.process.poll()
+        fo = self.config.failover
+        if self._standby_restarts >= fo.max_controller_restarts:
+            logger.error(
+                "standby died (exit %s) with its relaunch budget (%d) "
+                "exhausted; continuing WITHOUT hot-standby cover — the "
+                "next controller death is fatal", code,
+                fo.max_controller_restarts)
+            self._procs = [p for p in self._procs if p.name != "standby"]
+            return False
+        self._standby_restarts += 1
+        backoff = fo.restart_backoff_s * (2 ** (self._standby_restarts - 1))
+        self._standby_restart_after = time.time() + min(backoff, 60.0)
+        logger.warning("standby died (exit %s); relaunch %d/%d", code,
+                       self._standby_restarts, fo.max_controller_restarts)
+        self._launch_standby()
         return True
 
     def _recipe_path(self, idx: int) -> str:
@@ -1117,6 +1311,12 @@ class DriverSession:
                 "--controller-host", self.config.controller_host or "localhost",
                 "--controller-port", str(self.config.controller_port),
                 "--advertise-host", ep.hostname or "localhost",
+                *(["--standby-host",
+                   self.config.controller.standby.host or "localhost",
+                   "--standby-port",
+                   str(self.config.controller.standby.port)]
+                  if (self.config.controller.standby.enabled
+                      and not self._standby_promoted) else []),
                 "--port", str(ep.port),
                 "--recipe", recipe_path,
                 "--rpc-deadline-s", str(self.config.comm.default_deadline_s),
@@ -1206,6 +1406,15 @@ class DriverSession:
         raise RuntimeError(f"controller never became healthy: {last_exc}")
 
     def _check_procs_alive(self, skip: Sequence[str] = ()) -> None:
+        skip = tuple(skip)
+        if self.config.controller.standby.enabled:
+            # hot-standby configured: a controller death is a FAILOVER
+            # event (_supervise_controller waits for the standby's
+            # promotion and hands the endpoint over), never an instant
+            # abort — and the standby itself is supervised. With no
+            # standby the fail-fast below stands: a dead controller with
+            # supervision off must kill the run immediately.
+            skip += ("controller", "standby")
         for proc in self._procs:
             if proc.name in skip:
                 continue
@@ -1233,6 +1442,7 @@ class DriverSession:
             # the two calls belongs to the NEXT supervision cycle, not to
             # an instant abort that bypasses the restart budget.
             self._supervise_controller()
+            self._supervise_standby()
             self._supervise_gateway()
             self._supervise_slices()
             self._autoscale_serving()
@@ -1640,6 +1850,13 @@ class DriverSession:
                 self._client.shutdown_controller()
         except Exception:  # noqa: BLE001
             logger.warning("controller shutdown RPC failed; killing processes")
+        for proc in self._procs:
+            if proc.name == "standby" and proc.process.poll() is None:
+                # the warm standby has no ShutDown RPC surface — SIGTERM
+                # is its clean exit (and must come BEFORE the wait loop,
+                # or the primary's death above would read as a WAL stall
+                # and the standby would promote into the shutdown)
+                _terminate_process(proc.process)
         deadline = time.time() + timeout_s
         for proc in self._procs:
             remaining = max(0.5, deadline - time.time())

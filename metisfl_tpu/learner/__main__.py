@@ -56,6 +56,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser("metisfl_tpu.learner")
     parser.add_argument("--controller-host", default="localhost")
     parser.add_argument("--controller-port", type=int, required=True)
+    parser.add_argument("--standby-host", default="",
+                        help="controller hot-standby endpoint: a call that "
+                             "exhausts its UNAVAILABLE retries re-resolves "
+                             "to whichever endpoint answers SERVING")
+    parser.add_argument("--standby-port", type=int, default=0)
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--advertise-host", default="",
                         help="hostname the controller should dial back")
@@ -184,7 +189,10 @@ def main(argv=None) -> int:
         from metisfl_tpu.config import CommConfig
         comm = CommConfig(default_deadline_s=args.rpc_deadline_s)
     controller = ControllerClient(args.controller_host, args.controller_port,
-                                  ssl=ssl, comm=comm)
+                                  ssl=ssl, comm=comm,
+                                  standby=((args.standby_host,
+                                            args.standby_port)
+                                           if args.standby_port else None))
     advertise = args.advertise_host or socket.gethostname()
     learner = Learner(
         model_ops=model_ops,

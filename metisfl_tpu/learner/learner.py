@@ -715,6 +715,7 @@ class Learner:
                 task_id=task.task_id,
                 learner_id=self.learner_id,
                 auth_token=self.auth_token,
+                controller_epoch=task.controller_epoch,
                 round_id=task.round_id,
                 model=model_bytes,
                 num_train_examples=len(self.datasets["train"]),

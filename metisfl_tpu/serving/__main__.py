@@ -127,9 +127,13 @@ def main(argv=None) -> int:
                                              ServingGateway)
     from metisfl_tpu.serving.service import ServingServer
 
+    standby = config.controller.standby
     controller = ControllerClient(
         config.controller_host or "localhost", config.controller_port,
-        ssl=config.ssl, comm=config.comm)
+        ssl=config.ssl, comm=config.comm,
+        # registry poller redial contract: a controller failover must not
+        # strand the gateway on the dead primary's endpoint
+        standby=((standby.host, standby.port) if standby.enabled else None))
     gateway = ServingGateway(
         model_ops, config.serving,
         ship_tensor_regex=config.train.ship_tensor_regex)

@@ -408,6 +408,16 @@ def _fleet_collector(args, ssl=None):
         specs = [{"name": "controller", "host": args.host,
                   "port": args.port, "service_name": CONTROLLER_SERVICE,
                   "role": "controller"}]
+        if getattr(args, "standby_port", 0):
+            # warm hot-standby (controller/__main__.py --standby): its
+            # role-tagged methodless service answers the pulls, so the
+            # table shows it live pre-promotion
+            specs.append({"name": "standby",
+                          "host": getattr(args, "standby_host", "")
+                          or args.host,
+                          "port": args.standby_port,
+                          "service_name": CONTROLLER_SERVICE,
+                          "role": "standby"})
         try:
             snap = client.describe_federation(event_tail=0, timeout=5.0,
                                               wait_ready=False)
@@ -525,6 +535,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "learners + gateway): per-peer liveness and "
                              "clock offset, merged metric families, one "
                              "skew-corrected span waterfall")
+    parser.add_argument("--standby-host", default="",
+                        help="--fleet: controller hot-standby host "
+                             "(defaults to --host)")
+    parser.add_argument("--standby-port", type=int, default=0,
+                        help="--fleet: also pull the warm hot-standby on "
+                             "this port — shown as a role=standby peer "
+                             "until it promotes")
     parser.add_argument("--serving-port", type=int, default=0,
                         help="--fleet: also pull the serving plane on "
                              "this port (the fleet ROUTER when one runs "

@@ -136,6 +136,10 @@ M_CHAOS_FAULTS_INJECTED_TOTAL = "chaos_faults_injected_total"
 # driver failover supervision (driver/session.py)
 M_CONTROLLER_RESTARTS_TOTAL = "controller_restarts_total"
 M_GATEWAY_RESTARTS_TOTAL = "gateway_restarts_total"
+# controller hot-standby (controller/wal.py + __main__.py --standby)
+M_CONTROLLER_WAL_RECORDS_TOTAL = "controller_wal_records_total"
+M_CONTROLLER_FAILOVER_TOTAL = "controller_failover_total"
+M_CONTROLLER_FAILOVER_PROMOTE_SECONDS = "controller_failover_promote_seconds"
 # model registry (registry/registry.py)
 M_REGISTRY_VERSIONS_TOTAL = "registry_versions_total"
 M_REGISTRY_VERSION_STATE = "registry_version_state"

@@ -331,6 +331,27 @@ class ServingScaledDown:
 
 
 @dataclass(frozen=True)
+class ControllerFailover:
+    """The warm standby promoted itself to controller: the primary's WAL
+    tail went stale, ``probe_failures`` consecutive grpc.health.v1
+    probes confirmed it down, and the standby restored the replicated
+    round state and started serving on its own pinned port
+    (controller/__main__.py ``--standby``). Also emitted by the driver
+    when it hands the federation's controller endpoint over to the
+    promoted standby."""
+
+    kind: ClassVar[str] = "controller_failover"
+    role: str            # "standby" (promotion) | "driver" (handoff)
+    host: str = ""
+    port: int = 0
+    round: int = 0
+    learners: int = 0
+    wal_records: int = 0
+    promote_s: float = 0.0
+    reason: str = ""
+
+
+@dataclass(frozen=True)
 class SliceAggregatorLost:
     """A slice aggregator process stopped answering (consecutive RPC
     failures confirmed by a grpc.health.v1 probe); its cohort slice is
@@ -368,7 +389,7 @@ EVENT_TYPES: Dict[str, type] = {
                 AlertResolved, FabricPeerStale, FabricPeerRecovered,
                 SliceAggregatorLost, SliceRehomed, ServingReplicaDead,
                 ServingReplicaRecovered, ServingScaledUp,
-                ServingScaledDown)
+                ServingScaledDown, ControllerFailover)
 }
 
 

