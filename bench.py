@@ -1615,6 +1615,101 @@ def bench_prof(trials=5, acquire_iters=200_000, sample_iters=300):
     }
 
 
+def bench_runtime(trials=5, call_iters=2000, steady_iters=20):
+    """Accelerator-runtime section (docs/OBSERVABILITY.md "Runtime
+    observability"): the compile-listener's own cost, measured — the
+    per-call overhead of a monitored_jit wrapper vs a raw jitted call
+    (minima over ``trials``), the round kernel's cold-compile vs
+    cached-call ms (the gap every recompile re-pays), and the decode
+    path's recompile count at prompt lengths {8, 64} after warmup (0 =
+    the slot decoder's per-length LRU is doing its job). The ns/ms/
+    recompile keys are direction-classified for ``perf --trajectory``."""
+    import numpy as _np
+
+    from metisfl_tpu.telemetry import runtime as truntime
+
+    truntime.reset()
+    truntime.configure(enabled=True)
+
+    # cold compile vs cached call for the bench round kernel
+    step = truntime._smoke_round_kernel()
+    rng = _np.random.default_rng(11)
+    params = {"w": rng.standard_normal((128, 64)).astype(_np.float32),
+              "b": rng.standard_normal((64,)).astype(_np.float32)}
+    x = rng.standard_normal((32, 128)).astype(_np.float32)
+    t0 = time.perf_counter()
+    params, _ = step(params, x)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    times = []
+    for _ in range(steady_iters):
+        t0 = time.perf_counter()
+        params, _ = step(params, x)
+        times.append((time.perf_counter() - t0) * 1e3)
+    cached_ms = min(times)
+
+    # wrapper overhead: monitored vs raw compiled call (minima judged)
+    import jax as _jax
+
+    def tiny(v):
+        return v * 2.0 + 1.0
+
+    raw = _jax.jit(tiny)
+    mon = truntime.monitored_jit(tiny, name="bench.runtime_tiny")
+    v = _np.ones((16,), _np.float32)
+    raw(v), mon(v)
+
+    def _per_call_ns(fn):
+        t0 = time.perf_counter()
+        for _ in range(call_iters):
+            fn(v)
+        return (time.perf_counter() - t0) / call_iters * 1e9
+
+    raw_ns = min(_per_call_ns(raw) for _ in range(trials))
+    mon_ns = min(_per_call_ns(mon) for _ in range(trials))
+
+    # decode-path recompiles at prompt lengths {8, 64}: warm each
+    # length once, then repeated prompts must reuse the per-length LRU
+    out = {}
+    try:
+        from metisfl_tpu.models.generate import SlotDecoder
+
+        ops, variables = truntime._smoke_decoder()
+        decoder = SlotDecoder(ops.module, slots=2, max_len=128)
+        toks = _np.zeros(2, _np.int32)
+        for length in (8, 64):
+            prompt = _np.arange(1, length + 1,
+                                dtype=_np.int32)[None, :]
+            positions = _np.full(2, length, _np.int32)
+            decoder.prefill(variables, 0, prompt)
+            decoder.step(variables, toks, positions)  # warm both programs
+            warm = truntime.collect_state()["compiles"]
+            for _ in range(4):
+                decoder.prefill(variables, 0, prompt)
+                decoder.step(variables, toks, positions)
+            after = truntime.collect_state()
+            out[f"runtime_decode_recompiles_len{length}"] = (
+                after["compiles"] - warm)
+    except Exception as exc:  # noqa: BLE001 - report, don't fail bench
+        out["runtime_decode_failed"] = 1
+        print(f"bench runtime: decode leg failed: {exc}", file=sys.stderr)
+
+    state = truntime.collect_state()
+    out.update({
+        "runtime_listener_overhead_ns": round(max(0.0, mon_ns - raw_ns),
+                                              1),
+        "runtime_call_ns_raw": round(raw_ns, 1),
+        "runtime_call_ns_monitored": round(mon_ns, 1),
+        "runtime_cold_compile_ms": round(cold_ms, 3),
+        "runtime_cached_call_ms": round(cached_ms, 4),
+        "runtime_compiles": int(state.get("compiles", 0)),
+        "runtime_recompiles_total": int(state.get("recompiles", 0)),
+        "runtime_listener_mode_monitoring": int(
+            truntime.listener_mode() == "monitoring"),
+    })
+    truntime.reset()
+    return out
+
+
 def _synth_trace(n_spans: int) -> list:
     """A synthetic round-shaped trace of ~``n_spans`` records: one round
     root, fan-out dispatch/learner subtrees (each train span outliving
@@ -1704,6 +1799,7 @@ _SECTIONS = {
     "tree_dist": lambda a: bench_tree_dist(),
     "fleet": lambda a: bench_fleet(),
     "trace": lambda a: bench_trace(),
+    "runtime": lambda a: bench_runtime(),
     "lora": lambda a: bench_lora(),
 }
 
@@ -1931,7 +2027,8 @@ _SECTION_TIMEOUTS = {"agg": 600, "train": 300, "ckks": 240, "store": 240,
                      "e2e": 600, "cohort": 1200, "health": 240,
                      "serving": 300, "churn": 240, "obs": 240,
                      "fabric": 240, "prof": 240, "tree_dist": 300,
-                     "fleet": 300, "trace": 240, "lora": 600}
+                     "fleet": 300, "trace": 240, "runtime": 300,
+                     "lora": 600}
 # the MFU sweep runs one child per variant (see _run_mfu_variants); a
 # single variant — one 201M-param compile + a handful of steps — gets this
 # much before it is declared wedged. A wedge therefore burns ~420s + one
@@ -1979,7 +2076,8 @@ _DEVICE_SECTIONS = ("agg", "mfu", "e2e", "train", "flash", "decode", "lora")
 # host-only sections — immune to tunnel state; run last on a healthy
 # backend, FIRST while degraded (buys the tunnel minutes to recover)
 _HOST_SECTIONS = ("ckks", "store", "cohort", "health", "serving", "churn",
-                  "obs", "fabric", "prof", "tree_dist", "fleet", "trace")
+                  "obs", "fabric", "prof", "tree_dist", "fleet", "trace",
+                  "runtime")
 def _default_partial_path() -> str:
     """Where the crash-durable partials land by default:
     ``bench_results/`` — NOT the repo root. Three separate rounds shipped

@@ -267,6 +267,31 @@ class ProfConfig:
 
 
 @dataclass
+class RuntimeConfig:
+    """Accelerator runtime observability (telemetry/runtime.py): XLA
+    compile tracking (``jax.monitoring`` duration listener + the
+    ``monitored_jit`` attribution wrappers on the jit entrypoints we
+    own), cold-vs-recompile classification with storm events, and
+    device/host memory accounting sampled on the prof cadence.
+    ``enabled=false`` installs no listener, wrapped jits pass straight
+    through at one attribute check, and the ``CollectTelemetry``
+    section is an ``{"enabled": false}`` stub."""
+
+    enabled: bool = True
+    # per-fn compile-row budget: this many names stay exact, the crowd
+    # folds into the "_other" row (PR 9 posture)
+    budget: int = 256
+    # memory-sample gate on the prof sampler cadence (seconds): a 67 Hz
+    # sampler costs one memory walk per this interval, not 67/s
+    mem_every_s: float = 1.0
+    # a recompile storm = storm_threshold recompiles of ONE function
+    # inside storm_window_s (emits a jax_recompile_storm event, muted
+    # per function for one window)
+    storm_window_s: float = 10.0
+    storm_threshold: int = 4
+
+
+@dataclass
 class FabricConfig:
     """Fleet telemetry fabric (telemetry/fabric.py): the
     ``CollectTelemetry`` cursor-pull RPC every role-carrying endpoint
@@ -340,6 +365,8 @@ class TelemetryConfig:
     fabric: FabricConfig = field(default_factory=FabricConfig)
     # continuous profiling plane (telemetry/prof.py)
     prof: ProfConfig = field(default_factory=ProfConfig)
+    # accelerator runtime observability (telemetry/runtime.py)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     # flight-recorder bundle directory (telemetry/postmortem.py): crash /
     # chaos-kill / failover post-mortems land here. "" → recorder off;
     # the driver fills this in with <workdir>/postmortem.
@@ -886,6 +913,23 @@ class FederationConfig:
                 # a tiny table thrashes the SpaceSaving floor and every
                 # profile becomes eviction noise
                 raise ValueError("telemetry.prof.budget must be >= 16")
+        rt = self.telemetry.runtime
+        if rt.enabled:
+            # the silently-armed-nothing posture: a knob that would make
+            # the plane record nothing (or storm-mute everything) must
+            # fail at config time, not "run" blind
+            if rt.budget < 8:
+                raise ValueError("telemetry.runtime.budget must be >= 8")
+            if rt.mem_every_s <= 0.0:
+                raise ValueError(
+                    "telemetry.runtime.mem_every_s must be > 0")
+            if rt.storm_window_s <= 0.0:
+                raise ValueError(
+                    "telemetry.runtime.storm_window_s must be > 0")
+            if rt.storm_threshold < 2:
+                # 1 would flag every single recompile as a "storm"
+                raise ValueError(
+                    "telemetry.runtime.storm_threshold must be >= 2")
         if self.telemetry.alerts_interval_s <= 0.0:
             raise ValueError("telemetry.alerts_interval_s must be > 0")
         if self.telemetry.alerts:

@@ -101,11 +101,22 @@ def _standby_main(args, config, parser, metrics_http) -> int:
     last_progress = time.monotonic()
     failures = 0
     promoted = False
+    # standby replication lag: primary WAL head vs the tail position
+    # this loop has caught up to — records that landed inside one probe
+    # interval. Rides CollectTelemetry off the warm standby's server, so
+    # the status --fleet ha: line can show the standby keeping up (or
+    # not) while the primary is still alive.
+    lag_gauge = tmetrics.registry().gauge(
+        telemetry.M_CONTROLLER_WAL_LAG_RECORDS,
+        "Standby tail position behind the primary's WAL head (records "
+        "observed landing per probe tick; 0 = caught up)")
+    lag_gauge.set(0.0)
     while not stop.is_set():
         stop.wait(standby.probe_interval_s)
         if stop.is_set():
             break
         seq = wal.poll()
+        lag_gauge.set(float(max(0, seq - last_seq)))
         if seq != last_seq:
             last_seq, last_progress, failures = seq, time.monotonic(), 0
             continue

@@ -1775,6 +1775,18 @@ class DriverSession:
                                              "prof-fleet.json"))
             except Exception:  # noqa: BLE001 - profiling never blocks
                 logger.exception("fleet profile dump failed")
+            # and the accelerator-runtime sections (telemetry/runtime.py):
+            # per-peer compile tables + the fleet merge, the artifact
+            # `python -m metisfl_tpu.perf --compile-report
+            # <workdir>/runtime-fleet.json` renders
+            try:
+                if self._fleet.dump_runtime(
+                        os.path.join(self.workdir, "runtime-fleet.json")):
+                    logger.info("fleet runtime report written: %s",
+                                os.path.join(self.workdir,
+                                             "runtime-fleet.json"))
+            except Exception:  # noqa: BLE001 - telemetry never blocks
+                logger.exception("fleet runtime dump failed")
         if timeout_s is None:
             multihost = any(int(getattr(ep, "world_size", 1)) > 1
                             for ep in self.config.learners)

@@ -32,6 +32,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from metisfl_tpu.telemetry import runtime as _runtime
+
 Pytree = Any
 
 
@@ -161,7 +163,8 @@ def generate(module, variables: Pytree, prompt, max_new_tokens: int, *,
             # server with many (shape, sampling) combos must not retain
             # every XLA executable forever
             _COMPILED.pop(next(iter(_COMPILED)))
-        compiled = _COMPILED[key] = jax.jit(run)
+        compiled = _COMPILED[key] = _runtime.monitored_jit(
+            run, name="generate")
     else:
         _COMPILED[key] = _COMPILED.pop(key)  # refresh LRU position
     return compiled(variables, prompt, rng)
@@ -260,7 +263,8 @@ class SlotDecoder:
 
             while len(self._prefill_fns) >= self._PREFILL_MAX:
                 self._prefill_fns.pop(next(iter(self._prefill_fns)))
-            fn = self._prefill_fns[L] = jax.jit(run)
+            fn = self._prefill_fns[L] = _runtime.monitored_jit(
+                run, name="decode.prefill")
         else:
             self._prefill_fns[L] = self._prefill_fns.pop(L)  # LRU refresh
         self.caches, tok = fn(variables, self.caches, prompt,
@@ -287,7 +291,7 @@ class SlotDecoder:
                 return jax.vmap(one, in_axes=(0, 0, 0))(caches, toks,
                                                         positions)
 
-            self._step_fn = jax.jit(run)
+            self._step_fn = _runtime.monitored_jit(run, name="decode.step")
         self.caches, nxt = self._step_fn(
             variables, self.caches, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32))

@@ -32,6 +32,7 @@ from metisfl_tpu.comm.messages import TrainParams
 from metisfl_tpu.models.dataset import ArrayDataset
 from metisfl_tpu.models.optimizers import make_optimizer
 from metisfl_tpu.telemetry import profile as _tprofile
+from metisfl_tpu.telemetry import runtime as _runtime
 
 Pytree = Any
 
@@ -312,7 +313,8 @@ class FlaxModelOps:
             acc = _accuracy(logits, y)
             return params, new_bs, opt_state, loss, acc
 
-        compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+        compiled = _runtime.monitored_jit(step, name="train.step",
+                                          donate_argnums=(0, 1, 2))
         self._step_cache[key] = (compiled, tx, step)
         return self._step_cache[key]
 
@@ -348,7 +350,9 @@ class FlaxModelOps:
                              (xs, ys, step_ids)))
             return params, batch_stats, opt_state, rng, losses, accs
 
-        compiled = jax.jit(scan_steps, donate_argnums=(0, 1, 2))
+        compiled = _runtime.monitored_jit(scan_steps,
+                                          name="train.scan_steps",
+                                          donate_argnums=(0, 1, 2))
         self._step_cache[key] = (compiled, tx)
         return self._step_cache[key]
 
@@ -525,8 +529,9 @@ class FlaxModelOps:
         touching the engine's training slot.
         """
         if not hasattr(self, "_infer_compiled"):
-            self._infer_compiled = jax.jit(
-                lambda v, xb: self._apply(v, xb, train=False))
+            self._infer_compiled = _runtime.monitored_jit(
+                lambda v, xb: self._apply(v, xb, train=False),
+                name="infer")
         if variables is None:
             variables = self.variables
         elif self.mesh is not None:
@@ -586,7 +591,7 @@ class FlaxModelOps:
                 vals[name] = fn(logits, y)
             return vals
 
-        compiled = jax.jit(eval_step)
+        compiled = _runtime.monitored_jit(eval_step, name="eval.step")
         self._eval_cache[metric_names] = compiled
         return compiled
 

@@ -40,6 +40,16 @@ The reading half of the performance observatory (telemetry/profile.py):
   self-time growth, the table that answers "which frames grew when
   rounds/s dropped".
 
+- **--compile-report <source>** — the accelerator-runtime view
+  (telemetry/runtime.py): per-fn XLA compile counts/durations and the
+  recompile offenders table, from a fleet runtime dump
+  (``FleetCollector.dump_runtime`` / the driver's
+  ``runtime-fleet.json``), a raw ``runtime.collect_state()`` JSON, or a
+  run dir whose span timeline carries ``jax.compile`` events::
+
+      python -m metisfl_tpu.perf --compile-report <workdir>/runtime-fleet.json
+      python -m metisfl_tpu.perf --compile-report <workdir>
+
 Bench noise floor: captures may carry a ``details.repeats`` map
 (``{key: K}`` — bench.py re-measured ms-scale keys median-of-K on hosts
 whose run-to-run spread exceeds the gate). The comparison rows carry
@@ -424,7 +434,11 @@ _LOWER_BETTER = ("_ms", "ms_per", "_secs", "seconds", "_bytes", "_mb",
                  # (the overhead *percentage* is deliberately unjudged —
                  # a ratio of two noisy medians would flag pure noise;
                  # the chaos_smoke prof gate bounds it absolutely)
-                 "_ns")
+                 "_ns",
+                 # runtime section: a growing steady-state recompile
+                 # count is always a regression (the smoke gate pins the
+                 # decode path's at zero absolutely)
+                 "recompile")
 
 
 def metric_direction(key: str) -> int:
@@ -677,6 +691,124 @@ def _flame_main(path: str, want_round: Optional[int], top: int,
     return 0
 
 
+def load_runtime_state(path: str) -> Dict[str, Any]:
+    """An accelerator-runtime state (``runtime.collect_state`` shape)
+    from any artifact this repo writes:
+
+    - a fleet runtime dump (``{"kind": "runtime", "peers"/"merged"}`` —
+      ``FleetCollector.dump_runtime`` / the driver's
+      ``runtime-fleet.json``): the fleet-merged view;
+    - a raw ``runtime.collect_state()`` JSON (``{"fns": {...}}``);
+    - a run dir / ``traces.jsonl`` whose span timeline carries
+      ``jax.compile`` events — rows rebuilt from their attrs.
+
+    Returns ``{}`` when nothing runtime-shaped is found."""
+    if os.path.isdir(path) or path.endswith(".jsonl"):
+        fns: Dict[str, Dict[str, Any]] = {}
+        compiles = recompiles = 0
+        for span in _load_trace_spans(path):
+            if span.get("name") != "jax.compile":
+                continue
+            attrs = span.get("attrs") or {}
+            fn = str(attrs.get("fn", "(unattributed)"))
+            kind = str(attrs.get("kind", "cold"))
+            dur_s = float(span.get("dur_ms", 0.0) or 0.0) / 1e3
+            row = fns.setdefault(fn, {"cold": 0, "recompiles": 0,
+                                      "total_s": 0.0, "max_s": 0.0,
+                                      "last_sig": ""})
+            if kind == "recompile":
+                row["recompiles"] += 1
+                recompiles += 1
+            else:
+                row["cold"] += 1
+            row["total_s"] += dur_s
+            row["max_s"] = max(row["max_s"], dur_s)
+            row["last_sig"] = str(attrs.get("sig", "")) or row["last_sig"]
+            compiles += 1
+        if not fns:
+            return {}
+        return {"enabled": True, "compiles": compiles,
+                "recompiles": recompiles, "fns": fns}
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"cannot read a runtime report from {path}: {exc}",
+              file=sys.stderr)
+        return {}
+    if not isinstance(data, dict):
+        return {}
+    if data.get("kind") == "runtime":            # fleet dump
+        merged = data.get("merged") or {}
+        if merged.get("fns"):
+            merged = dict(merged)
+            merged["peers"] = sorted(data.get("peers") or ())
+            return merged
+        return {}
+    if "fns" in data:                            # raw collect_state
+        return data
+    return {}
+
+
+def render_compile_report(state: Dict[str, Any], top: int = 15) -> str:
+    """The ``--compile-report`` screen: totals, the per-fn compile
+    table (recompile offenders first), and the recent-compile tail when
+    the source carries one."""
+    from metisfl_tpu.telemetry import runtime as _runtime
+
+    rows = _runtime.compile_rows(state)
+    lines = [
+        f"compiles: {int(state.get('compiles', 0))} total / "
+        f"{int(state.get('recompiles', 0))} recompiles / "
+        f"{int(state.get('storms', 0) or 0)} storm(s)"
+        + (f"  peers={','.join(state['peers'])}"
+           if state.get("peers") else "")]
+    mem = state.get("memory") or {}
+    if isinstance(mem, dict) and mem:
+        if "device_bytes" in mem:               # one process's sample
+            lines.append(f"memory: {mem.get('plane', '?')} "
+                         f"{int(mem.get('device_bytes', 0)) / 1e6:.1f}MB "
+                         f"({mem.get('source', '?')})")
+        else:                                   # merged per-plane maxima
+            cells = [f"{pl}={int(b) / 1e6:.1f}MB"
+                     for pl, b in sorted(mem.items())]
+            lines.append("memory: " + "  ".join(cells))
+    lines.append(f"{'fn':<28} {'compiles':>8} {'cold':>5} "
+                 f"{'recomp':>6} {'total_s':>8} {'max_s':>7}  last_sig")
+    for row in rows[:top]:
+        lines.append(
+            f"{row['fn'][:28]:<28} {row['compiles']:>8} {row['cold']:>5} "
+            f"{row['recompiles']:>6} {row['total_s']:>8.3f} "
+            f"{row['max_s']:>7.3f}  {row['last_sig'][:40]}")
+    if len(rows) > top:
+        lines.append(f"... {len(rows) - top} more fn(s)")
+    offenders = [r for r in rows if r["recompiles"]]
+    if offenders:
+        worst = offenders[0]
+        lines.append(f"worst offender: {worst['fn']} recompiled "
+                     f"{worst['recompiles']}x "
+                     f"(last sig {worst['last_sig'][:60] or '?'})")
+    recent = state.get("recent") or []
+    if recent:
+        lines.append("recent compiles:")
+        for ts, fn, kind, dur_s, sig in recent[-8:]:
+            lines.append(f"  {kind:<9} {fn:<28} {float(dur_s) * 1e3:8.1f}ms"
+                         f"  {str(sig)[:40]}")
+    return "\n".join(lines)
+
+
+def _compile_report_main(path: str, top: int) -> int:
+    state = load_runtime_state(path)
+    if not state or not state.get("fns"):
+        print(f"no runtime compile data found in {path} (is "
+              "telemetry.runtime enabled and the source a runtime dump / "
+              "collect_state JSON / run dir with jax.compile spans?)",
+              file=sys.stderr)
+        return 2
+    print(render_compile_report(state, top=top))
+    return 0
+
+
 def _flame_diff_main(path_a: str, path_b: str,
                      want_round: Optional[int], top: int) -> int:
     a = load_folded(path_a, want_round=want_round)
@@ -739,6 +871,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--flame-diff", nargs=2, metavar=("A", "B"),
                         help="differential profile between two captures "
                              "or rounds (path@N selects a round)")
+    parser.add_argument("--compile-report", metavar="SOURCE",
+                        help="per-fn XLA compile counts/durations + the "
+                             "recompile offenders table from a fleet "
+                             "runtime dump (runtime-fleet.json), a raw "
+                             "runtime collect_state JSON, or a run dir's "
+                             "jax.compile spans")
     parser.add_argument("--out", default="",
                         help="--flame: write the collapsed stacks to this "
                              "file and print the table to stdout")
@@ -765,6 +903,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.flame_diff:
         return _flame_diff_main(args.flame_diff[0], args.flame_diff[1],
                                 args.round, args.top)
+    if args.compile_report:
+        return _compile_report_main(args.compile_report, args.top)
     if args.compare:
         return _compare_main(args.compare[0], args.compare[1],
                              args.threshold, args.all)

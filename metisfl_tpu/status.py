@@ -332,6 +332,48 @@ def render_fleet(snap: Dict[str, Any], span_tail: int = 25,
             cells.append(cell)
         if cells:
             lines.append("prof: " + "  |  ".join(cells))
+    runtime = snap.get("runtime") or {}
+    if runtime:
+        # accelerator-runtime line (telemetry/runtime.py over the
+        # CollectTelemetry runtime section): per-peer compile totals,
+        # the worst recompile offender, and the latest memory sample
+        cells = []
+        for name in sorted(runtime):
+            row = runtime[name] or {}
+            if not row.get("compiles") and not row.get("mem_bytes"):
+                continue
+            cell = (f"{name}: {row.get('compiles', 0)}c/"
+                    f"{row.get('recompiles', 0)}r")
+            if row.get("storms"):
+                cell += f" STORMS={row['storms']}"
+            if row.get("top_offender"):
+                cell += (f" worst={row['top_offender']}"
+                         f"x{row.get('top_offender_recompiles', 0)}")
+            if row.get("mem_bytes"):
+                cell += f" mem={row['mem_bytes'] / 1e6:.0f}MB"
+            cells.append(cell)
+        if cells:
+            lines.append("runtime: " + "  |  ".join(cells))
+    families = snap.get("families") or {}
+    wal_total = (families.get("controller_wal_records_total")
+                 or {}).get("total")
+    wal_lag = (families.get("controller_wal_lag_records")
+               or {}).get("total")
+    failovers = (families.get("controller_failover_total")
+                 or {}).get("total")
+    if wal_total is not None or wal_lag is not None:
+        # hot-standby HA line (controller/wal.py + __main__ --standby):
+        # WAL replication depth, the standby's tail lag, and how long
+        # promotions took when any fired
+        cell = (f"ha: wal={wal_total or 0:g} records "
+                f"lag={wal_lag or 0:g}")
+        if failovers:
+            cell += f"  failovers={failovers:g}"
+            promote = (families.get("controller_failover_promote_seconds")
+                       or {}).get("sum")
+            if promote:
+                cell += f" promote={promote:g}s"
+        lines.append(cell)
     crit = snap.get("crit") or {}
     if crit:
         # latest round's causal critical path (telemetry/causal.py via

@@ -138,6 +138,7 @@ M_CONTROLLER_RESTARTS_TOTAL = "controller_restarts_total"
 M_GATEWAY_RESTARTS_TOTAL = "gateway_restarts_total"
 # controller hot-standby (controller/wal.py + __main__.py --standby)
 M_CONTROLLER_WAL_RECORDS_TOTAL = "controller_wal_records_total"
+M_CONTROLLER_WAL_LAG_RECORDS = "controller_wal_lag_records"
 M_CONTROLLER_FAILOVER_TOTAL = "controller_failover_total"
 M_CONTROLLER_FAILOVER_PROMOTE_SECONDS = "controller_failover_promote_seconds"
 # model registry (registry/registry.py)
@@ -155,6 +156,10 @@ M_ALERTS_FIRED_TOTAL = alerts.ALERTS_FIRED_TOTAL
 M_PROF_SAMPLES_TOTAL = "prof_samples_total"
 M_LOCK_WAIT_SECONDS = "lock_wait_seconds"
 M_LOCK_CONTENTION_TOTAL = "lock_contention_total"
+# accelerator runtime observability (telemetry/runtime.py)
+M_JAX_COMPILES_TOTAL = "jax_compiles_total"
+M_JAX_COMPILE_SECONDS = "jax_compile_seconds"
+M_JAX_DEVICE_MEMORY_BYTES = "jax_device_memory_bytes"
 # fleet telemetry fabric (telemetry/fabric.py FleetCollector)
 M_FABRIC_COLLECTIONS_TOTAL = "fabric_collections_total"
 M_FABRIC_PEER_OFFSET_MS = "fabric_peer_clock_offset_ms"
@@ -276,6 +281,19 @@ def apply_config(telemetry_config, service: str = "",
         enabled=enabled and bool(getattr(prof_cfg, "enabled", True)),
         hz=float(getattr(prof_cfg, "hz", 0.0) or 0.0),
         budget=int(getattr(prof_cfg, "budget", 0) or 0))
+    # accelerator runtime observability (telemetry/runtime.py): arm the
+    # XLA compile listener + memory accounting; the service name picks
+    # the memory-attribution plane (controller / learner / serving)
+    rt_cfg = getattr(telemetry_config, "runtime", None)
+    runtime.set_plane(service)
+    runtime.configure(
+        enabled=enabled and bool(getattr(rt_cfg, "enabled", True)),
+        budget=int(getattr(rt_cfg, "budget", 0) or 0),
+        mem_every_s=float(getattr(rt_cfg, "mem_every_s", 0.0) or 0.0),
+        storm_window_s=float(
+            getattr(rt_cfg, "storm_window_s", 0.0) or 0.0),
+        storm_threshold=int(
+            getattr(rt_cfg, "storm_threshold", 0) or 0))
 
 
 # Imported at the BOTTOM so profile.py (which reads the M_* constants at
@@ -283,8 +301,10 @@ def apply_config(telemetry_config, service: str = "",
 # submodules import nothing back from this package. fabric imports only
 # sibling submodules at module level (its RPC client is lazy), so the
 # same late import keeps the comm <-> telemetry layering acyclic. prof
-# loads FIRST: fabric and profile both reference it.
+# loads FIRST: fabric, profile, and runtime all reference it; runtime
+# loads before fabric (fabric's CollectTelemetry serves its section).
 from metisfl_tpu.telemetry import prof  # noqa: E402
+from metisfl_tpu.telemetry import runtime  # noqa: E402
 from metisfl_tpu.telemetry import fabric, profile  # noqa: E402
 
-__all__ += ["profile", "fabric", "prof"]
+__all__ += ["profile", "fabric", "prof", "runtime"]

@@ -249,6 +249,12 @@ def main(argv: List[str]) -> int:
         from metisfl_tpu.telemetry import prof as _prof
         return _prof.main(
             ["--smoke"] + [a for a in argv if a != "--prof-smoke"])
+    if "--runtime-smoke" in argv:
+        # the accelerator-runtime CI gate (scripts/chaos_smoke.sh):
+        # zero steady-state recompiles + detector fires + overhead
+        from metisfl_tpu.telemetry import runtime as _runtime
+        return _runtime.main(
+            ["--smoke"] + [a for a in argv if a != "--runtime-smoke"])
     if "--causal-smoke" in argv:
         # the causal-tracing CI gate (scripts/chaos_smoke.sh): slowed-
         # learner attribution + orphan lint + propagation overhead

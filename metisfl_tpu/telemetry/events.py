@@ -352,6 +352,22 @@ class ControllerFailover:
 
 
 @dataclass(frozen=True)
+class RecompileStorm:
+    """One jitted function recompiled ``count`` times inside
+    ``window_s`` seconds (telemetry/runtime.py): its abstract input
+    signature keeps changing — unpadded shapes, an LRU bound too small
+    for the live working set, or a Python-side cache miss — and every
+    recompile stalls the caller for the full XLA compile. Muted per
+    function for one window after firing."""
+
+    kind: ClassVar[str] = "jax_recompile_storm"
+    fn: str
+    count: int = 0
+    window_s: float = 0.0
+    last_sig: str = ""
+
+
+@dataclass(frozen=True)
 class SliceAggregatorLost:
     """A slice aggregator process stopped answering (consecutive RPC
     failures confirmed by a grpc.health.v1 probe); its cohort slice is
@@ -389,7 +405,7 @@ EVENT_TYPES: Dict[str, type] = {
                 AlertResolved, FabricPeerStale, FabricPeerRecovered,
                 SliceAggregatorLost, SliceRehomed, ServingReplicaDead,
                 ServingReplicaRecovered, ServingScaledUp,
-                ServingScaledDown, ControllerFailover)
+                ServingScaledDown, ControllerFailover, RecompileStorm)
 }
 
 

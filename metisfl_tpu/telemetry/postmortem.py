@@ -154,6 +154,17 @@ class _Recorder:
         except Exception:  # noqa: BLE001 - best-effort by contract
             pass
         try:
+            # accelerator runtime at death (telemetry/runtime.py): the
+            # compile/recompile rollup + last memory sample — a crash
+            # mid recompile-storm or post HBM-climb names itself here
+            from metisfl_tpu.telemetry import runtime as _runtime
+
+            runtime_snapshot = _runtime.postmortem_snapshot()
+            if runtime_snapshot is not None:
+                bundle["runtime"] = runtime_snapshot
+        except Exception:  # noqa: BLE001 - best-effort by contract
+            pass
+        try:
             # alerts at death (telemetry/alerts.py): the firing page
             # nobody got — which rules were active, for how long
             from metisfl_tpu.telemetry import alerts as _alerts

@@ -58,7 +58,7 @@ import sys
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from metisfl_tpu.telemetry import metrics as _metrics
 from metisfl_tpu.telemetry.sketch import SpaceSaving
@@ -351,6 +351,12 @@ class _Sampler:
             self.samples += len(folded)
             self.ticks += 1
         _M_SAMPLES.inc(len(folded))
+        for hook in tuple(_TICK_HOOKS):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - a hook must never take
+                # the sampler thread down
+                logger.exception("sampler tick hook failed")
         return len(folded)
 
     def counts(self) -> Dict[str, float]:
@@ -383,6 +389,17 @@ class _State:
 
 _STATE = _State()
 _SAMPLER = _Sampler()
+# other telemetry planes riding the sampler cadence (runtime.py's
+# memory accounting); each hook self-gates its own frequency
+_TICK_HOOKS: List[Callable[[], None]] = []
+
+
+def register_tick_hook(fn: Callable[[], None]) -> None:
+    """Piggyback ``fn`` on every sampler tick (~hz calls/s while the
+    sampler runs). Idempotent per function; hooks must be cheap and
+    exception-safe — a raising hook is logged and skipped, never fatal."""
+    if fn not in _TICK_HOOKS:
+        _TICK_HOOKS.append(fn)
 
 
 def enabled() -> bool:

@@ -160,6 +160,28 @@ case "$rc" in
 esac
 [ "$rc" -eq 0 ] || exit "$rc"
 
+# ISSUE 19 accelerator-runtime gate (docs/OBSERVABILITY.md "Runtime
+# observability"): the bench round loop plus a continuous-batching
+# decode burst under the XLA compile listener. The build fails when any
+# steady-state (post-warmup) compile fires on either path, when a
+# deliberately shape-shifting control run does NOT trip the recompile
+# detector (+ its storm event), or when the monitored_jit wrapper costs
+# more than the pinned 50 µs per steady-state call.
+JAX_PLATFORMS=cpu timeout -k 10 240 "$PYTHON" -m metisfl_tpu.telemetry \
+  --runtime-smoke --overhead-budget-ns 50000
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: runtime PASS (zero steady-state compiles on the" \
+          "round + decode paths, the recompile detector provably fires," \
+          "wrapper overhead within budget)" ;;
+  1) echo "chaos_smoke: runtime FAIL — a steady-state recompile, a blind" \
+          "detector, or wrapper overhead past budget (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: runtime FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
 # ISSUE 17 controller-kill gate (docs/RESILIENCE.md "Controller
 # hot-standby"): a real-gRPC federation with a warm --standby tailing
 # the round-state WAL; the seeded injector SIGKILLs the controller on
