@@ -61,8 +61,9 @@ from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.aggregation.slice import (
     SLICE_SERVICE,
     SliceClient,
-    read_spool,
+    read_spool_records,
 )
+from metisfl_tpu.secure.distributed import MaskedAccumulator
 from metisfl_tpu.aggregation.tree import (
     _DEFAULT_SUBBLOCK,
     SlicePartial,
@@ -118,8 +119,19 @@ class DistributedSliceReducer:
     public method is safe to call from the scheduling executor, and
     :meth:`describe` additionally from RPC threads."""
 
-    def __init__(self, tree_cfg, ssl=None, comm=None):
+    def __init__(self, tree_cfg, ssl=None, comm=None, masked: bool = False,
+                 stream: bool = False):
         self._ssl, self._comm = ssl, comm
+        # masked partial-fold plane (secure/distributed.py): uplinks are
+        # opaque uint64 blobs forwarded VERBATIM (re-encoding a masked
+        # payload is meaningless and decode is impossible here), slices
+        # fold them as modular sums via FoldPartial{masked}, and reduce
+        # happens through :meth:`reduce_masked`. ``stream`` additionally
+        # turns on slice-side fold-on-arrival (masking × streaming ×
+        # distributed — safe because masked payloads are round-idempotent
+        # byte-identical, so the slice's duplicate-contributor skip holds)
+        self.masked = bool(masked)
+        self.stream = bool(stream) and self.masked
         self.rehome_retries = int(getattr(tree_cfg, "rehome_retries", 3))
         self.rehome_backoff_s = float(
             getattr(tree_cfg, "rehome_backoff_s", 0.2))
@@ -268,6 +280,11 @@ class DistributedSliceReducer:
         True when a slice holds it, False when it fell back to the
         root's residual buffer — either way the uplink is kept."""
         blob: Optional[bytes] = None
+        if self.masked:
+            # masked mode: ``model`` IS the learner's raw uplink bytes —
+            # forwarded verbatim (one-time-pad discipline: the slice must
+            # hold exactly the bytes the learner shipped)
+            blob = model
         attempt = 0
         last_idx = ROOT
         while not self._shutdown:
@@ -284,7 +301,8 @@ class DistributedSliceReducer:
             st = self._slices[idx]
             last_idx = idx
             try:
-                self._client(st).submit(learner_id, round_id, blob)
+                self._client(st).submit(learner_id, round_id, blob,
+                                        stream=self.stream)
                 with self._lock:
                     st.failures = 0
                 return True
@@ -307,7 +325,7 @@ class DistributedSliceReducer:
             if idx not in (ROOT, last_idx):
                 try:
                     self._client(self._slices[idx]).submit(
-                        learner_id, round_id, blob)
+                        learner_id, round_id, blob, stream=self.stream)
                     with self._lock:
                         self._slices[idx].failures = 0
                     return True
@@ -317,8 +335,17 @@ class DistributedSliceReducer:
         # survive whatever the slice fleet is doing. Re-pointing the
         # owner to ROOT is what keeps it IN the round's fold (the fold
         # path only consults the residual buffer for root-owned ids).
+        parked: Any = model
+        if self.masked:
+            try:
+                parked = dict(ModelBlob.from_bytes(model).opaque)
+            except ValueError:
+                logger.warning("masked uplink from %s undecodable; "
+                               "dropping from the root residual",
+                               learner_id)
+                return False
         with self._lock:
-            self._residual[learner_id] = (int(round_id), model)
+            self._residual[learner_id] = (int(round_id), parked)
             self._owner[learner_id] = ROOT
         return False
 
@@ -368,25 +395,30 @@ class DistributedSliceReducer:
         alive = [i for i in self._alive_indices() if i != st.index]
         target = alive[0] if alive else ROOT
         target_name = self._slices[target].name if target != ROOT else "root"
-        spooled = read_spool(st.spool_dir) if st.spool_dir else {}
+        spooled = read_spool_records(st.spool_dir) if st.spool_dir else {}
         recovered, lost = 0, 0
-        for lid, raw in spooled.items():
+        for lid, (rid, raw) in spooled.items():
             if target != ROOT:
                 try:
+                    # re-submit under the RECORDED round: masked folds are
+                    # round-matched (mask streams are round-keyed), and
+                    # the plain path's latest-wins hold is unaffected
                     self._client(self._slices[target]).submit(
-                        lid, round_id, raw)
+                        lid, rid, raw, stream=self.stream)
                     recovered += 1
                     continue
                 except Exception:  # noqa: BLE001 - survivor died too
                     logger.warning("re-home target %s refused %s; keeping "
                                    "it at the root", target_name, lid)
             try:
-                tree = dict(ModelBlob.from_bytes(raw).tensors)
+                decoded = ModelBlob.from_bytes(raw)
+                tree = (dict(decoded.opaque) if decoded.opaque
+                        else dict(decoded.tensors))
             except ValueError:
                 lost += 1
                 continue
             with self._lock:
-                self._residual[lid] = (int(round_id), tree)
+                self._residual[lid] = (int(rid), tree)
                 # re-point THIS learner at the root: the fold path only
                 # consults the residual buffer for root-owned ids, so
                 # without the re-point a target-refused uplink would be
@@ -485,6 +517,152 @@ class DistributedSliceReducer:
                              "re-folded from the recovered spool")
                 # loop: the executor re-resolves through any new redirect
         return self._fold_root(group, scales, subblock), error
+
+    # ------------------------------------------------------------------ #
+    # masked fan-in (secure/distributed.py partial-fold plane)
+    # ------------------------------------------------------------------ #
+
+    def _fold_masked_root(self, ids: Sequence[str], round_id: int
+                          ) -> Tuple[Dict[str, Any], Dict[str, Any],
+                                     List[str]]:
+        """Residual-buffer masked fold: round-matched opaque blobs only
+        (a stale masked payload must never enter the sum — its masks
+        would not cancel)."""
+        acc = MaskedAccumulator()
+        with self._lock:
+            held = {lid: self._residual[lid] for lid in ids
+                    if lid in self._residual}
+        for lid in sorted(held):
+            rid, tree = held[lid]
+            if int(rid) != int(round_id):
+                continue
+            acc.fold(lid, tree)
+        return acc.snapshot()
+
+    def _fold_masked_remote(self, st: _SliceState, group: List[str],
+                            round_id: int
+                            ) -> Tuple[Dict[str, Any], Dict[str, Any],
+                                       List[str]]:
+        reply = self._client(st).fold_masked(group, round_id,
+                                             stream=self.stream)
+        with self._lock:
+            st.failures = 0
+            st.last_stats = reply.get("stats")
+        sums: Dict[str, Any] = {}
+        specs: Dict[str, Any] = {}
+        if reply.get("acc"):
+            blob = ModelBlob.from_bytes(reply["acc"])
+            for name, (payload, spec) in blob.opaque.items():
+                sums[name] = np.frombuffer(payload, np.uint64).copy()
+                specs[name] = spec
+        return sums, specs, [str(lid) for lid in reply.get("present") or ()]
+
+    def _fold_masked_group(self, base_idx: int, group: List[str],
+                           round_id: int
+                           ) -> Tuple[Tuple[Dict[str, Any], Dict[str, Any],
+                                            List[str]], Optional[str]]:
+        """The masked twin of :meth:`_fold_group`: same retry ladder,
+        same probe-owned death decision, same root fallback — but the
+        partial is per-tensor uint64 sums + the contributor list the
+        root's mask settlement reconciles."""
+        error: Optional[str] = None
+        attempts = 0
+        budget = len(self._slices) + max(1, self.rehome_retries) + 1
+        while attempts < budget:
+            idx = self._resolve_executor(base_idx)
+            if idx == ROOT:
+                break
+            st = self._slices[idx]
+            try:
+                return self._fold_masked_remote(st, group, round_id), error
+            except Exception as exc:  # noqa: BLE001 - retry / re-home
+                self._note_failure(st, exc, round_id)
+                attempts += 1
+                with self._lock:
+                    alive = not st.dead and st.redirect is None
+                if alive:
+                    if attempts >= budget:
+                        error = (f"slice {st.name} probe-alive but "
+                                 "unresponsive to FoldPartial; its group "
+                                 "folded at the root")
+                        break
+                    time.sleep(self.rehome_backoff_s
+                               * (2 ** max(0, attempts - 1)))
+                else:
+                    error = (f"slice {st.name} died mid-round; its group "
+                             "re-folded from the recovered spool")
+        return self._fold_masked_root(group, round_id), error
+
+    def reduce_masked(self, ids: Sequence[str], round_id: int
+                      ) -> Optional[Tuple[Dict[str, Any], Dict[str, Any],
+                                          List[str], List[str]]]:
+        """Fan in the round's MASKED partials: one FoldPartial{masked}
+        per base owner group (parallel), root residual folded locally,
+        modular uint64 sums combined at the root. Returns ``(sums,
+        specs, contributors, errors)`` — the contributor list is ground
+        truth for the mask settlement — or None when nothing folded.
+        Contributor sets across groups must be disjoint; an overlap
+        means a payload entered two sums and the combined sum would
+        double-count it, so the round fails loudly into the caller's
+        aggregation retry instead of publishing a corrupt model."""
+        ids = sorted(set(ids))
+        if not ids:
+            return None
+        groups: Dict[int, List[str]] = {}
+        for lid in ids:
+            groups.setdefault(self._base_owner(lid), []).append(lid)
+        order = sorted(groups, key=lambda i: (i == ROOT, i))
+        trace_ctx = _ttrace.current_context()
+
+        def _fold_traced(idx):
+            with _ttrace.use_context(trace_ctx):
+                if idx == ROOT:
+                    return self._fold_masked_root(groups[idx],
+                                                  round_id), None
+                return self._fold_masked_group(idx, groups[idx], round_id)
+
+        futures = {idx: self._executor().submit(_fold_traced, idx)
+                   for idx in order}
+        root = MaskedAccumulator()
+        errors: List[str] = []
+        first_error: Optional[BaseException] = None
+        for idx in order:
+            try:
+                (sums, specs, present), err = futures[idx].result()
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                if first_error is None:
+                    first_error = exc
+                continue
+            if err:
+                errors.append(err)
+            if not present:
+                continue
+            fresh = [lid for lid in present
+                     if lid not in set(root.contributors)]
+            if not fresh:
+                # a fully-duplicate partial: after a re-home, two base
+                # groups resolve to the same executor and (in stream
+                # mode) each fold reply is that slice's WHOLE round
+                # accumulator — every contributor already merged, so the
+                # partial carries nothing new. Skip it.
+                continue
+            if len(fresh) != len(present):
+                overlap = sorted(set(present) - set(fresh))
+                raise RuntimeError(
+                    f"masked partials overlap on {overlap}: a payload "
+                    "was folded in two places and the modular sum would "
+                    "double-count it")
+            root.merge_sums(sums, present, specs)
+        if first_error is not None:
+            raise first_error
+        if root.count == 0:
+            return None
+        sums, specs, present = root.snapshot()
+        if len([lid for lid in ids if lid in present]) < len(ids):
+            missing = len(ids) - len([l for l in ids if l in present])
+            errors.append(f"{missing} of {len(ids)} selected learners "
+                          "had no held masked payload in any slice")
+        return sums, specs, present, errors
 
     def reduce(self, ids: Sequence[str], scales: Dict[str, float],
                stride: int = 0, round_id: int = 0

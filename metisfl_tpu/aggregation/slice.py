@@ -48,6 +48,7 @@ from metisfl_tpu import telemetry as _tel
 from metisfl_tpu.store import durable as _durable
 from metisfl_tpu.aggregation.tree import _DEFAULT_SUBBLOCK, TreeReducer
 from metisfl_tpu.comm.codec import dumps, loads
+from metisfl_tpu.secure.distributed import MaskedAccumulator
 from metisfl_tpu.telemetry import metrics as _tmetrics
 from metisfl_tpu.telemetry import prof as _prof
 from metisfl_tpu.telemetry import trace as _ttrace
@@ -65,6 +66,17 @@ _M_UPLINKS = _REG.counter(
 _M_HELD = _REG.gauge(
     _tel.M_SLICE_HELD_MODELS,
     "Learner models currently held fold-ready by this slice aggregator")
+_M_MASKED_UPLINKS = _REG.counter(
+    _tel.M_SECURE_MASKED_UPLINKS_TOTAL,
+    "Masked (secure-agg) uplinks accepted by this process")
+_M_MASKED_FOLDS = _REG.counter(
+    _tel.M_SECURE_MASKED_FOLDS_TOTAL,
+    "Masked partial folds performed, by tier",
+    labelnames=("tier",))
+
+# stream-mode accumulators kept per round id; anything older than the
+# newest few rounds is dead weight (mask streams are round-keyed)
+_STREAM_ROUNDS_KEPT = 4
 
 
 def spool_path(spool_dir: str, learner_id: str) -> str:
@@ -78,15 +90,17 @@ def spool_path(spool_dir: str, learner_id: str) -> str:
     return os.path.join(spool_dir, f"{_durable.sanitize_id(learner_id)}.bin")
 
 
-def read_spool(spool_dir: str) -> Dict[str, bytes]:
+def read_spool_records(spool_dir: str) -> Dict[str, tuple]:
     """Recover a (possibly dead) aggregator's spooled uplinks:
-    ``{learner_id: model blob bytes}``. Records are codec envelopes
-    carrying the EXACT learner id (filenames are sanitized, so an id
-    with filesystem-hostile characters would not round-trip through
-    them). Torn or unreadable files are skipped with a warning — the
-    blob integrity framing downstream rejects garbage anyway, and
-    re-homing must recover what it can, not abort on what it cannot."""
-    out: Dict[str, bytes] = {}
+    ``{learner_id: (round, model blob bytes)}``. Records are codec
+    envelopes carrying the EXACT learner id (filenames are sanitized, so
+    an id with filesystem-hostile characters would not round-trip
+    through them). Torn or unreadable files are skipped with a warning —
+    the blob integrity framing downstream rejects garbage anyway, and
+    re-homing must recover what it can, not abort on what it cannot.
+    The round matters for masked uplinks (mask streams are round-keyed,
+    so a recovered payload must only ever fold into its own round)."""
+    out: Dict[str, tuple] = {}
     if not os.path.isdir(spool_dir):
         return out
 
@@ -94,7 +108,7 @@ def read_spool(spool_dir: str) -> Dict[str, bytes]:
         record = loads(raw)
         blob = record["model"]
         ModelBlob.from_bytes(blob)  # integrity check before recovery
-        return str(record["learner_id"]), blob
+        return str(record["learner_id"]), int(record.get("round", 0)), blob
 
     for name in sorted(os.listdir(spool_dir)):
         if not name.endswith(".bin"):
@@ -102,8 +116,14 @@ def read_spool(spool_dir: str) -> Dict[str, bytes]:
         decoded = _durable.read_tolerant(
             os.path.join(spool_dir, name), _decode)
         if decoded is not None:
-            out[decoded[0]] = decoded[1]
+            out[decoded[0]] = (decoded[1], decoded[2])
     return out
+
+
+def read_spool(spool_dir: str) -> Dict[str, bytes]:
+    """``{learner_id: model blob bytes}`` — see :func:`read_spool_records`."""
+    return {lid: blob
+            for lid, (_, blob) in read_spool_records(spool_dir).items()}
 
 
 class SliceAggregator:
@@ -123,22 +143,33 @@ class SliceAggregator:
         # learner_id -> (round, fold-ready model tree) — latest wins,
         # the required_lineage == 1 store semantics
         self._models: Dict[str, tuple] = {}
+        # masked partial-fold plane (secure/distributed.py): held masked
+        # models (learner_id -> (round, opaque dict)) and the stream-mode
+        # fold-on-arrival accumulators, one per round id
+        self._masked: Dict[str, tuple] = {}
+        self._stream_accs: Dict[int, MaskedAccumulator] = {}
         if spool_dir:
             # the durability contract both ways: a RELAUNCHED aggregator
             # reloads its spool, so acked uplinks survive the process —
             # not just for the controller's re-home path but for the
             # driver's supervised relaunch too (a learner that skips the
             # next round keeps its lineage, exactly like the store path)
-            for lid, blob in read_spool(spool_dir).items():
+            for lid, (rid, blob) in read_spool_records(spool_dir).items():
                 try:
-                    self._models[lid] = (
-                        0, dict(ModelBlob.from_bytes(blob).tensors))
+                    decoded = ModelBlob.from_bytes(blob)
+                    if decoded.opaque:
+                        # masked uplinks reload as HELD models even when
+                        # the live path streams: the fold-time held scan
+                        # picks up exactly the round-matched survivors
+                        self._masked[lid] = (rid, dict(decoded.opaque))
+                    else:
+                        self._models[lid] = (rid, dict(decoded.tensors))
                 except ValueError:  # pragma: no cover - checked on read
                     continue
-            if self._models:
+            if self._models or self._masked:
                 logger.info("slice %s reloaded %d spooled model(s)",
-                            name, len(self._models))
-                _M_HELD.set(len(self._models))
+                            name, len(self._models) + len(self._masked))
+                _M_HELD.set(len(self._models) + len(self._masked))
         # per-client stats sharded down from the controller: the slice
         # owns its learners' uplink accounting and ships O(1) mergeable
         # sketches to the root (PR 9's rollup format) instead of the
@@ -148,11 +179,18 @@ class SliceAggregator:
         self._uplinks = 0
 
     # -- uplink path (RPC threads) ----------------------------------------
-    def submit(self, learner_id: str, round_id: int, blob: bytes) -> int:
+    def submit(self, learner_id: str, round_id: int, blob: bytes,
+               stream: bool = False) -> int:
         """Accept one uplink: spool first (atomic — an acked uplink
         survives this process), then hold the decoded tree fold-ready.
-        Returns the held-model count."""
-        model = dict(ModelBlob.from_bytes(blob).tensors)
+        Masked (opaque) payloads hold as uint64 blobs instead — or, with
+        ``stream``, fold straight into the round's modular accumulator
+        (O(1) resident models; sound because a re-shipped masked payload
+        is byte-identical, so duplicate ids simply skip). Returns the
+        held-model count."""
+        decoded = ModelBlob.from_bytes(blob)
+        masked = bool(decoded.opaque)
+        model = dict(decoded.opaque) if masked else dict(decoded.tensors)
         if not model:
             raise ValueError("uplink carries no tensors")
         if self.spool_dir:
@@ -163,13 +201,26 @@ class SliceAggregator:
             record = dumps({"learner_id": learner_id,
                             "round": int(round_id), "model": blob})
             _durable.atomic_write(path, record, prefix=".up_")
+        rid = int(round_id)
         with self._lock:
-            self._models[learner_id] = (int(round_id), model)
-            held = len(self._models)
+            if masked and stream:
+                acc = self._stream_accs.get(rid)
+                if acc is None:
+                    acc = self._stream_accs[rid] = MaskedAccumulator()
+                    while len(self._stream_accs) > _STREAM_ROUNDS_KEPT:
+                        self._stream_accs.pop(min(self._stream_accs))
+                acc.fold(learner_id, model)
+            elif masked:
+                self._masked[learner_id] = (rid, model)
+            else:
+                self._models[learner_id] = (rid, model)
+            held = len(self._models) + len(self._masked)
             self._uplinks += 1
             self._bytes_digest.add(float(len(blob)))
             self._top_bytes.update(learner_id, float(len(blob)))
         _M_UPLINKS.inc()
+        if masked:
+            _M_MASKED_UPLINKS.inc()
         _M_HELD.set(held)
         return held
 
@@ -181,8 +232,13 @@ class SliceAggregator:
             for lid in learner_ids:
                 if self._models.pop(lid, None) is not None:
                     dropped += 1
+                if self._masked.pop(lid, None) is not None:
+                    dropped += 1
+                # a stream-folded contribution stays in its round's sum
+                # (modular folds are not reversible without the payload);
+                # masks still cancel and settlement counts the contributor
                 self._top_bytes.drop(lid)
-            held = len(self._models)
+            held = len(self._models) + len(self._masked)
         _M_HELD.set(held)
         if self.spool_dir:
             for lid in learner_ids:
@@ -233,6 +289,48 @@ class SliceAggregator:
             ).to_bytes()
         return reply
 
+    def fold_masked(self, ids, round_id: int,
+                    stream: bool = False) -> Dict[str, Any]:
+        """Masked partial fold (secure/distributed.py): per-tensor uint64
+        sums mod 2^64 over this slice's contributors — no scales, no
+        keys, no new crypto; masks cancel at the root by construction.
+        Starts from the round's stream accumulator (fold-on-arrival mode)
+        and adds any HELD round-matched masked models for the requested
+        ids the stream has not seen (the relaunch-reload path). The
+        reply's ``present`` list is the ground truth the root's mask
+        settlement reconciles against the dispatched cohort."""
+        rid = int(round_id)
+        t0 = time.perf_counter()
+        out = MaskedAccumulator()
+        with self._lock:
+            if stream:
+                acc = self._stream_accs.get(rid)
+                if acc is not None:
+                    sums, specs, contributors = acc.snapshot()
+                    out.merge_sums(sums, contributors, specs)
+            for lid in ids:
+                held = self._masked.get(lid)
+                if held is None or held[0] != rid:
+                    continue
+                out.fold(lid, held[1])
+        sums, specs, present = out.snapshot()
+        duration_ms = (time.perf_counter() - t0) * 1e3
+        _M_MASKED_FOLDS.inc(tier="slice")
+        reply: Dict[str, Any] = {
+            "ok": True,
+            "masked": True,
+            "count": out.count,
+            "duration_ms": round(duration_ms, 3),
+            "present": present,
+            "acc": b"",
+            "stats": self.stats(),
+        }
+        if sums:
+            reply["acc"] = ModelBlob(opaque={
+                name: (sums[name].tobytes(), specs[name])
+                for name in sorted(sums)}).to_bytes()
+        return reply
+
     def stats(self) -> Dict[str, Any]:
         """The slice's per-client rollup as mergeable sketches (PR 9's
         slice→root format): uplink-bytes quantile digest + top offenders
@@ -240,7 +338,7 @@ class SliceAggregator:
         with self._lock:
             return {
                 "name": self.name,
-                "held": len(self._models),
+                "held": len(self._models) + len(self._masked),
                 "uplinks": self._uplinks,
                 "bytes_digest": self._bytes_digest.to_dict(),
                 "top_bytes": self._top_bytes.to_dict(),
@@ -280,13 +378,19 @@ class SliceServer:
         req = loads(raw)
         held = self.aggregator.submit(str(req["learner_id"]),
                                       int(req.get("round", 0)),
-                                      req["model"])
+                                      req["model"],
+                                      stream=bool(req.get("stream", False)))
         return dumps({"ok": True, "held": held})
 
     def _fold(self, raw: bytes) -> bytes:
         req = loads(raw)
+        ids = [str(lid) for lid in req.get("ids", [])]
+        if bool(req.get("masked", False)):
+            return dumps(self.aggregator.fold_masked(
+                ids, int(req.get("round", 0)),
+                stream=bool(req.get("stream", False))))
         return dumps(self.aggregator.fold(
-            [str(lid) for lid in req.get("ids", [])],
+            ids,
             {str(k): float(v) for k, v in (req.get("scales") or {}).items()},
             stride=int(req.get("stride", 0))))
 
@@ -345,11 +449,12 @@ class SliceClient:
         self._client = RpcClient(host, port, SLICE_SERVICE, retries=0,
                                  ssl=ssl, **kwargs)
 
-    def submit(self, learner_id: str, round_id: int, blob: bytes) -> dict:
+    def submit(self, learner_id: str, round_id: int, blob: bytes,
+               stream: bool = False) -> dict:
         return loads(self._client.call(
             "SubmitUplink",
             dumps({"learner_id": learner_id, "round": int(round_id),
-                   "model": blob}),
+                   "model": blob, "stream": bool(stream)}),
             timeout=self.timeout_s, wait_ready=False))
 
     def fold(self, ids, scales, stride: int = 0,
@@ -358,6 +463,15 @@ class SliceClient:
             "FoldPartial",
             dumps({"ids": list(ids), "scales": dict(scales),
                    "stride": int(stride)}),
+            timeout=timeout or max(self.timeout_s, 120.0),
+            wait_ready=False))
+
+    def fold_masked(self, ids, round_id: int, stream: bool = False,
+                    timeout: Optional[float] = None) -> dict:
+        return loads(self._client.call(
+            "FoldPartial",
+            dumps({"ids": list(ids), "masked": True,
+                   "round": int(round_id), "stream": bool(stream)}),
             timeout=timeout or max(self.timeout_s, 120.0),
             wait_ready=False))
 

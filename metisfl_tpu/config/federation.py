@@ -184,6 +184,12 @@ class SecureAggConfig:
     # partial sum of fewer surviving parties than this — at 1 the "sum"
     # would be a single learner's plaintext update
     min_recovery_parties: int = 2
+    # masking at distributed scale (secure/distributed.py): 0 = every
+    # pair masks against every other (the classic O(n·model) Bonawitz
+    # construction); k > 0 = the deterministic ring k-regular mask graph
+    # (Bell et al.) — O(k·model) mask generation per learner, dropout
+    # recovery refuses splits that would isolate any survivor
+    mask_neighbors: int = 0
 
 
 @dataclass
@@ -678,8 +684,11 @@ class FederationConfig:
             # MaskingBackend.weighted_sum rejects non-uniform scales at
             # aggregation time; fail at startup instead of stalling round 1.
             raise ValueError(
-                "masking secure aggregation requires the 'participants' "
-                "scaler (pairwise masks only cancel under uniform scales)")
+                "masking secure aggregation requires uniform scales: set "
+                "aggregation.scaler: participants — that configuration "
+                "composes with aggregation.streaming, "
+                "aggregation.tree.distributed, and quorum dropout "
+                f"recovery (got scaler={self.aggregation.scaler!r})")
         if (self.secure.enabled and self.secure.scheme == "masking"
                 and self.protocol.startswith("asynchronous")):
             # Pairwise masks only cancel when ALL parties' payloads enter one
@@ -687,9 +696,11 @@ class FederationConfig:
             # buffer is a partial cohort too). Async secure federations
             # need a partial-cohort-capable scheme (ckks).
             raise ValueError(
-                "masking secure aggregation requires a synchronous or "
-                "semi-synchronous protocol; use scheme='ckks' for "
-                "asynchronous secure federations")
+                "masking secure aggregation requires protocol: synchronous "
+                "or semi_synchronous (pairwise masks only cancel across "
+                "one round barrier; semi_synchronous masking still "
+                "tolerates dropouts via seed-share recovery). For a truly "
+                "asynchronous secure federation use scheme: ckks")
         if self.protocol not in ("synchronous", "semi_synchronous",
                                  "asynchronous", "asynchronous_buffered"):
             raise ValueError(f"unknown protocol {self.protocol!r}")
@@ -772,13 +783,21 @@ class FederationConfig:
                 "controller.standby.wal_dir requires "
                 "controller.standby.enabled (the WAL exists to keep a "
                 "standby promote-ready)")
-        if self.registry.enabled and self.secure.enabled:
-            # registered blobs are opaque ciphertext under secure agg: the
+        if self.registry.enabled and self.secure.enabled and (
+                self.secure.scheme != "masking"):
+            # under ckks the registered blobs are opaque ciphertext: the
             # gateway could never decode them and eval-gated promotion
-            # would compare metrics of models nobody can serve
+            # would compare metrics of models nobody can serve. Masking
+            # is different by construction — the masks cancel at
+            # settlement (secure/recovery.py), so the registered
+            # community is the protocol's PUBLIC plain output and
+            # round-pinned versioning composes with it
             raise ValueError(
-                "registry is incompatible with secure aggregation "
-                "(registered community blobs would be ciphertext)")
+                "registry requires a decodable community model: secure "
+                f"scheme {self.secure.scheme!r} registers opaque "
+                "ciphertext — use scheme: masking, whose settled output "
+                "is the public plain aggregate and composes with the "
+                "registry")
         if self.registry.enabled and self.registry.retention < 1:
             raise ValueError("registry.retention must be >= 1")
         if self.registry.enabled:
@@ -960,30 +979,45 @@ class FederationConfig:
                 raise ValueError(
                     "aggregation.tree.distributed requires "
                     "aggregation.tree.enabled")
-            if self.secure.enabled:
+            # capability matrix (docs/SECURITY.md "Secure aggregation at
+            # scale"): masking COMPOSES with the distributed tier —
+            # masked payloads are modular uint64 sums, so slices fold
+            # them as plain blobs and the masks cancel at the root by
+            # construction (secure/distributed.py). HE ciphertexts do
+            # not: CKKS addition needs the evaluation context the slices
+            # deliberately never hold.
+            if self.secure.enabled and self.secure.scheme != "masking":
                 raise ValueError(
-                    "aggregation.tree.distributed is incompatible with "
-                    "secure aggregation (slice aggregators fold plaintext "
-                    "trees; masked/HE payloads need the one-combine path)")
-            if self.aggregation.streaming:
+                    "aggregation.tree.distributed with secure aggregation "
+                    "requires secure.scheme: masking (masked partial sums "
+                    "fold key-free at the slices; "
+                    f"scheme={self.secure.scheme!r} payloads need the "
+                    "one-combine path)")
+            if self.aggregation.streaming and not (
+                    self.secure.enabled
+                    and self.secure.scheme == "masking"):
                 raise ValueError(
-                    "aggregation.tree.distributed is incompatible with "
-                    "aggregation.streaming (uplinks fold at their slice "
-                    "aggregator, not in the controller's stream)")
+                    "aggregation.tree.distributed with "
+                    "aggregation.streaming requires masking secure "
+                    "aggregation (slices fold masked uplinks on arrival; "
+                    "plaintext uplinks fold at their slice aggregator, "
+                    "not in the controller's stream)")
             if self.model_store.ingest_workers > 0:
                 raise ValueError(
                     "aggregation.tree.distributed is incompatible with "
                     "model_store.ingest_workers (uplinks bypass the root "
-                    "store entirely; there is nothing to ingest)")
+                    "store entirely; there is nothing to ingest — this "
+                    "holds for every secure scheme and for plaintext)")
             if self.aggregation.rule.lower() not in ("fedavg", "scaffold",
-                                                     "fedstride"):
+                                                     "fedstride",
+                                                     "secure_agg"):
                 # same silently-ignored-knob posture as the checks above:
                 # a rule that cannot slice-fold would boot (and pay for)
                 # a whole aggregator fleet that never receives a byte
                 raise ValueError(
                     f"aggregation.tree.distributed requires a weighted-"
-                    f"sum rule (fedavg/scaffold/fedstride), not "
-                    f"{self.aggregation.rule!r}")
+                    f"sum rule (fedavg/scaffold/fedstride) or masked "
+                    f"secure_agg, not {self.aggregation.rule!r}")
             if tree.rehome_retries < 0:
                 raise ValueError(
                     "aggregation.tree.rehome_retries must be >= 0")
@@ -991,14 +1025,19 @@ class FederationConfig:
                 raise ValueError(
                     "aggregation.tree.rehome_backoff_s must be > 0 when "
                     "rehome_retries is armed")
-        if self.aggregation.streaming and self.secure.enabled:
-            # streaming folds plaintext trees on arrival; secure payloads
-            # are opaque ciphertext that only the full-cohort combine can
-            # handle — fail loudly instead of silently falling back, the
-            # operator asked for a path this federation cannot take
+        if (self.aggregation.streaming and self.secure.enabled
+                and self.secure.scheme != "masking"):
+            # streaming folds payloads on arrival; masked payloads are
+            # modular uint64 sums so fold-on-arrival is exact
+            # (secure/distributed.py MaskedStreamingAggregator), but HE
+            # ciphertexts need the keyed full-cohort combine — fail
+            # loudly instead of silently falling back, the operator
+            # asked for a path this federation cannot take
             raise ValueError(
-                "aggregation.streaming is incompatible with secure "
-                "aggregation (opaque payloads cannot fold on arrival)")
+                "aggregation.streaming with secure aggregation requires "
+                "secure.scheme: masking (masked payloads fold on arrival "
+                f"as modular sums; scheme={self.secure.scheme!r} "
+                "ciphertexts cannot)")
         if self.train.dp_noise_multiplier < 0.0 or self.train.dp_clip_norm < 0.0:
             # a sign typo must not silently disable the mechanism
             raise ValueError("dp_clip_norm and dp_noise_multiplier must be "
@@ -1042,7 +1081,14 @@ class FederationConfig:
             # failure limit halts the federation
             raise ValueError(
                 "staleness_decay is incompatible with masking secure "
-                "aggregation (masks only cancel under uniform scales)")
+                "aggregation (masks only cancel under uniform scales). "
+                "Deadline stragglers compose with masking the other way: "
+                "leave staleness_decay at 0 and let the mask settlement "
+                "recover expired learners via seed-share disclosure "
+                "(secure.min_recovery_parties)")
+        if self.secure.mask_neighbors < 0:
+            raise ValueError("secure.mask_neighbors must be >= 0 (0 = "
+                             "complete pairwise mask graph)")
         if (self.train.dp_noise_multiplier > 0.0
                 and self.train.dp_clip_norm <= 0.0):
             # the noise std is noise_multiplier * clip_norm — without a

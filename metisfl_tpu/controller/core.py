@@ -125,6 +125,17 @@ _M_WAL_RECORDS = _REG.counter(
     _tel.M_CONTROLLER_WAL_RECORDS_TOTAL,
     "Hot-standby round-state WAL records appended, by kind "
     "(snapshot/join/leave; controller/wal.py)", ("kind",))
+# masked partial-fold plane (secure/distributed.py + secure/recovery.py)
+_M_SECURE_SETTLEMENT = _REG.histogram(
+    _tel.M_SECURE_SETTLEMENT_SECONDS,
+    "Mask settlement duration: contributor reconciliation through "
+    "residual disclosure and fixed-point decode")
+_M_SECURE_RECOVERED = _REG.counter(
+    _tel.M_SECURE_RECOVERED_PARTIES_TOTAL,
+    "Dropped mask parties recovered via seed-share disclosure")
+_M_SECURE_FOLDS = _REG.counter(
+    _tel.M_SECURE_MASKED_FOLDS_TOTAL,
+    "Masked partial folds performed, by tier", ("tier",))
 
 # EWMA smoothing for per-learner train/eval durations (straggler
 # analytics): ~the last 3-4 rounds dominate, so a recovered learner's
@@ -404,20 +415,42 @@ class Controller:
         # attribute check; with it armed the in-process tree above stays
         # constructed as the fully-degraded fallback.
         self._slices = None
+        masked_tier = (config.secure.enabled
+                       and config.secure.scheme == "masking")
         if (tree_cfg is not None and getattr(tree_cfg, "distributed", False)
                 and getattr(tree_cfg, "slices", None)):
             if (self._aggregator.name in ("fedavg", "scaffold", "fedstride")
-                    and not config.secure.enabled):
+                    and not config.secure.enabled) or masked_tier:
                 from metisfl_tpu.aggregation.distributed import (
                     DistributedSliceReducer,
                 )
+                # masked mode (secure/distributed.py): slices fold raw
+                # masked blobs as modular uint64 sums — key-free, masks
+                # cancel at the root settlement; with streaming they
+                # additionally fold on arrival
                 self._slices = DistributedSliceReducer(
-                    tree_cfg, ssl=config.ssl, comm=config.comm)
+                    tree_cfg, ssl=config.ssl, comm=config.comm,
+                    masked=masked_tier,
+                    stream=masked_tier and bool(getattr(agg, "streaming",
+                                                        False)))
             else:
                 logger.info(
                     "aggregation.tree.distributed requested but rule=%s "
                     "cannot slice-fold; using the in-process path",
                     self._aggregator.name)
+        # (e) masked streaming (secure/distributed.py): under scheme:
+        # masking with aggregation.streaming and NO slice tier, the
+        # controller folds masked uplinks on arrival itself — modular
+        # sums are exact and order-free, so the stream accumulates the
+        # bits the store path's one-combine would. With slices armed the
+        # fold-on-arrival happens slice-side instead (submit streams).
+        self._masked_stream = None
+        if (masked_tier and getattr(agg, "streaming", False)
+                and self._slices is None):
+            from metisfl_tpu.secure.distributed import (
+                MaskedStreamingAggregator,
+            )
+            self._masked_stream = MaskedStreamingAggregator()
 
         # community model state
         self._community_flat: Optional[Dict[str, np.ndarray]] = None
@@ -1114,7 +1147,29 @@ class Controller:
                     f"malformed result from {result.learner_id}: {exc}")
             model = None
         deferred_meta = False
-        if model is not None and self._streaming is not None:
+        if model is not None and self._masked_stream is not None:
+            # masked streaming (secure/distributed.py): the raw masked
+            # blob folds on arrival as a modular uint64 sum. Stale
+            # uplinks carry dead masks (streams are round-keyed) and
+            # must NEVER enter a live sum — drop them like the plain
+            # streaming path drops round-scoped stragglers.
+            folded = False
+            if not stale and isinstance(model, (bytes, bytearray)):
+                try:
+                    opaque = dict(ModelBlob.from_bytes(model).opaque)
+                    folded = bool(opaque) and self._masked_stream.fold(
+                        result.learner_id, opaque, result.round_id)
+                except ValueError as exc:
+                    logger.warning("undecodable masked uplink from %s: %s",
+                                   result.learner_id, exc)
+            if folded:
+                _M_SECURE_FOLDS.inc(tier="stream")
+            else:
+                logger.info("masked uplink from %s dropped (stale or "
+                            "malformed; masks are round-keyed)",
+                            result.learner_id)
+            model = None if not folded else model
+        elif model is not None and self._streaming is not None:
             # streaming aggregation (docs/SCALE.md): the accepted uplink
             # folds straight into the community accumulator — the store
             # round-trip is skipped entirely. A dropped fold (stale on a
@@ -1231,6 +1286,8 @@ class Controller:
             self._scheduler.reset()
             if self._streaming is not None:
                 self._streaming.abandon()
+            if self._masked_stream is not None:
+                self._masked_stream.abandon()
             self._dispatch_train(self._sample_cohort())
             return
         if stale:
@@ -1275,6 +1332,8 @@ class Controller:
             self._scheduler.reset()
             if self._streaming is not None:
                 self._streaming.abandon()
+            if self._masked_stream is not None:
+                self._masked_stream.abandon()
             self._dispatch_train(self._sample_cohort())
 
     def _expire_tasks_locked(self, pending: Dict[str, str]) -> None:
@@ -1436,6 +1495,8 @@ class Controller:
             _M_REDISPATCH.inc()
             if self._streaming is not None:
                 self._streaming.abandon()
+            if self._masked_stream is not None:
+                self._masked_stream.abandon()
             self._dispatch_train(self._sample_cohort())
 
     def _ingest_landed(self, result: TaskResult, ms: float) -> None:
@@ -1594,6 +1655,8 @@ class Controller:
                 # drop round-scoped fold state so the retry starts clean
                 # (fedrec's cross-round rolling state survives)
                 self._streaming.abandon()
+            if self._masked_stream is not None:
+                self._masked_stream.abandon()
             with self._lock:
                 self._current_meta.errors.append(f"aggregation failed: {exc!r}")
             if self._agg_failures >= self._MAX_AGG_FAILURES:
@@ -1902,7 +1965,45 @@ class Controller:
                 end_block(sp, block)
             return pairs, present_ids
 
-        if self.config.secure.enabled:
+        if self.config.secure.enabled and (
+                self._masked_stream is not None
+                or (self._slices is not None
+                    and getattr(self._slices, "masked", False))):
+            # Masked partial-fold plane (secure/distributed.py): the
+            # round's per-tensor uint64 sums were accumulated where the
+            # uplinks landed (controller stream or slice processes);
+            # barrier release reconciles contributors against the
+            # dispatched cohort and settles the masks
+            # (secure/recovery.py) — dropouts recovered via seed-share
+            # disclosure, never silently folded in.
+            if self._masked_stream is not None:
+                folded = self._masked_stream.stats()["folded"]
+                sp = block_span(range(folded))
+                with sp:
+                    snap = self._masked_stream.finish(selected)
+                end_block(sp, range(folded))
+            else:
+                slice_sp = _ttrace.span(
+                    "round.slice_reduce", parent=agg_sp,
+                    attrs={"cohort": len(ids), "masked": True})
+                with slice_sp, slice_sp.activate():
+                    reduced = self._slices.reduce_masked(
+                        ids, self.global_iteration)
+                _M_SECURE_FOLDS.inc(tier="root")
+                if reduced is None:
+                    snap = None
+                else:
+                    m_sums, m_specs, m_present, slice_errors = reduced
+                    snap = (m_sums, m_specs, m_present)
+                    if slice_errors:
+                        with self._lock:
+                            self._current_meta.errors.extend(slice_errors)
+            if snap is None:
+                logger.warning("no masked contributions for cohort %s",
+                               list(selected))
+                return
+            community = self._settle_masked(*snap)
+        elif self.config.secure.enabled:
             # Secure: masking sums must cancel across ALL parties.
             pairs, present_ids = collect_all_pairs()
             if not pairs:
@@ -2088,6 +2189,94 @@ class Controller:
                         sizes[key] += q[key]
                 meta.model_size = sizes
 
+    def _settle_masked(self, sums, specs, contributors):
+        """Settle one round's masked partial-fold sums (secure/recovery.py)
+        into the opaque community payload: reconcile the contributor set
+        against the registered mask parties, recover dropouts via
+        seed-share disclosure, unmask, and re-wrap under the SecureAgg
+        output contract (float64 payloads, CIPHERTEXT-kind specs).
+        Raises when the cohort cannot settle so the aggregation-failure
+        retry re-runs the round clean."""
+        from metisfl_tpu.secure import recovery as _recovery
+        from metisfl_tpu.tensor.spec import TensorKind, TensorSpec
+
+        cfg = self.config.secure
+        with self._lock:
+            idx_of = {lid: self._learners[lid].party_index
+                      for lid in contributors if lid in self._learners}
+            registered = {r.party_index for r in self._learners.values()
+                          if r.party_index >= 0}
+        missing = [lid for lid in contributors if lid not in idx_of]
+        if missing:
+            raise RuntimeError(
+                f"masked contributors {missing} have no registration "
+                "record; their party indices are unknown and the sum "
+                "cannot settle")
+        n = cfg.num_parties or (max(registered) + 1 if registered else 0)
+        if n <= 0:
+            raise RuntimeError(
+                "mask settlement needs the registered party count "
+                "(secure.num_parties, driver-filled) or joined "
+                "capabilities['party_index'] values")
+        round_id = self.global_iteration
+
+        def recover_fn(rid, surviving, dropped, lengths):
+            return self._request_mask_recovery(
+                rid, surviving, dropped, lengths, list(contributors))
+
+        payloads, report = _recovery.settle(
+            sums, idx_of, n, max(2, cfg.min_recovery_parties),
+            round_id, recover_fn)
+        _M_SECURE_SETTLEMENT.observe(report.duration_ms / 1e3)
+        if report.recovered:
+            _M_SECURE_RECOVERED.inc(len(report.dropped))
+        _tevents.emit(
+            _tevents.SecureSettlement, round=round_id,
+            contributors=len(report.contributors),
+            dropped=len(report.dropped), recovered=report.recovered,
+            tier="stream" if self._masked_stream is not None else "slice",
+            duration_ms=round(report.duration_ms, 3))
+        community = {}
+        for name, payload in payloads.items():
+            spec = specs[name]
+            community[name] = (payload, TensorSpec(
+                tuple(spec.shape), spec.dtype, TensorKind.CIPHERTEXT))
+        return community
+
+    def _request_mask_recovery(self, round_id, surviving, dropped,
+                               lengths, candidates):
+        """Walk the surviving learners' proxies for ONE residual
+        disclosure (MaskingBackend.recovery_correction — the learner
+        side enforces the privacy thresholds). Returns the per-tensor
+        correction list, None when the transport cannot recover
+        (full-cohort semantics apply downstream), and raises when every
+        survivor refused or errored."""
+        last_error = None
+        for lid in candidates:
+            record = self._learners.get(lid)
+            if record is None or record.proxy is None:
+                continue
+            if not hasattr(record.proxy, "recover_masks"):
+                return None  # transport cannot recover
+            try:
+                corrections = record.proxy.recover_masks(
+                    int(round_id), list(surviving), list(dropped),
+                    list(lengths))
+            except Exception as exc:  # noqa: BLE001 - try the next one
+                last_error = exc
+                continue
+            logger.warning(
+                "masking dropout recovery: %s computed residuals for "
+                "dropped parties %s (surviving %d)", lid, list(dropped),
+                len(surviving))
+            _tevents.emit(_tevents.SecureMasksRecovered,
+                          round=int(round_id), survivor=lid,
+                          surviving=len(surviving), dropped=len(dropped))
+            return corrections
+        raise RuntimeError(
+            f"masking dropout recovery failed on every survivor: "
+            f"{last_error!r}")
+
     def _masking_dropout_correction(self, present_ids, parsed):
         """Masking dropout recovery: when the aggregating cohort is missing
         registered mask parties (deadline stragglers, crashes), ask ONE
@@ -2121,26 +2310,11 @@ class Controller:
         names = list(first_model)
         lengths = [int(first_model[name][1].size) for name in names]
         round_id = self.global_iteration
-        last_error = None
-        for lid in present_ids:
-            record = self._learners.get(lid)
-            if record is None or record.proxy is None:
-                continue
-            if not hasattr(record.proxy, "recover_masks"):
-                return None  # transport cannot recover: full-cohort semantics
-            try:
-                corrections = record.proxy.recover_masks(
-                    round_id, surviving, dropped, lengths)
-                logger.warning(
-                    "masking dropout recovery: %s computed residuals for "
-                    "dropped parties %s (surviving %d/%d)", lid, dropped,
-                    len(surviving), n)
-                return dict(zip(names, corrections))
-            except Exception as exc:  # noqa: BLE001 - try the next survivor
-                last_error = exc
-        raise RuntimeError(
-            f"masking dropout recovery failed on every survivor: "
-            f"{last_error!r}")
+        corrections = self._request_mask_recovery(
+            round_id, surviving, dropped, lengths, list(present_ids))
+        if corrections is None:
+            return None  # transport cannot recover: full-cohort semantics
+        return dict(zip(names, corrections))
 
     def _parse_secure(self, pairs):
         parsed = []
@@ -2268,6 +2442,11 @@ class Controller:
                 # replacement single-learner dispatches keep the round's
                 # map — their uplinks route by it (unknowns go to root).
                 self._slices.assign(list(learner_ids))
+            if self._masked_stream is not None:
+                # rotate the masked fold-on-arrival accumulator for the
+                # fresh round (mask streams are round-keyed; a stale
+                # fold into the new accumulator would never cancel)
+                self._masked_stream.begin_round(self.global_iteration)
         # The dispatched set is the synchronous round barrier (participation
         # sampling means it can be a strict subset of the active learners).
         self._scheduler.notify_dispatched(list(learner_ids))
@@ -3196,6 +3375,8 @@ class Controller:
             snapshot["slices"] = self._slices.describe()
         if self._streaming is not None:
             snapshot["streaming"] = self._streaming.stats()
+        if self._masked_stream is not None:
+            snapshot["secure_stream"] = self._masked_stream.stats()
         if self._health is not None:
             # latest round's convergence snapshot ({} before round 1)
             snapshot["health"] = self._health.snapshot()

@@ -696,7 +696,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "itself, every round completes, and the "
                              "community model is bit-identical to the "
                              "same-seed undisturbed control run")
+    parser.add_argument("--secure-smoke", action="store_true",
+                        help="run the secure-aggregation chaos gate "
+                             "instead: real-gRPC federation with "
+                             "distributed slices under scheme=masking, "
+                             "one learner SIGKILLed with its masked "
+                             "uplink in the air; FAIL unless every round "
+                             "completes via dropout settlement, the "
+                             "community matches the same-seed plain "
+                             "control within the fixed-point tolerance, "
+                             "and the control emits zero secure events")
     args = parser.parse_args(argv)
+
+    if args.secure_smoke:
+        from metisfl_tpu.driver.secure_smoke import run_secure_smoke
+        out = run_secure_smoke(rounds=min(args.rounds, 2), seed=args.seed,
+                               timeout_s=args.timeout)
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
 
     if args.controller_smoke:
         from metisfl_tpu.driver.ha_smoke import run_ha_smoke

@@ -1,0 +1,240 @@
+"""Secure-aggregation chaos gate (`scripts/chaos_smoke.sh`).
+
+A real-gRPC federation under ``scheme: masking`` composed with the
+distributed slice tier AND streaming fold-on-arrival — subprocess
+controller, two slice-aggregator subprocesses, three subprocess
+learners — where the seeded chaos injector SIGKILLs ``learner_0`` on
+its second ``MarkTaskCompleted`` (client side: the round-2 masked
+uplink dies in the air, never reaching a slice). The gate passes iff:
+
+- every round completes without operator action: round 2's deadline
+  expires the corpse, the surviving masked partials keep folding
+  through the slice tier, and the root settles the cohort via one
+  survivor's seed-share disclosure (``secure_settlement`` fired every
+  round and ``secure_masks_recovered`` fired for the dropout);
+- masks cancel: each *round-pinned* registry version of the masked run
+  decodes to the same-seed PLAIN control run's community model within
+  the pinned fixed-point tolerance (encode quantizes each parameter to
+  a 2^-40 grid, so legitimate drift is ~1e-12 per round while a
+  mask-cancellation failure is ~12 orders of magnitude larger — the
+  1e-3 bound separates the two regimes with room for training
+  amplification of the round-1 quantization); and
+- the plain control — same topology, same seed, same SIGKILL — emits
+  **zero** ``secure_*`` events end to end.
+
+Round pinning mirrors ha_smoke: the federation keeps aggregating
+between termination detection and shutdown, so the community *head* is
+a moving target while registry version ``k`` is exactly round ``k``'s
+aggregate in both runs.
+
+Run directly::
+
+    python -m metisfl_tpu.driver.crossdevice --secure-smoke
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger("metisfl_tpu.driver.secure_smoke")
+
+# the pinned mask-cancellation tolerance (docs/SECURITY.md "Fixed-point
+# tolerance"): fixed-point quantization is 2^-40 per parameter per
+# round; a residual mask is O(2^24) after decode. 1e-3 sits between the
+# two regimes with ~9 orders of magnitude of margin each way.
+MASK_CANCEL_TOLERANCE = 1e-3
+
+
+def _secure_events(workdir: str) -> Dict[str, int]:
+    """Count ``secure_*`` events by kind across every telemetry journal
+    under ``workdir`` (controller + slices + learners each write their
+    own JSONL; settlement events come from the controller process)."""
+    counts: Dict[str, int] = {}
+    pattern = os.path.join(workdir, "telemetry", "*-events.jsonl")
+    for path in glob.glob(pattern):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    kind = str(rec.get("kind", ""))
+                    if kind.startswith("secure_"):
+                        counts[kind] = counts.get(kind, 0) + 1
+        except OSError:
+            continue
+    return counts
+
+
+def _decode_community(raw: bytes) -> Dict[str, np.ndarray]:
+    """Flatten a community blob to ``name -> float64 vector`` whether it
+    is plaintext (control run) or the masking plane's opaque float64
+    payloads (SecureAgg output contract, secure/distributed.py
+    ``unmask``)."""
+    from metisfl_tpu.tensor.pytree import ModelBlob
+
+    blob = ModelBlob.from_bytes(raw)
+    out: Dict[str, np.ndarray] = {}
+    for name, arr in blob.tensors:
+        out[name] = np.asarray(arr, np.float64).ravel()
+    for name, (payload, _spec) in blob.opaque.items():
+        out[name] = np.frombuffer(bytes(payload), np.float64).copy()
+    return out
+
+
+def _run_one(workdir: str, seed: int, rounds: int, secure: bool,
+             timeout_s: float) -> Dict[str, Any]:
+    from metisfl_tpu.comm.messages import TrainParams
+    from metisfl_tpu.config import (AggregationConfig, ChaosConfig,
+                                    EvalConfig, FederationConfig,
+                                    RegistryConfig, SecureAggConfig,
+                                    TerminationConfig,
+                                    TreeAggregationConfig)
+    from metisfl_tpu.driver.session import DriverSession
+    from metisfl_tpu.models import ArrayDataset, FlaxModelOps
+    from metisfl_tpu.models.zoo import MLP
+
+    import socket as _socket
+
+    def _free_port() -> int:
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((4, 2)).astype(np.float32)
+
+    def make_recipe(idx: int):
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+
+        def recipe():
+            ops = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                               np.zeros((2, 4), np.float32), rng_seed=0)
+            return ops, ArrayDataset(x, y, seed=idx)
+
+        return recipe
+
+    recipes = [make_recipe(i) for i in range(3)]
+    template = FlaxModelOps(MLP(features=(8,), num_outputs=2),
+                            np.zeros((2, 4), np.float32),
+                            rng_seed=0).get_variables()
+    config = FederationConfig(
+        controller_port=_free_port(),
+        # the deadline is what expires the corpse: round 1 completes at
+        # the full barrier well under it, round 2 waits it out for the
+        # killed learner and then settles the survivors
+        round_deadline_secs=12.0,
+        aggregation=AggregationConfig(
+            rule="secure_agg" if secure else "fedavg",
+            scaler="participants",
+            # masked sums fold on arrival at the slices; the plain
+            # control keeps the store path (streaming composes with
+            # tree.distributed only under masking — the capability
+            # matrix this smoke exists to exercise)
+            streaming=secure,
+            tree=TreeAggregationConfig(enabled=True, branch=2,
+                                       distributed=True)),
+        secure=SecureAggConfig(enabled=secure, scheme="masking",
+                               min_recovery_parties=2),
+        train=TrainParams(batch_size=8, local_steps=2, learning_rate=0.1),
+        eval=EvalConfig(every_n_rounds=0),
+        # round-pinned comparison evidence, exactly like ha_smoke:
+        # version k is round k's aggregate in both runs
+        registry=RegistryConfig(enabled=True, retention=64),
+        termination=TerminationConfig(
+            federation_rounds=rounds,
+            execution_cutoff_mins=max(1.0, timeout_s / 60.0)),
+        # client-side kill on the SECOND completion: round 1's uplink
+        # lands (full-cohort baseline), round 2's dies in the air with
+        # the process — the dropout-settlement trigger
+        chaos=ChaosConfig(enabled=True, seed=seed, rules=[
+            {"process": "learner_0", "side": "client", "fault": "kill",
+             "method": "MarkTaskCompleted", "after_calls": 1,
+             "max_fires": 1}]),
+    )
+    session = DriverSession(config, template, recipes, workdir=workdir)
+    t0 = time.time()
+    models: Dict[int, Dict[str, np.ndarray]] = {}
+    missing = []
+    try:
+        session.initialize_federation()
+        stats = session.monitor_federation(poll_every_s=0.5,
+                                           eval_drain_timeout_s=0)
+        for version in range(1, rounds + 1):
+            raw = session._client.get_registered_model(version=version,
+                                                       timeout=30.0)
+            if not raw:
+                missing.append(version)
+                continue
+            models[version] = _decode_community(raw)
+        completed = int(stats.get("global_iteration", 0))
+    finally:
+        session.shutdown_federation()
+    events = _secure_events(workdir)
+    return {
+        "secure": secure,
+        "seed": seed,
+        "rounds_target": rounds,
+        "rounds_completed": completed,
+        "secure_events": events,
+        "missing_versions": missing,
+        "models": models,
+        "wall_s": round(time.time() - t0, 3),
+        "ok": completed >= rounds and not missing,
+    }
+
+
+def run_secure_smoke(rounds: int = 2, seed: int = 7,
+                     timeout_s: float = 180.0,
+                     workdir: Optional[str] = None) -> Dict[str, Any]:
+    """Masked kill run versus the same-seed plain kill control. Passes
+    iff both completed every round, the masked run settled every round
+    and recovered the SIGKILLed learner's masks, each round-pinned
+    community matches within :data:`MASK_CANCEL_TOLERANCE`, and the
+    control emitted zero ``secure_*`` events."""
+    root = workdir or tempfile.mkdtemp(prefix="metisfl_tpu_secure_")
+    masked = _run_one(os.path.join(root, "masked"), seed, rounds,
+                      secure=True, timeout_s=timeout_s)
+    control = _run_one(os.path.join(root, "control"), seed, rounds,
+                       secure=False, timeout_s=timeout_s)
+
+    diffs: Dict[str, float] = {}
+    for version in range(1, rounds + 1):
+        a = masked["models"].get(version)
+        b = control["models"].get(version)
+        if a is None or b is None or set(a) != set(b):
+            diffs[str(version)] = float("inf")
+            continue
+        diffs[str(version)] = max(
+            float(np.max(np.abs(a[name] - b[name]))) if a[name].size
+            else 0.0
+            for name in a)
+    masks_cancel = (len(diffs) == rounds
+                    and all(d <= MASK_CANCEL_TOLERANCE
+                            for d in diffs.values()))
+
+    m_events = masked["secure_events"]
+    ok = (masked["ok"] and control["ok"]
+          # settlement ran every round, and the dropout was recovered
+          # via seed-share disclosure (not silently full-cohorted)
+          and m_events.get("secure_settlement", 0) >= rounds
+          and m_events.get("secure_masks_recovered", 0) >= 1
+          # the plain control must be secure-silent end to end
+          and not control["secure_events"]
+          and masks_cancel)
+    # the decoded arrays are evidence, not output
+    masked.pop("models", None)
+    control.pop("models", None)
+    return {"masked": masked, "control": control,
+            "max_abs_diff": diffs, "tolerance": MASK_CANCEL_TOLERANCE,
+            "masks_cancel": masks_cancel, "workdir": root, "ok": ok}

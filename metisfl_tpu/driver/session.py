@@ -297,7 +297,8 @@ class DriverSession:
                 {"scheme": "masking", "kwargs": {
                     "federation_secret": secret, "party_index": idx,
                     "num_parties": cfg.num_parties,
-                    "min_parties": cfg.min_recovery_parties}}
+                    "min_parties": cfg.min_recovery_parties,
+                    "neighbors": cfg.mask_neighbors}}
                 for idx in range(len(self.learner_recipes))
             ]
         else:  # identity
@@ -1460,6 +1461,15 @@ class DriverSession:
                 # must never fail the run
                 skip = tuple(skip) + tuple(
                     f"slice_{i}" for i in range(len(tree.slices)))
+            if self.config.chaos.enabled:
+                # chaos-killed processes are expected casualties: a kill
+                # rule names its victim up front, and the resilience plane
+                # under test (dropout settlement, re-homing, failover)
+                # must absorb the death — the liveness check aborting on
+                # it would gate the wrong thing
+                skip = tuple(skip) + tuple(
+                    str(r["process"]) for r in self.config.chaos.rules
+                    if r.get("fault") == "kill" and r.get("process"))
             self._check_procs_alive(skip=skip)
             # poll the tail-bounded lineage RPCs — a long-running federation
             # must not ship its full history every 2 s (the unbounded

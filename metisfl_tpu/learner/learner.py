@@ -74,6 +74,10 @@ _M_REATTACH = _REG.counter(
     _tel.M_LEARNER_REATTACH_TOTAL,
     "Re-attach joins after a controller crash/restart was detected",
     ("reason",))
+_M_MASK_GEN = _REG.histogram(
+    _tel.M_SECURE_MASK_GEN_SECONDS,
+    "Secure-uplink encode time per train task: fixed-point encoding + "
+    "pairwise mask stream generation (secure/distributed.py)")
 
 
 class ControllerProxy(Protocol):
@@ -478,6 +482,7 @@ class Learner:
             self._drop_local(pytree_to_named_tensors(variables)))
         if self.secure_backend is not None:
             from metisfl_tpu.tensor.spec import TensorSpec, wire_dtype_of, TensorKind
+            t0 = time.perf_counter()
             opaque = {}
             for name, arr in named:
                 payload = self.secure_backend.encrypt(
@@ -485,6 +490,7 @@ class Learner:
                 spec = TensorSpec(arr.shape, wire_dtype_of(arr.dtype),
                                   TensorKind.CIPHERTEXT)
                 opaque[name] = (payload, spec)
+            _M_MASK_GEN.observe(time.perf_counter() - t0)
             return ModelBlob(opaque=opaque).to_bytes()
         if ship_dtype:
             from metisfl_tpu.tensor.quantize import SHIP_INT8Q, quantize_named

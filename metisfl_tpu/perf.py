@@ -438,7 +438,10 @@ _LOWER_BETTER = ("_ms", "ms_per", "_secs", "seconds", "_bytes", "_mb",
                  # runtime section: a growing steady-state recompile
                  # count is always a regression (the smoke gate pins the
                  # decode path's at zero absolutely)
-                 "recompile")
+                 "recompile",
+                 # secure section: the secure-vs-plain round-time
+                 # multiplier — masking overhead growing is a regression
+                 "multiplier")
 
 
 def metric_direction(key: str) -> int:

@@ -36,27 +36,38 @@ key from the controller, driver_session.py:129-140).
 
 from __future__ import annotations
 
-import hashlib
 from collections import OrderedDict
 from typing import Optional, Sequence
 
 import numpy as np
 
-_FP_BITS = 40
-_FP_SCALE = float(1 << _FP_BITS)
+from metisfl_tpu.secure.distributed import (
+    FP_BITS,
+    FP_SCALE,
+    mask_partners,
+    pair_stream,
+)
+
+_FP_BITS = FP_BITS
+_FP_SCALE = FP_SCALE
 
 
 class MaskingBackend:
     name = "masking"
 
     def __init__(self, federation_secret: str = "", party_index: int = 0,
-                 num_parties: int = 1, min_parties: int = 2):
+                 num_parties: int = 1, min_parties: int = 2,
+                 neighbors: int = 0):
         self.secret = federation_secret
         self.party_index = int(party_index)
         self.num_parties = int(num_parties)
         # the Bonawitz threshold t, enforced LEARNER-side: this party
         # refuses to help unmask a sum of fewer than min_parties payloads
         self.min_parties = max(2, int(min_parties))
+        # bounded mask graph (secure/distributed.py mask_partners): 0 =
+        # every pair (the classic construction); > 0 = the deterministic
+        # ring k-regular graph, O(neighbors · model) mask generation
+        self.neighbors = max(0, int(neighbors))
         self._round_id = 0
         self._tensor_counter = 0
         # rounds this party actually trained for (begin_round), newest
@@ -93,18 +104,18 @@ class MaskingBackend:
     def _pair_stream(self, i: int, j: int, tensor_idx: int, n: int,
                      round_id: int = None) -> np.ndarray:
         rid = self._round_id if round_id is None else int(round_id)
-        material = (f"metisfl-mask|{self.secret}|{min(i, j)}|{max(i, j)}|"
-                    f"{rid}|{tensor_idx}").encode()
-        # SHAKE-256 as XOF: one call yields the whole uniform uint64 stream
-        stream = hashlib.shake_256(material).digest(8 * n)
-        return np.frombuffer(stream, "<u8")
+        # the canonical chunked XOF derivation (secure/distributed.py):
+        # encrypt-time masking and dropout recovery share it bit-exactly
+        return pair_stream(self.secret, i, j, rid, tensor_idx, n)
+
+    def _partners(self) -> Sequence[int]:
+        return mask_partners(self.party_index, self.num_parties,
+                             self.neighbors)
 
     def _mask(self, n: int, tensor_idx: int) -> np.ndarray:
         mask = np.zeros(n, np.uint64)
         i = self.party_index
-        for j in range(self.num_parties):
-            if j == i:
-                continue
+        for j in self._partners():
             stream = self._pair_stream(i, j, tensor_idx, n)
             # modular uint64 arithmetic: adds and subtracts cancel exactly
             mask = mask + stream if j > i else mask - stream
@@ -189,12 +200,32 @@ class MaskingBackend:
             raise ValueError(
                 f"already served a different recovery split for round "
                 f"{rid}; refusing (partial-sum intersection attack)")
+        # (d) neighbor isolation (bounded mask graphs only): a survivor
+        # whose every mask partner is in the dropped set would have ALL
+        # its masks disclosed by this residual — its payload would sit in
+        # the sum effectively unmasked. Refuse the whole recovery.
+        survivors = set(surviving)
+        if self.neighbors > 0:
+            for s in survivors:
+                partners = set(mask_partners(int(s), self.num_parties,
+                                             self.neighbors))
+                if partners and not (partners & survivors):
+                    raise ValueError(
+                        f"refusing recovery: survivor {s} would keep no "
+                        "live mask partner (every neighbor dropped; its "
+                        "payload would be disclosed)")
         self._rounds_seen[rid] = key
         corrections = []
         for tensor_idx, n in enumerate(lengths):
             acc = np.zeros(int(n), np.uint64)
             for d in dropped:
+                # bounded graphs: party d only ever masked against its
+                # partners — the residual spans exactly those edges
+                partners = set(mask_partners(int(d), self.num_parties,
+                                             self.neighbors))
                 for i in surviving:
+                    if i not in partners:
+                        continue
                     stream = self._pair_stream(i, d, tensor_idx, int(n),
                                                round_id=round_id)
                     acc = acc + stream if d > i else acc - stream

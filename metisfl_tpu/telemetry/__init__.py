@@ -169,6 +169,12 @@ M_SLICE_UPLINKS_TOTAL = "slice_uplinks_total"
 M_SLICE_HELD_MODELS = "slice_held_models"
 M_SLICE_FAILURES_TOTAL = "slice_failures_total"
 M_SLICE_REHOMING_SECONDS = "slice_rehoming_seconds"
+# masked partial-fold plane (secure/distributed.py + recovery.py)
+M_SECURE_MASKED_UPLINKS_TOTAL = "secure_masked_uplinks_total"
+M_SECURE_MASKED_FOLDS_TOTAL = "secure_masked_folds_total"
+M_SECURE_SETTLEMENT_SECONDS = "secure_settlement_seconds"
+M_SECURE_RECOVERED_PARTIES_TOTAL = "secure_recovered_parties_total"
+M_SECURE_MASK_GEN_SECONDS = "secure_mask_gen_seconds"
 # serving gateway (serving/gateway.py)
 M_SERVING_REQUESTS_TOTAL = "serving_requests_total"
 M_SERVING_REQUEST_LATENCY_SECONDS = "serving_request_latency_seconds"

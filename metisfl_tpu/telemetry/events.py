@@ -394,6 +394,39 @@ class SliceRehomed:
     reason: str = ""
 
 
+@dataclass(frozen=True)
+class SecureSettlement:
+    """The root settled a masked round (secure/recovery.py): the
+    contributor set was reconciled against the dispatched mask-party
+    cohort, dropout residuals (if any) were subtracted, and the sum
+    decoded to the plain community payload. ``tier`` names which masked
+    plane fed the root: ``stream`` (fold-on-arrival), ``slice``
+    (distributed partial folds) or ``store`` (the in-process path)."""
+
+    kind: ClassVar[str] = "secure_settlement"
+    round: int
+    contributors: int = 0
+    dropped: int = 0
+    recovered: bool = False
+    tier: str = ""
+    duration_ms: float = 0.0
+
+
+@dataclass(frozen=True)
+class SecureMasksRecovered:
+    """A surviving learner disclosed the dropped parties' residual masks
+    (seed-share disclosure through the quorum/deadline expiry path):
+    ``survivor`` recomputed Σ±stream(i, d) for every dropped d so the
+    partial sum unmasks to exactly the survivors' sum — the dropped
+    payloads are settled OUT, never silently folded in."""
+
+    kind: ClassVar[str] = "secure_masks_recovered"
+    round: int
+    survivor: str = ""
+    surviving: int = 0
+    dropped: int = 0
+
+
 EVENT_TYPES: Dict[str, type] = {
     cls.kind: cls
     for cls in (LearnerJoined, LearnerLost, RoundStarted, TaskDispatched,
@@ -405,7 +438,8 @@ EVENT_TYPES: Dict[str, type] = {
                 AlertResolved, FabricPeerStale, FabricPeerRecovered,
                 SliceAggregatorLost, SliceRehomed, ServingReplicaDead,
                 ServingReplicaRecovered, ServingScaledUp,
-                ServingScaledDown, ControllerFailover, RecompileStorm)
+                ServingScaledDown, ControllerFailover, RecompileStorm,
+                SecureSettlement, SecureMasksRecovered)
 }
 
 

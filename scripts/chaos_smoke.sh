@@ -73,6 +73,31 @@ case "$rc" in
 esac
 [ "$rc" -eq 0 ] || exit "$rc"
 
+# ISSUE 20 secure-aggregation gate (docs/SECURITY.md "Secure
+# aggregation at scale"): a real-gRPC federation under scheme=masking
+# composed with distributed slice aggregators AND streaming
+# fold-on-arrival, one learner SIGKILLed with its masked uplink in the
+# air. The build fails unless every round completes via dropout
+# settlement (seed-share disclosure from a survivor), the masks cancel
+# (each round-pinned community equals the same-seed PLAIN control run
+# within the fixed-point tolerance), and the control emits zero
+# secure_* events.
+JAX_PLATFORMS=cpu timeout -k 10 420 "$PYTHON" -m metisfl_tpu.driver.crossdevice \
+  --secure-smoke --seed 7 --timeout 150
+rc=$?
+case "$rc" in
+  0) echo "chaos_smoke: secure-agg PASS (learner SIGKILLed mid-uplink," \
+          "round settled via mask recovery, community equals the plain" \
+          "control within fixed-point tolerance, control secure-silent)" ;;
+  1) echo "chaos_smoke: secure-agg FAIL — a round did not settle, masks" \
+          "failed to cancel against the plain control, or the control" \
+          "emitted secure events (see JSON above)" >&2 ;;
+  *) echo "chaos_smoke: secure-agg FAIL — smoke crashed or timed out" \
+          "(rc=$rc)" >&2
+     rc=2 ;;
+esac
+[ "$rc" -eq 0 ] || exit "$rc"
+
 # ISSUE 11 fleet-tail gate (docs/OBSERVABILITY.md "Fleet fabric"): a
 # three-peer real-gRPC fleet with one flapping learner — the collector
 # must keep assembling the merged view while the peer is down (stale

@@ -418,9 +418,16 @@ def test_config_validation():
         FederationConfig(registry=RegistryConfig(enabled=True,
                                                  retention=0))
     from metisfl_tpu.config import SecureAggConfig
-    with pytest.raises(ValueError, match="secure"):
+    # masking's settled output is the public plain aggregate — the
+    # registry composes with it; ciphertext schemes stay rejected
+    FederationConfig(
+        aggregation=AggregationConfig(rule="secure_agg",
+                                      scaler="participants"),
+        secure=SecureAggConfig(enabled=True, scheme="masking"),
+        registry=RegistryConfig(enabled=True))
+    with pytest.raises(ValueError, match="use scheme: masking"):
         FederationConfig(
             aggregation=AggregationConfig(rule="secure_agg",
                                           scaler="participants"),
-            secure=SecureAggConfig(enabled=True),
+            secure=SecureAggConfig(enabled=True, scheme="ckks"),
             registry=RegistryConfig(enabled=True))

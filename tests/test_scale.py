@@ -177,13 +177,20 @@ def test_fedrec_streaming_disabled_under_checkpointing(tmp_path):
         ctrl.shutdown()
 
 
-def test_streaming_rejected_with_secure_agg():
-    with pytest.raises(ValueError, match="streaming"):
+def test_streaming_composes_with_masking_but_not_ckks():
+    # masking folds on arrival as modular sums — streaming composes
+    FederationConfig(
+        aggregation=AggregationConfig(rule="secure_agg", streaming=True,
+                                      scaler="participants"),
+        secure=SecureAggConfig(enabled=True, scheme="masking",
+                               num_parties=3))
+    # ciphertext schemes cannot stream-fold; the rejection names the
+    # scheme that can
+    with pytest.raises(ValueError, match="secure.scheme: masking"):
         FederationConfig(
             aggregation=AggregationConfig(rule="secure_agg", streaming=True,
                                           scaler="participants"),
-            secure=SecureAggConfig(enabled=True, scheme="masking",
-                                num_parties=3))
+            secure=SecureAggConfig(enabled=True, scheme="ckks"))
 
 
 def test_tree_branch_validation():

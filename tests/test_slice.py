@@ -567,12 +567,20 @@ def test_distributed_config_rejections():
         FederationConfig(aggregation=AggregationConfig(
             streaming=True,
             tree=TreeAggregationConfig(enabled=True, distributed=True)))
-    with pytest.raises(ValueError, match="secure"):
+    # masking composes with the distributed tier (slices fold masked
+    # partial sums); ciphertext schemes do not — the rejection names
+    # the scheme that does
+    FederationConfig(
+        aggregation=AggregationConfig(
+            rule="secure_agg", scaler="participants",
+            tree=TreeAggregationConfig(enabled=True, distributed=True)),
+        secure=SecureAggConfig(enabled=True, scheme="masking"))
+    with pytest.raises(ValueError, match="secure.scheme: masking"):
         FederationConfig(
             aggregation=AggregationConfig(
                 rule="secure_agg", scaler="participants",
                 tree=TreeAggregationConfig(enabled=True, distributed=True)),
-            secure=SecureAggConfig(enabled=True, scheme="masking"))
+            secure=SecureAggConfig(enabled=True, scheme="ckks"))
     with pytest.raises(ValueError, match="ingest_workers"):
         from metisfl_tpu.config import ModelStoreConfig
         FederationConfig(
